@@ -1,0 +1,22 @@
+"""libskylark_tpu — a TPU-native randomized numerical linear algebra framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of libSkylark
+(/root/reference): sketching transforms, sketch-accelerated NLA (randomized
+SVD, sketched least squares, condition estimation) and ML on top of sketching
+(kernel ridge regression, RLSC, block-ADMM kernel machines, graph spectral
+embedding, local community detection).
+
+Design stance (see SURVEY.md §7): sharding specs over a `jax.sharding.Mesh`
+replace Elemental's distribution template parameters; XLA collectives over
+ICI/DCN replace Boost.MPI; `jax.random`'s counter-based Threefry replaces
+Random123 — preserving the reference's core determinism property that a
+sketch's entries are a pure function of (seed, counter), independent of the
+data layout (ref: base/randgen.hpp:98-115, base/context.hpp:19-194).
+"""
+
+__version__ = "0.1.0"
+
+from libskylark_tpu.base.context import Context
+from libskylark_tpu.base import errors
+
+__all__ = ["Context", "errors", "__version__"]

@@ -1,0 +1,133 @@
+"""Exception hierarchy with stable error codes.
+
+TPU-native analog of the reference's error-code table and exception classes
+(ref: base/exception.hpp:297-430). The codes are kept numerically compatible
+(100-112) so that tooling written against the reference's `sl_strerror`
+contract keeps working against :func:`strerror`.
+"""
+
+from __future__ import annotations
+
+
+class SkylarkError(Exception):
+    """Base of all libskylark_tpu errors (ref: base/exception.hpp:310)."""
+
+    code = 100
+
+    def __init__(self, message: str = ""):
+        super().__init__(message or self.__doc__)
+        self._trace: list[str] = []
+
+    def append_trace(self, entry: str) -> "SkylarkError":
+        """Mirror of the reference's trace-append mechanism
+        (ref: base/exception.hpp:262-295)."""
+        self._trace.append(entry)
+        return self
+
+    @property
+    def trace(self) -> list[str]:
+        return list(self._trace)
+
+
+class UnsupportedError(SkylarkError):
+    """Operation not supported for the given types/shardings."""
+
+    code = 101
+
+
+class InvalidParametersError(SkylarkError):
+    """Invalid parameters passed to an algorithm or transform."""
+
+    code = 102
+
+
+class AllocationError(SkylarkError):
+    """Device/host memory allocation failure."""
+
+    code = 103
+
+
+class CommunicationError(SkylarkError):
+    """Collective/mesh communication failure (MPI-exception analog)."""
+
+    code = 104
+
+
+class MeshError(SkylarkError):
+    """Mesh/sharding incompatibility (elemental-exception analog)."""
+
+    code = 105
+
+
+class SparseError(SkylarkError):
+    """Sparse-matrix error (combblas-exception analog)."""
+
+    code = 106
+
+
+class RandgenError(SkylarkError):
+    """Random-stream error (random123-exception analog)."""
+
+    code = 107
+
+
+class SketchError(SkylarkError):
+    """Sketch-layer error."""
+
+    code = 108
+
+
+class NLAError(SkylarkError):
+    """NLA-layer error (factorization failed, solver diverged...)."""
+
+    code = 109
+
+
+class MLError(SkylarkError):
+    """ML-layer error."""
+
+    code = 110
+
+
+class IOError_(SkylarkError):
+    """Data IO error."""
+
+    code = 111
+
+
+class NotImplementedYetError(SkylarkError):
+    """Declared in the API surface but not yet implemented."""
+
+    code = 112
+
+
+_CODE_TABLE = {
+    cls.code: cls
+    for cls in [
+        SkylarkError,
+        UnsupportedError,
+        InvalidParametersError,
+        AllocationError,
+        CommunicationError,
+        MeshError,
+        SparseError,
+        RandgenError,
+        SketchError,
+        NLAError,
+        MLError,
+        IOError_,
+        NotImplementedYetError,
+    ]
+}
+
+
+def strerror(code: int) -> str:
+    """Human-readable message for an error code (ref: base/exception.hpp:256)."""
+    cls = _CODE_TABLE.get(code)
+    if cls is None:
+        return f"unknown error code {code}"
+    return cls.__doc__.split("\n")[0]
+
+
+def from_code(code: int, message: str = "") -> SkylarkError:
+    return _CODE_TABLE.get(code, SkylarkError)(message)

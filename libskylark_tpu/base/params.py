@@ -1,0 +1,47 @@
+"""Base parameter struct for all algorithms.
+
+Analog of ref: base/params.hpp:208-228 — every algorithm's params derives from
+this, carrying logging/debug knobs. JSON-loadable like the reference's
+ptree-backed params (ref: nla/svd.hpp:43-52), which is how the high-level API
+passes params as strings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+from typing import Any, TextIO
+
+
+@dataclasses.dataclass
+class Params:
+    am_i_printing: bool = False
+    log_level: int = 0
+    debug_level: int = 0
+    prefix: str = ""
+    log_stream: TextIO = dataclasses.field(default=sys.stdout, repr=False)
+
+    def log(self, level: int, message: str) -> None:
+        if self.am_i_printing and self.log_level >= level:
+            print(f"{self.prefix}{message}", file=self.log_stream)
+
+    def to_dict(self) -> dict[str, Any]:
+        d = {}
+        for f in dataclasses.fields(self):
+            if f.name == "log_stream":
+                continue
+            d[f.name] = getattr(self, f.name)
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]):
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})
+
+    @classmethod
+    def from_json(cls, s: str):
+        return cls.from_dict(json.loads(s))

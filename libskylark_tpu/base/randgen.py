@@ -1,0 +1,258 @@
+"""Counter-based lazy random streams over jax.random.
+
+TPU-native analog of the reference's ``random_samples_array_t``
+(ref: base/randgen.hpp:17-193): a *virtual* array of i.i.d. samples in which
+element ``i`` is a pure function of (key, i) — order-independent and
+replicable on any device/shard, which is the property that makes sketch
+application layout-independent and exactly testable ("sharded apply ==
+single-device apply with the same seed", ref: tests/unit/DenseSketchApplyElementalTest.cpp:44-101).
+
+Implementation: the stream is generated in fixed-size chunks. Chunk ``c`` of a
+stream with allocation key ``k`` is ``sampler(fold_in(fold_in(k, c>>31), c&M), (CHUNK,))``
+— so any contiguous slice can be materialized by generating only its covering
+chunks, on whichever device needs it. The chunk size is an internal constant:
+changing it changes the stream, so it is part of the format (serialized
+streams record it).
+
+Distributions mirror the reference's set (ref: utility/distributions.hpp):
+normal, uniform real/int, Cauchy, Rademacher, standard Levy (= 1/Gamma(1/2, 2),
+ref: utility/distributions.hpp:17-34), exponential.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+
+# Elements per generation block. Part of the stream format: changing it
+# changes every stream's values.
+CHUNK = 4096
+
+_MASK31 = (1 << 31) - 1
+
+
+def chunk_key(key: jax.Array, cid) -> jax.Array:
+    """Key for chunk ``cid`` (host int of any size, or traced int32 < 2^31)."""
+    if isinstance(cid, (int, np.integer)):
+        hi, lo = int(cid) >> 31, int(cid) & _MASK31
+        return jr.fold_in(jr.fold_in(key, hi), lo)
+    # Traced chunk ids are restricted to < 2^31 (hi word = 0).
+    return jr.fold_in(jr.fold_in(key, 0), cid)
+
+
+# ---------------------------------------------------------------------------
+# Distributions
+# ---------------------------------------------------------------------------
+
+
+class Distribution:
+    """A named, serializable sampler: maps (key, shape, dtype) -> samples."""
+
+    name: str = "distribution"
+
+    def sample(self, key: jax.Array, shape, dtype=jnp.float32) -> jax.Array:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)  # type: ignore[call-overload]
+        d["distribution"] = self.name
+        return d
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "Distribution":
+        d = dict(d)
+        cls = _DIST_REGISTRY[d.pop("distribution")]
+        return cls(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class Normal(Distribution):
+    mean: float = 0.0
+    std: float = 1.0
+    name = "normal"
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        return self.mean + self.std * jr.normal(key, shape, dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Uniform(Distribution):
+    low: float = 0.0
+    high: float = 1.0
+    name = "uniform"
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        return jr.uniform(key, shape, dtype, minval=self.low, maxval=self.high)
+
+
+@dataclasses.dataclass(frozen=True)
+class UniformInt(Distribution):
+    """Uniform integers in [low, high] inclusive (boost convention,
+    ref: utility/distributions.hpp:84-100)."""
+
+    low: int = 0
+    high: int = 1
+    name = "uniform_int"
+
+    def sample(self, key, shape, dtype=jnp.int32):
+        return jr.randint(key, shape, self.low, self.high + 1, dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Cauchy(Distribution):
+    loc: float = 0.0
+    scale: float = 1.0
+    name = "cauchy"
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        return self.loc + self.scale * jr.cauchy(key, shape, dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rademacher(Distribution):
+    name = "rademacher"
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        return jr.rademacher(key, shape).astype(dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class StandardLevy(Distribution):
+    """Standard Levy: 1/Gamma(1/2, scale=2) == 1/Z^2, Z~N(0,1)
+    (ref: utility/distributions.hpp:17-34)."""
+
+    name = "standard_levy"
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        z = jr.normal(key, shape, dtype)
+        return 1.0 / jnp.maximum(z * z, jnp.finfo(dtype).tiny)
+
+
+@dataclasses.dataclass(frozen=True)
+class Exponential(Distribution):
+    rate: float = 1.0
+    name = "exponential"
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        return jr.exponential(key, shape, dtype) / self.rate
+
+
+@dataclasses.dataclass(frozen=True)
+class Gamma(Distribution):
+    shape_param: float = 1.0
+    scale: float = 1.0
+    name = "gamma"
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        return self.scale * jr.gamma(key, self.shape_param, shape, dtype)
+
+
+_DIST_REGISTRY = {
+    cls.name: cls
+    for cls in [
+        Normal,
+        Uniform,
+        UniformInt,
+        Cauchy,
+        Rademacher,
+        StandardLevy,
+        Exponential,
+        Gamma,
+    ]
+}
+
+
+# ---------------------------------------------------------------------------
+# Virtual streams
+# ---------------------------------------------------------------------------
+
+
+def stream_slice(
+    key: jax.Array,
+    dist: Distribution,
+    start: int,
+    stop: int,
+    dtype=jnp.float32,
+    chunk: int = CHUNK,
+) -> jax.Array:
+    """Materialize elements [start, stop) of the virtual stream.
+
+    ``start``/``stop`` are host-side ints (shard-local slice bounds are static
+    under jit). Equivalent of indexing ``random_samples_array_t``
+    (ref: base/randgen.hpp:98-115): the result does not depend on what other
+    slices anyone else materializes.
+    """
+    if stop <= start:
+        return jnp.zeros((0,), dtype)
+    c0 = start // chunk
+    c1 = -(-stop // chunk)
+    cids = np.arange(c0, c1, dtype=np.int64)
+    hi = (cids >> 31).astype(np.int32)
+    lo = (cids & _MASK31).astype(np.int32)
+    keys = jax.vmap(lambda h, l: jr.fold_in(jr.fold_in(key, h), l))(hi, lo)
+    vals = jax.vmap(lambda k: dist.sample(k, (chunk,), dtype))(keys)
+    flat = vals.reshape(-1)
+    return flat[start - c0 * chunk : stop - c0 * chunk]
+
+
+def stream_chunks(
+    key: jax.Array,
+    dist: Distribution,
+    first_cid,
+    n_chunks: int,
+    dtype=jnp.float32,
+    chunk: int = CHUNK,
+) -> jax.Array:
+    """Materialize ``n_chunks`` whole chunks starting at chunk id ``first_cid``.
+
+    ``first_cid`` may be a traced int32 (for use inside lax loops over
+    panels); ``n_chunks`` must be static. Returns shape (n_chunks * chunk,).
+    """
+    cids = first_cid + jnp.arange(n_chunks, dtype=jnp.int32)
+    keys = jax.vmap(lambda c: chunk_key(key, c))(cids)
+    vals = jax.vmap(lambda k: dist.sample(k, (chunk,), dtype))(keys)
+    return vals.reshape(-1)
+
+
+def dense_block(
+    key: jax.Array,
+    dist: Distribution,
+    rows: int,
+    block_id,
+    block_cols: int,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Column block ``block_id`` of a virtual i.i.d. (rows x n) matrix.
+
+    The matrix is defined in column blocks of width ``block_cols``: block ``b``
+    is ``sampler(chunk_key(key, b), (rows, block_cols))``. Any shard can
+    materialize any column panel without generating the rest — the TPU-native
+    form of the reference's ``realize_matrix_view`` lazy-panel trick
+    (ref: sketch/dense_transform_data.hpp:79-152). ``block_id`` may be traced.
+    """
+    return dist.sample(chunk_key(key, block_id), (rows, block_cols), dtype)
+
+
+def dense_panel(
+    key: jax.Array,
+    dist: Distribution,
+    rows: int,
+    col_start: int,
+    col_stop: int,
+    block_cols: int,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Materialize columns [col_start, col_stop) of the virtual (rows x n)
+    matrix defined by :func:`dense_block`. Host-side static bounds."""
+    b0 = col_start // block_cols
+    b1 = -(-col_stop // block_cols)
+    blocks = [
+        dense_block(key, dist, rows, b, block_cols, dtype) for b in range(b0, b1)
+    ]
+    panel = blocks[0] if len(blocks) == 1 else jnp.concatenate(blocks, axis=1)
+    return panel[:, col_start - b0 * block_cols : col_stop - b0 * block_cols]

@@ -1,0 +1,31 @@
+"""Parallelism layer: meshes, shardings, collective helpers (SURVEY.md §2.9)."""
+
+from libskylark_tpu.parallel.mesh import (
+    COLS,
+    ROWS,
+    col_sharded,
+    distribute,
+    grid2d,
+    make_mesh,
+    replicated,
+    row_sharded,
+    square_mesh,
+    to_host,
+    use_mesh,
+    vec_sharded,
+)
+
+__all__ = [
+    "COLS",
+    "ROWS",
+    "col_sharded",
+    "distribute",
+    "grid2d",
+    "make_mesh",
+    "replicated",
+    "row_sharded",
+    "square_mesh",
+    "to_host",
+    "use_mesh",
+    "vec_sharded",
+]

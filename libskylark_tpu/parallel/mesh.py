@@ -1,0 +1,121 @@
+"""Device-mesh and sharding helpers — the distribution vocabulary.
+
+TPU-native replacement for Elemental's distribution template parameters
+(SURVEY.md §2.9). The reference encodes data layout in types
+([MC,MR], [VC,*], [*,VR], [*,*], [CIRC,CIRC]); here layout is a
+``jax.sharding.NamedSharding`` over a ``Mesh``, and XLA inserts the
+collectives that Elemental performed in redistribution assignments.
+
+Correspondence (ref: sketch/sketch_transform.hpp:13-51 type universe):
+
+=============  =======================================  =========================
+Reference      Meaning                                  Here
+=============  =======================================  =========================
+[MC, MR]       2D block-cyclic over process grid        ``grid2d(mesh)`` — P(ROWS, COLS)
+[VC, *]/[VR,*] 1D row distribution                      ``row_sharded(mesh)`` — P(axes, None)
+[*, VC]/[*,VR] 1D column distribution                   ``col_sharded(mesh)`` — P(None, axes)
+[*, *]         replicated on all ranks                  ``replicated(mesh)`` — P()
+[CIRC, CIRC]   stored on root rank only                 host numpy / ``to_host``
+=============  =======================================  =========================
+
+Communicator extraction (ref: utility/get_communicator.hpp:25-51) has no
+analog: mesh axes *are* the communicators.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROWS = "rows"
+COLS = "cols"
+
+
+def make_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Optional[Sequence[str]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Create a device mesh. Default: 1D over all devices, axis ``rows``.
+
+    ``shape=(r, c)`` gives the 2D grid analog of Elemental's process grid
+    (ref: El::Grid); XLA maps the first axis to the slower-varying ICI
+    dimension via ``mesh_utils.create_device_mesh``.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),)
+    if axis_names is None:
+        axis_names = (ROWS, COLS)[: len(shape)]
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(
+            f"mesh shape {tuple(shape)} does not cover {len(devices)} devices"
+        )
+    dev_array = mesh_utils.create_device_mesh(tuple(shape), devices=devices)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def square_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Largest (r, c) grid with r*c == n_devices and r<=c, r maximal — the
+    analog of Elemental's default near-square grid."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    r = int(np.floor(np.sqrt(n)))
+    while n % r:
+        r -= 1
+    return make_mesh((r, n // r), (ROWS, COLS), devices)
+
+
+def _all_axes(mesh: Mesh):
+    axes = tuple(mesh.axis_names)
+    return axes if len(axes) > 1 else axes[0]
+
+
+def row_sharded(mesh: Mesh) -> NamedSharding:
+    """1D row distribution over *all* mesh axes ([VC,*] analog)."""
+    return NamedSharding(mesh, P(_all_axes(mesh), None))
+
+
+def col_sharded(mesh: Mesh) -> NamedSharding:
+    """1D column distribution over *all* mesh axes ([*,VR] analog)."""
+    return NamedSharding(mesh, P(None, _all_axes(mesh)))
+
+
+def grid2d(mesh: Mesh) -> NamedSharding:
+    """2D distribution: rows over first axis, cols over second ([MC,MR] analog)."""
+    if len(mesh.axis_names) < 2:
+        return row_sharded(mesh)
+    return NamedSharding(mesh, P(mesh.axis_names[0], mesh.axis_names[1]))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully replicated ([*,*] analog)."""
+    return NamedSharding(mesh, P())
+
+
+def vec_sharded(mesh: Mesh) -> NamedSharding:
+    """1D-sharded vector over all mesh axes."""
+    return NamedSharding(mesh, P(_all_axes(mesh)))
+
+
+def distribute(x, sharding: NamedSharding) -> jax.Array:
+    """Place an array with the given sharding (the redistribution primitive —
+    Elemental's ``B = A`` distribution-conversion assignment)."""
+    return jax.device_put(x, sharding)
+
+
+def to_host(x) -> np.ndarray:
+    """Gather to host ([CIRC,CIRC] root-gather analog)."""
+    return np.asarray(jax.device_get(x))
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Context manager exposing the mesh for `jax.lax` collective lowering."""
+    with mesh:
+        yield mesh

@@ -1,0 +1,49 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's strategy of testing distributed behavior with
+``mpirun -np N`` on a single box (ref: tests/unit/CMakeLists.txt:10-46);
+here N virtual XLA host devices play the role of MPI ranks. Must run before
+jax initializes its backends, hence the env mutation at import time.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize pre-imports jax with the TPU platform pinned; the
+# config update (post-import, pre-backend-init) overrides it reliably.
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass  # older jax: the XLA_FLAGS above covers it
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def mesh1d():
+    from libskylark_tpu.parallel import make_mesh
+
+    return make_mesh()
+
+
+@pytest.fixture()
+def mesh2d():
+    from libskylark_tpu.parallel import make_mesh
+
+    return make_mesh((2, 4))
