@@ -31,22 +31,36 @@ class Allocation:
 
     Reconstructible from (seed, counter) alone — this pair is what sketch
     transforms serialize as their ``creation_context``
-    (ref: sketch/sketch_transform_data.hpp:64-71).
+    (ref: sketch/sketch_transform_data.hpp:64-71). ``path`` supports nested
+    sub-allocations for compound transforms (e.g. PPT's internal CWTs): each
+    element is folded into the key in order.
     """
 
     seed: int
     counter: int
+    path: tuple = ()
 
     @property
     def key(self) -> jax.Array:
-        return jr.fold_in(jr.key(self.seed), self.counter)
+        k = jr.fold_in(jr.key(self.seed), self.counter)
+        for p in self.path:
+            k = jr.fold_in(k, p)
+        return k
 
-    def to_dict(self) -> dict[str, int]:
-        return {"seed": int(self.seed), "counter": int(self.counter)}
+    def child(self, tag: int) -> "Allocation":
+        return Allocation(self.seed, self.counter, self.path + (int(tag),))
+
+    def to_dict(self) -> dict[str, Any]:
+        d = {"seed": int(self.seed), "counter": int(self.counter)}
+        if self.path:
+            d["path"] = list(self.path)
+        return d
 
     @staticmethod
     def from_dict(d: dict[str, Any]) -> "Allocation":
-        return Allocation(int(d["seed"]), int(d["counter"]))
+        return Allocation(
+            int(d["seed"]), int(d["counter"]), tuple(d.get("path", ()))
+        )
 
 
 class Context:

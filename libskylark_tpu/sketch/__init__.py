@@ -1,0 +1,69 @@
+"""Sketching transforms — the core layer (SURVEY.md §2.2).
+
+Uniform protocol: ``T = JLT(N, S, context); SA = T.apply(A, COLUMNWISE)``,
+serialization via ``T.to_json()`` / ``deserialize_sketch``.
+"""
+
+from libskylark_tpu.sketch.transform import (
+    COLUMNWISE,
+    ROWWISE,
+    Dimension,
+    SketchTransform,
+    deserialize_sketch,
+    register,
+)
+from libskylark_tpu.sketch import params
+from libskylark_tpu.sketch.dense import CT, JLT, DenseTransform
+from libskylark_tpu.sketch.hash import CWT, MMT, WZT, HashTransform
+from libskylark_tpu.sketch.rft import (
+    ExpSemigroupRLT,
+    GaussianRFT,
+    LaplacianRFT,
+    MaternRFT,
+    RFT,
+)
+from libskylark_tpu.sketch.ust import UST
+from libskylark_tpu.sketch import fut
+from libskylark_tpu.sketch.fjlt import FJLT, RFUT
+from libskylark_tpu.sketch.frft import FastGaussianRFT, FastMaternRFT, FastRFT
+from libskylark_tpu.sketch.ppt import PPT
+from libskylark_tpu.sketch.qrft import (
+    ExpSemigroupQRLT,
+    GaussianQRFT,
+    LaplacianQRFT,
+    QRFT,
+)
+
+__all__ = [
+    "fut",
+    "FJLT",
+    "RFUT",
+    "FastRFT",
+    "FastGaussianRFT",
+    "FastMaternRFT",
+    "PPT",
+    "QRFT",
+    "GaussianQRFT",
+    "LaplacianQRFT",
+    "ExpSemigroupQRLT",
+    "COLUMNWISE",
+    "ROWWISE",
+    "Dimension",
+    "SketchTransform",
+    "deserialize_sketch",
+    "register",
+    "params",
+    "JLT",
+    "CT",
+    "DenseTransform",
+    "CWT",
+    "MMT",
+    "WZT",
+    "HashTransform",
+    "UST",
+    "RFT",
+    "GaussianRFT",
+    "LaplacianRFT",
+    "MaternRFT",
+    "ExpSemigroupRLT",
+]

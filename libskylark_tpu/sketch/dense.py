@@ -1,0 +1,170 @@
+"""Lazy dense sketch transforms: JLT, CT.
+
+TPU-native analog of the reference's dense_transform family
+(ref: sketch/dense_transform.hpp, sketch/dense_transform_data.hpp:22-174,
+sketch/JLT_data.hpp:17-78, sketch/CT_data.hpp:21-60).
+
+The sketch matrix S (S_dim × N) is *virtual*: entries are a pure function of
+(allocation key, column block), so any column panel can be materialized
+on-demand on whichever device needs it — the reference's
+``realize_matrix_view`` trick (ref: sketch/dense_transform_data.hpp:79-152)
+that lets distributed apply proceed without ever storing S. Column blocks are
+``BLOCK_COLS`` wide; the block width is part of the transform's definition
+(changing it changes the entries).
+
+Three apply regimes (the analog of the reference's 3-regime panel algorithm,
+ref: sketch/dense_transform_Elemental_mc_mr.hpp:617-658, tuned by
+sketch_params blocksize/factor):
+- small N: materialize S once, single fused matmul (XLA fuses generation
+  into the pipeline; MXU does the work).
+- large N (``apply_blocked``): lax.scan over column panels of S / row panels
+  of A, materializing one (S_dim × blocksize) panel per step — bounded memory,
+  traced block ids.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax.numpy as jnp
+from jax import lax
+
+from libskylark_tpu.base import errors, randgen
+from libskylark_tpu.sketch import params as sketch_params
+from libskylark_tpu.sketch.transform import SketchTransform, register
+
+# Width of a virtual-S column block; part of the stream format.
+BLOCK_COLS = 256
+
+
+class DenseTransform(SketchTransform):
+    """Base: S = scale × i.i.d. matrix from ``dist``
+    (ref: sketch/random_dense_transform_data.hpp:15-76)."""
+
+    sketch_type = "DenseTransform"
+    dist: randgen.Distribution = randgen.Normal()
+
+    @property
+    def scale(self) -> float:
+        raise NotImplementedError
+
+    # -- virtual S materialization --
+
+    def s_panel(self, col_start: int, col_stop: int, dtype=jnp.float32) -> jnp.ndarray:
+        """Materialize S[:, col_start:col_stop] (static bounds)."""
+        return self.scale * randgen.dense_panel(
+            self._alloc.key, self.dist, self._S, col_start, col_stop, BLOCK_COLS, dtype
+        )
+
+    def s_block(self, block_id, dtype=jnp.float32) -> jnp.ndarray:
+        """Materialize column block ``block_id`` (traced id ok; for scan loops)."""
+        return self.scale * randgen.dense_block(
+            self._alloc.key, self.dist, self._S, block_id, BLOCK_COLS, dtype
+        )
+
+    # -- apply --
+
+    def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        blocksize = sketch_params.get_blocksize()
+        if blocksize and self._N > blocksize:
+            return self._apply_columnwise_blocked(A, blocksize)
+        S = self.s_panel(0, self._N, A.dtype)
+        return S @ A
+
+    def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        blocksize = sketch_params.get_blocksize()
+        if blocksize and self._N > blocksize:
+            return self._apply_rowwise_blocked(A, blocksize)
+        S = self.s_panel(0, self._N, A.dtype)
+        return A @ S.T
+
+    # -- blocked (memory-bounded) apply: scan over column panels of S --
+
+    def _panel_schedule(self, blocksize: int):
+        """Round blocksize down to a BLOCK_COLS multiple; compute panel count."""
+        bs = max(BLOCK_COLS, (blocksize // BLOCK_COLS) * BLOCK_COLS)
+        n_full = self._N // bs
+        rem = self._N - n_full * bs
+        return bs, n_full, rem
+
+    def _apply_columnwise_blocked(self, A: jnp.ndarray, blocksize: int) -> jnp.ndarray:
+        """SA = Σ_p S[:, p] @ A[p, :], one virtual panel at a time."""
+        bs, n_full, rem = self._panel_schedule(blocksize)
+        blocks_per_panel = bs // BLOCK_COLS
+        m = A.shape[1]
+        acc0 = jnp.zeros((self._S, m), A.dtype)
+
+        def body(acc, p):
+            first = p * blocks_per_panel
+            panel = jnp.concatenate(
+                [self.s_block(first + b, A.dtype) for b in range(blocks_per_panel)],
+                axis=1,
+            )
+            a_rows = lax.dynamic_slice_in_dim(A, p * bs, bs, axis=0)
+            return acc + panel @ a_rows, None
+
+        acc, _ = lax.scan(body, acc0, jnp.arange(n_full, dtype=jnp.int32))
+        if rem:
+            tail = self.s_panel(n_full * bs, self._N, A.dtype)
+            acc = acc + tail @ A[n_full * bs :, :]
+        return acc
+
+    def _apply_rowwise_blocked(self, A: jnp.ndarray, blocksize: int) -> jnp.ndarray:
+        """A·Sᵀ = Σ_p A[:, p] @ S[:, p]ᵀ, one virtual panel at a time."""
+        bs, n_full, rem = self._panel_schedule(blocksize)
+        blocks_per_panel = bs // BLOCK_COLS
+        m = A.shape[0]
+        acc0 = jnp.zeros((m, self._S), A.dtype)
+
+        def body(acc, p):
+            first = p * blocks_per_panel
+            panel = jnp.concatenate(
+                [self.s_block(first + b, A.dtype) for b in range(blocks_per_panel)],
+                axis=1,
+            )
+            a_cols = lax.dynamic_slice_in_dim(A, p * bs, bs, axis=1)
+            return acc + a_cols @ panel.T, None
+
+        acc, _ = lax.scan(body, acc0, jnp.arange(n_full, dtype=jnp.int32))
+        if rem:
+            tail = self.s_panel(n_full * bs, self._N, A.dtype)
+            acc = acc + A[:, n_full * bs :] @ tail.T
+        return acc
+
+
+@register
+class JLT(DenseTransform):
+    """Johnson-Lindenstrauss transform: S ~ N(0, 1/S_dim)
+    (ref: sketch/JLT_data.hpp:27-38 — scale sqrt(1/S))."""
+
+    sketch_type = "JLT"
+    dist = randgen.Normal()
+
+    @property
+    def scale(self) -> float:
+        return math.sqrt(1.0 / self._S)
+
+
+@register
+class CT(DenseTransform):
+    """Cauchy transform for l1 embedding: Cauchy entries scaled C/S
+    (ref: sketch/CT_data.hpp:35-47)."""
+
+    sketch_type = "CT"
+    dist = randgen.Cauchy()
+
+    def __init__(self, N, S, context, C: float = 1.0):
+        self._C = float(C)
+        super().__init__(N, S, context)
+
+    @property
+    def scale(self) -> float:
+        return self._C / self._S
+
+    def _extra_params(self) -> dict[str, Any]:
+        return {"C": self._C}
+
+    @classmethod
+    def _from_parts(cls, N, S, alloc, d):
+        return cls(N, S, alloc, C=float(d.get("C", 1.0)))

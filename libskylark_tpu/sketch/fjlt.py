@@ -1,0 +1,115 @@
+"""RFUT (randomized fast unitary transform) and FJLT.
+
+TPU-native analogs of ref: sketch/RFUT_data.hpp:20-55, sketch/RFUT_Elemental.hpp:15-310,
+sketch/FJLT_data.hpp:25-98, sketch/FJLT_Elemental.hpp:13-555.
+
+RFUT: X → F·D·X with D a random (Rademacher) diagonal and F a fast unitary
+transform scaled to near-orthonormality.
+
+FJLT (subsampled randomized DCT/DHT): S = sqrt(N/S_dim) · R · F · D — mix with
+RFUT, then uniformly sample S_dim coordinates
+(ref: FJLT_Elemental.hpp:144-174: per-rank local FUT, then sample with scale
+sqrt(N/S)). Under a sharded input the FUT runs independently per column shard
+(the transform acts along the N axis, which is materialized locally when the
+input is column-sharded; for row-sharded inputs XLA re-lays out, the analog of
+the reference's [VC,*] → [*,VR] redistribution).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax.numpy as jnp
+
+from libskylark_tpu.base import randgen
+from libskylark_tpu.sketch.fut import make_fut
+from libskylark_tpu.sketch.transform import SketchTransform, register
+
+
+@register
+class RFUT(SketchTransform):
+    """X → F·D·X (output dim == input dim). ``dist`` fixed to Rademacher, the
+    only use in the reference (FJLT's underlying mixer)."""
+
+    sketch_type = "RFUT"
+
+    def __init__(self, N, S=None, context=None, fut: str = "dct"):
+        # RFUT preserves dimension; accept (N, context) calling style too.
+        if context is None:
+            context = S
+            S = N
+        self._fut_name = fut
+        super().__init__(N, N, context)
+
+    def _build(self):
+        self._fut = make_fut(self._fut_name, self._N)
+
+    def diagonal(self, dtype=jnp.float32) -> jnp.ndarray:
+        return randgen.stream_slice(
+            self.subkey(0), randgen.Rademacher(), 0, self._N, dtype=dtype
+        )
+
+    def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        D = self.diagonal(A.dtype)
+        return self._fut.apply(self._fut.scale() * D[:, None] * A, axis=0)
+
+    def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        D = self.diagonal(A.dtype)
+        return self._fut.apply(self._fut.scale() * D[None, :] * A, axis=1)
+
+    def _extra_params(self) -> dict[str, Any]:
+        return {"fut": self._fut_name}
+
+    @classmethod
+    def _from_parts(cls, N, S, alloc, d):
+        return cls(N, alloc, fut=d.get("fut", "dct"))
+
+
+@register
+class FJLT(SketchTransform):
+    """Fast Johnson-Lindenstrauss transform (ref: sketch/FJLT_data.hpp)."""
+
+    sketch_type = "FJLT"
+
+    def __init__(self, N, S, context, fut: str = "dct"):
+        self._fut_name = fut
+        super().__init__(N, S, context)
+
+    def _build(self):
+        self._fut = make_fut(self._fut_name, self._N)
+
+    def diagonal(self, dtype=jnp.float32) -> jnp.ndarray:
+        """Rademacher mixing diagonal (sub-stream 0; the underlying RFUT's D)."""
+        return randgen.stream_slice(
+            self.subkey(0), randgen.Rademacher(), 0, self._N, dtype=dtype
+        )
+
+    def sample_indices(self) -> jnp.ndarray:
+        """Uniform coordinate samples (sub-stream 1; ref: FJLT_data.hpp:83-86)."""
+        return randgen.stream_slice(
+            self.subkey(1),
+            randgen.UniformInt(0, self._N - 1),
+            0,
+            self._S,
+            dtype=jnp.int32,
+        )
+
+    def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        D = self.diagonal(A.dtype)
+        mixed = self._fut.apply(self._fut.scale() * D[:, None] * A, axis=0)
+        scale = math.sqrt(self._N / self._S)
+        return scale * mixed[self.sample_indices(), :]
+
+    def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        D = self.diagonal(A.dtype)
+        mixed = self._fut.apply(self._fut.scale() * D[None, :] * A, axis=1)
+        scale = math.sqrt(self._N / self._S)
+        return scale * mixed[:, self.sample_indices()]
+
+    def _extra_params(self) -> dict[str, Any]:
+        return {"fut": self._fut_name}
+
+    @classmethod
+    def _from_parts(cls, N, S, alloc, d):
+        return cls(N, S, alloc, fut=d.get("fut", "dct"))

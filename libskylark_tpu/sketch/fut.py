@@ -1,0 +1,126 @@
+"""Fast unitary transforms: DCT, DHT, WHT.
+
+TPU-native analog of the reference's FFTW/SpiralWHT plan wrappers
+(ref: sketch/FUT.hpp:21-347). The reference wraps FFTW r2r plans (REDFT10 =
+unnormalized DCT-II, REDFT01 = DCT-III, FFTW_DHT) and SpiralWHT; here the
+transforms are XLA ops — ``jax.scipy.fft.dct`` matches FFTW's unnormalized
+convention exactly, DHT is Re(FFT) − Im(FFT), and WHT is a log2(N) reshape
+butterfly that XLA maps onto the VPU.
+
+Scale convention matches the reference (ref: sketch/FUT.hpp:55-56): each FUT
+exposes ``scale() = 1/sqrt(ScaleVal·N)`` with ScaleVal 2 for DCT, 1 for
+DHT/WHT, making scale·F approximately orthonormal.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import jax.scipy.fft as jfft
+
+
+def dct(A: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+    """Unnormalized DCT-II (FFTW REDFT10 analog)."""
+    return jfft.dct(A, type=2, axis=axis)
+
+
+def idct(A: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+    """Unnormalized DCT-III = FFTW REDFT01 (inverse of REDFT10 up to 2N)."""
+    # jax idct(type=2) inverts dct including normalization; FFTW's REDFT01 is
+    # unnormalized: REDFT01(REDFT10(x)) = 2N x. Match FFTW.
+    n = A.shape[axis]
+    return jfft.idct(A, type=2, axis=axis) * (2.0 * n)
+
+
+def dht(A: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+    """Discrete Hartley transform (FFTW_DHT analog): cas-kernel, self-inverse
+    up to N."""
+    F = jnp.fft.fft(A, axis=axis)
+    return jnp.real(F) - jnp.imag(F)
+
+
+def wht(A: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+    """Fast Walsh-Hadamard transform (natural/Hadamard ordering), N = 2^k
+    (SpiralWHT analog, ref: sketch/FUT.hpp:225-347). Unnormalized, self-inverse
+    up to N."""
+    if axis != 0:
+        return jnp.moveaxis(wht(jnp.moveaxis(A, axis, 0)), 0, axis)
+    n = A.shape[0]
+    if n & (n - 1):
+        raise ValueError(f"WHT requires power-of-2 length, got {n}")
+    orig_shape = A.shape
+    x = A.reshape(n, -1)
+    h = 1
+    while h < n:
+        x = x.reshape(n // (2 * h), 2, h, -1)
+        a, b = x[:, 0], x[:, 1]
+        x = jnp.stack([a + b, a - b], axis=1).reshape(n, -1)
+        h *= 2
+    return x.reshape(orig_shape)
+
+
+class FUT:
+    """A fast unitary transform with the reference's scale convention."""
+
+    def __init__(self, n: int):
+        self.n = int(n)
+
+    def scale(self) -> float:
+        raise NotImplementedError
+
+    def apply(self, A: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def apply_inverse(self, A: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+        raise NotImplementedError
+
+
+class DCT(FUT):
+    """ScaleVal=2 (ref: sketch/FUT.hpp:138-140)."""
+
+    name = "dct"
+
+    def scale(self) -> float:
+        return 1.0 / math.sqrt(2.0 * self.n)
+
+    def apply(self, A, axis=0):
+        return dct(A, axis)
+
+    def apply_inverse(self, A, axis=0):
+        return idct(A, axis)
+
+
+class DHT(FUT):
+    """ScaleVal=1 (ref: sketch/FUT.hpp:142-143)."""
+
+    name = "dht"
+
+    def scale(self) -> float:
+        return 1.0 / math.sqrt(self.n)
+
+    def apply(self, A, axis=0):
+        return dht(A, axis)
+
+    apply_inverse = apply
+
+
+class WHT(FUT):
+    """Walsh-Hadamard; requires power-of-2 n (ref: sketch/FUT.hpp:225-347)."""
+
+    name = "wht"
+
+    def scale(self) -> float:
+        return 1.0 / math.sqrt(self.n)
+
+    def apply(self, A, axis=0):
+        return wht(A, axis)
+
+    apply_inverse = apply
+
+
+_FUTS = {"dct": DCT, "dht": DHT, "wht": WHT}
+
+
+def make_fut(name: str, n: int) -> FUT:
+    return _FUTS[name](n)

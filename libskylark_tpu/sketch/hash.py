@@ -1,0 +1,118 @@
+"""Hash-based sparse-embedding sketches: CWT (CountSketch), MMT, WZT.
+
+TPU-native analog of the reference's hash_transform family
+(ref: sketch/hash_transform_data.hpp:21-104, sketch/CWT_data.hpp:23-70,
+sketch/MMT_data.hpp:22-60, sketch/WZT_data.hpp:27-124).
+
+The transform is defined by two virtual streams over the allocation key:
+``row_idx`` — a uniform bucket in [0, S) per input coordinate — and
+``row_value`` — a per-coordinate scaling (Rademacher for CWT, Cauchy for MMT,
+signed reciprocal-exponential for WZT). Where the reference applies these with
+O(nnz) CSC scatter loops (ref: sketch/hash_transform_Elemental.hpp:83-124),
+the TPU-native formulation is a ``segment_sum`` — a dataflow scatter-add XLA
+maps onto the VPU, and which under a sharded input becomes a local
+segment-sum + psum exactly like the reference's local-accumulate + all_reduce
+pattern (ref: sketch/hash_transform_Elemental.hpp:427-607).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from libskylark_tpu.base import randgen
+from libskylark_tpu.sketch.transform import SketchTransform, register
+
+
+class HashTransform(SketchTransform):
+    """Base: SA[h[j], :] += v[j] * A[j, :] (columnwise)."""
+
+    sketch_type = "HashTransform"
+
+    def _value_stream(self, dtype) -> jnp.ndarray:
+        """Per-coordinate scaling values v[0:N]; overridden per transform."""
+        raise NotImplementedError
+
+    def bucket_indices(self) -> jnp.ndarray:
+        """h[0:N] — bucket of each input coordinate (sub-stream 0)."""
+        return randgen.stream_slice(
+            self.subkey(0), randgen.UniformInt(0, self._S - 1), 0, self._N,
+            dtype=jnp.int32,
+        )
+
+    def values(self, dtype=jnp.float32) -> jnp.ndarray:
+        return self._value_stream(dtype)
+
+    def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        h = self.bucket_indices()
+        v = self.values(A.dtype)
+        return jax.ops.segment_sum(v[:, None] * A, h, num_segments=self._S)
+
+    def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        h = self.bucket_indices()
+        v = self.values(A.dtype)
+        return jax.ops.segment_sum(v[:, None] * A.T, h, num_segments=self._S).T
+
+
+@register
+class CWT(HashTransform):
+    """Clarkson-Woodruff CountSketch: ±1 values (OSNAP s=1)
+    (ref: sketch/CWT_data.hpp:23-70)."""
+
+    sketch_type = "CWT"
+
+    def _value_stream(self, dtype):
+        return randgen.stream_slice(
+            self.subkey(1), randgen.Rademacher(), 0, self._N, dtype=dtype
+        )
+
+
+@register
+class MMT(HashTransform):
+    """Meng-Mahoney transform: CountSketch with Cauchy values for l1 embedding
+    (ref: sketch/MMT_data.hpp:22-60)."""
+
+    sketch_type = "MMT"
+
+    def _value_stream(self, dtype):
+        return randgen.stream_slice(
+            self.subkey(1), randgen.Cauchy(), 0, self._N, dtype=dtype
+        )
+
+
+@register
+class WZT(HashTransform):
+    """Woodruff-Zhang transform for lp (p in [1,2]): values are
+    ±(1/Exp(1))^(1/p) (ref: sketch/WZT_data.hpp:106-124 — base exponential
+    stream reshaped to the target distribution, signed by a Rademacher
+    stream)."""
+
+    sketch_type = "WZT"
+
+    def __init__(self, N, S, context, p: float = 2.0):
+        if p < 1 or p > 2:
+            from libskylark_tpu.base import errors
+
+            raise errors.InvalidParametersError(
+                "WZT parameter p has to be in [1, 2]"
+            )
+        self._p = float(p)
+        super().__init__(N, S, context)
+
+    def _value_stream(self, dtype):
+        e = randgen.stream_slice(
+            self.subkey(1), randgen.Exponential(), 0, self._N, dtype=dtype
+        )
+        pm = randgen.stream_slice(
+            self.subkey(2), randgen.Rademacher(), 0, self._N, dtype=dtype
+        )
+        return pm * jnp.power(1.0 / e, 1.0 / self._p)
+
+    def _extra_params(self) -> dict[str, Any]:
+        return {"P": self._p}
+
+    @classmethod
+    def _from_parts(cls, N, S, alloc, d):
+        return cls(N, S, alloc, p=float(d.get("P", 2.0)))

@@ -1,0 +1,31 @@
+"""Global sketch tuning knobs (ref: sketch/sketch_params.hpp:15-36).
+
+``blocksize`` — column-panel width for memory-bounded dense apply (0 disables
+blocking: "better performance, much more memory", ref comment). The reference
+default is 1000 columns; we default to 0 (unblocked) because XLA fuses
+generation into the matmul and HBM is large — callers with huge N opt in.
+
+``factor`` — regime-selection threshold for distributed apply
+(ref: sketch/sketch_params.hpp:19).
+"""
+
+_blocksize = 0
+_factor = 20
+
+
+def get_blocksize() -> int:
+    return _blocksize
+
+
+def set_blocksize(b: int) -> None:
+    global _blocksize
+    _blocksize = int(b)
+
+
+def get_factor() -> int:
+    return _factor
+
+
+def set_factor(f: int) -> None:
+    global _factor
+    _factor = int(f)

@@ -1,0 +1,189 @@
+"""Quasi-random feature transforms: GaussianQRFT, LaplacianQRFT, ExpSemigroupQRLT.
+
+TPU-native analog of ref: sketch/QRFT_data.hpp:27-290, sketch/QRLT_data.hpp:35-150.
+Same feature maps as RFT/RLT, but frequencies come from a leaped Halton QMC
+sequence pushed through the kernel distribution's inverse CDF instead of
+pseudo-random draws: W[i, j] = inscale · quantile(dist, seq(skip+i, j)), and
+the phase shift uses the extra sequence dimension N
+(ref: QRFT_data.hpp:91-93: shifts[i] = 2π·seq(skip+i, N)).
+
+W is built host-side in float64 numpy (it is a deterministic function of
+(sequence, skip) — no RNG involved) and shipped to device once.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from scipy import special as sps
+
+from libskylark_tpu.base.quasirand import LeapedHaltonSequence, QMCSequence
+from libskylark_tpu.sketch.transform import SketchTransform, register
+
+
+def _normal_quantile(p: np.ndarray) -> np.ndarray:
+    return sps.ndtri(p)
+
+
+def _cauchy_quantile(p: np.ndarray) -> np.ndarray:
+    return np.tan(np.pi * (p - 0.5))
+
+
+def _levy_quantile(p: np.ndarray) -> np.ndarray:
+    """Standard Levy quantile: 1/(2·erfcinv(p)²)
+    (ref: sketch/QRLT_data.hpp:137-146)."""
+    v = sps.erfcinv(p)
+    return 1.0 / (2.0 * v * v)
+
+
+class QRFT(SketchTransform):
+    """Base quasi-random Fourier features."""
+
+    sketch_type = "QRFT"
+    _quantile = staticmethod(_normal_quantile)
+
+    def __init__(self, N, S, context, sequence: Optional[QMCSequence] = None,
+                 skip: int = 0):
+        self._sequence = sequence or LeapedHaltonSequence(N + 1)
+        self._skip = int(skip)
+        super().__init__(N, S, context)
+
+    @property
+    def inscale(self) -> float:
+        raise NotImplementedError
+
+    @property
+    def outscale(self) -> float:
+        return math.sqrt(2.0 / self._S)
+
+    def _build(self):
+        # Coordinates for features [skip, skip+S) over dims [0, N] — last dim
+        # feeds the shifts (ref: QRFT_data.hpp qmc_sequence_dim = N+1).
+        panel = self._sequence.panel(self._skip, self._skip + self._S, self._N + 1)
+        # Clamp away from {0,1} where quantiles blow up.
+        eps = np.finfo(np.float64).tiny
+        coords = np.clip(panel[:, : self._N], eps, 1 - 1e-16)
+        self._W_host = self.inscale * self._quantile(coords)
+        self._shifts_host = 2.0 * math.pi * panel[:, self._N]
+
+    def w_matrix(self, dtype=jnp.float32) -> jnp.ndarray:
+        return jnp.asarray(self._W_host, dtype=dtype)
+
+    def shifts(self, dtype=jnp.float32) -> jnp.ndarray:
+        return jnp.asarray(self._shifts_host, dtype=dtype)
+
+    def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        W = self.w_matrix(A.dtype)
+        return self.outscale * jnp.cos(W @ A + self.shifts(A.dtype)[:, None])
+
+    def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        W = self.w_matrix(A.dtype)
+        return self.outscale * jnp.cos(A @ W.T + self.shifts(A.dtype)[None, :])
+
+    def _extra_params(self) -> dict[str, Any]:
+        return {"sequence": self._sequence.to_dict(), "skip": self._skip}
+
+    @classmethod
+    def _from_parts(cls, N, S, alloc, d):
+        seq = QMCSequence.from_dict(d["sequence"]) if "sequence" in d else None
+        return cls(N, S, alloc, sequence=seq, skip=int(d.get("skip", 0)),
+                   **cls._extra_kernel_params(d))
+
+    @staticmethod
+    def _extra_kernel_params(d) -> dict[str, Any]:
+        return {}
+
+
+@register
+class GaussianQRFT(QRFT):
+    """Gaussian kernel, normal inverse-CDF (ref: QRFT_data.hpp:107-180)."""
+
+    sketch_type = "GaussianQRFT"
+    _quantile = staticmethod(_normal_quantile)
+
+    def __init__(self, N, S, context, sigma: float = 1.0, sequence=None,
+                 skip: int = 0):
+        self._sigma = float(sigma)
+        super().__init__(N, S, context, sequence=sequence, skip=skip)
+
+    @property
+    def inscale(self) -> float:
+        return 1.0 / self._sigma
+
+    def _extra_params(self):
+        d = super()._extra_params()
+        d["sigma"] = self._sigma
+        return d
+
+    @staticmethod
+    def _extra_kernel_params(d):
+        return {"sigma": float(d.get("sigma", 1.0))}
+
+
+@register
+class LaplacianQRFT(QRFT):
+    """Laplacian kernel, Cauchy inverse-CDF (ref: QRFT_data.hpp:183-290)."""
+
+    sketch_type = "LaplacianQRFT"
+    _quantile = staticmethod(_cauchy_quantile)
+
+    def __init__(self, N, S, context, sigma: float = 1.0, sequence=None,
+                 skip: int = 0):
+        self._sigma = float(sigma)
+        super().__init__(N, S, context, sequence=sequence, skip=skip)
+
+    @property
+    def inscale(self) -> float:
+        return 1.0 / self._sigma
+
+    def _extra_params(self):
+        d = super()._extra_params()
+        d["sigma"] = self._sigma
+        return d
+
+    @staticmethod
+    def _extra_kernel_params(d):
+        return {"sigma": float(d.get("sigma", 1.0))}
+
+
+@register
+class ExpSemigroupQRLT(QRFT):
+    """Quasi-random Laplace features for the exponential semigroup kernel
+    (ref: sketch/QRLT_data.hpp:35-150): z(x) = sqrt(1/S)·exp(−(W x)),
+    W from the Levy quantile with inscale β²/2."""
+
+    sketch_type = "ExpSemigroupQRLT"
+    _quantile = staticmethod(_levy_quantile)
+
+    def __init__(self, N, S, context, beta: float = 1.0, sequence=None,
+                 skip: int = 0):
+        self._beta = float(beta)
+        super().__init__(N, S, context, sequence=sequence, skip=skip)
+
+    @property
+    def inscale(self) -> float:
+        return self._beta * self._beta / 2.0
+
+    @property
+    def outscale(self) -> float:
+        return math.sqrt(1.0 / self._S)
+
+    def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        W = self.w_matrix(A.dtype)
+        return self.outscale * jnp.exp(-(W @ A))
+
+    def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        W = self.w_matrix(A.dtype)
+        return self.outscale * jnp.exp(-(A @ W.T))
+
+    def _extra_params(self):
+        d = super()._extra_params()
+        d["beta"] = self._beta
+        return d
+
+    @staticmethod
+    def _extra_kernel_params(d):
+        return {"beta": float(d.get("beta", 1.0))}

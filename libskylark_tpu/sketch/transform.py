@@ -1,0 +1,173 @@
+"""Sketch transform protocol, dimension tags, and serialization registry.
+
+TPU-native analog of the reference's sketch architecture
+(ref: sketch/sketch_transform.hpp:60-92, sketch/sketch_transform_data.hpp:28-87,
+sketch/transforms.hpp:12-18, sketch/sketch_add.hpp:15-55).
+
+Where the reference pairs a matrix-type-agnostic ``X_data_t`` with per-layout
+``X_t<In,Out>`` apply engines, here a single transform object covers all
+layouts: the apply methods are pure jnp functions, so input sharding flows
+through and XLA inserts the collectives that Elemental's per-distribution
+specializations hand-coded. The type-erased ``boost::any`` dispatch layer
+(ref: sketch/sketch_transform.hpp:187-221) has no analog — Python is already
+dynamically typed.
+
+Dimension convention (ref: sketch/transforms.hpp:12-18):
+- ``COLUMNWISE``: sketch_of_A = S · A   (compresses the column dimension: A is N×m)
+- ``ROWWISE``:    sketch_of_A = A · Sᵀ  (compresses the row dimension: A is m×N)
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from typing import Any, Union
+
+import jax
+import jax.numpy as jnp
+
+from libskylark_tpu import __version__
+from libskylark_tpu.base import errors
+from libskylark_tpu.base.context import Allocation, Context
+
+
+class Dimension(enum.Enum):
+    COLUMNWISE = "columnwise"
+    ROWWISE = "rowwise"
+
+
+COLUMNWISE = Dimension.COLUMNWISE
+ROWWISE = Dimension.ROWWISE
+
+_REGISTRY: dict[str, type["SketchTransform"]] = {}
+
+
+def register(cls: type["SketchTransform"]) -> type["SketchTransform"]:
+    """Register a transform class for deserialization
+    (ref: sketch/sketch_add.hpp:15-55 from_ptree registry)."""
+    _REGISTRY[cls.sketch_type] = cls
+    return cls
+
+
+class SketchTransform:
+    """A sketching transform S: R^N -> R^S_dim.
+
+    Mathematical definition lives in the (seed, counter) allocation plus the
+    hyper-params — matrix-free and serializable, like the reference's
+    ``_data_t`` classes. Construction advances the context's counter
+    (ref: sketch/sketch_transform_data.hpp ``build``).
+    """
+
+    sketch_type = "SketchTransform"
+
+    def __init__(self, N: int, S: int, context: Union[Context, Allocation]):
+        if N <= 0 or S <= 0:
+            raise errors.InvalidParametersError(
+                f"sketch dims must be positive, got N={N}, S={S}"
+            )
+        self._N = int(N)
+        self._S = int(S)
+        if isinstance(context, Context):
+            self._alloc = context.allocate()
+        else:
+            self._alloc = context
+        self._build()
+
+    def _build(self) -> None:
+        """Derive any host-side sample arrays. Default: nothing."""
+
+    # -- structural queries (ref: sketch_transform.hpp getindim/getsketchdim) --
+
+    @property
+    def input_dim(self) -> int:
+        return self._N
+
+    @property
+    def sketch_dim(self) -> int:
+        return self._S
+
+    @property
+    def allocation(self) -> Allocation:
+        return self._alloc
+
+    def subkey(self, tag: int) -> jax.Array:
+        """Sub-stream key ``tag`` of this transform's allocation; the analog
+        of the reference's sequential counter advancement during build."""
+        return jax.random.fold_in(self._alloc.key, tag)
+
+    # -- apply --
+
+    def apply(self, A, dimension: Dimension = COLUMNWISE) -> jnp.ndarray:
+        """Apply the sketch (ref: sketch/sketch_transform.hpp:60-92).
+
+        COLUMNWISE: A is (N, m) -> (S, m).  ROWWISE: A is (m, N) -> (m, S).
+        Works on any jax.Array regardless of sharding; XLA handles the
+        distributed contraction.
+        """
+        A = jnp.asarray(A)
+        if A.ndim == 1:
+            A = A[:, None] if dimension == COLUMNWISE else A[None, :]
+        if dimension == COLUMNWISE:
+            if A.shape[0] != self._N:
+                raise errors.SketchError(
+                    f"columnwise apply expects A with {self._N} rows, got {A.shape}"
+                )
+            return self._apply_columnwise(A)
+        else:
+            if A.shape[1] != self._N:
+                raise errors.SketchError(
+                    f"rowwise apply expects A with {self._N} cols, got {A.shape}"
+                )
+            return self._apply_rowwise(A)
+
+    def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        raise errors.NotImplementedYetError(
+            f"{self.sketch_type}: columnwise apply not implemented"
+        )
+
+    def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        raise errors.NotImplementedYetError(
+            f"{self.sketch_type}: rowwise apply not implemented"
+        )
+
+    # -- serialization (ref: sketch_transform_data.hpp:64-71 add_common) --
+
+    def _extra_params(self) -> dict[str, Any]:
+        """Transform-specific hyper-params to serialize."""
+        return {}
+
+    def to_dict(self) -> dict[str, Any]:
+        d = {
+            "skylark_object_type": "sketch",
+            "sketch_type": self.sketch_type,
+            "skylark_version": __version__,
+            "N": self._N,
+            "S": self._S,
+            "creation_context": self._alloc.to_dict(),
+        }
+        d.update(self._extra_params())
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @classmethod
+    def _from_parts(
+        cls, N: int, S: int, alloc: Allocation, d: dict[str, Any]
+    ) -> "SketchTransform":
+        return cls(N, S, alloc)
+
+    def __repr__(self) -> str:
+        return f"{self.sketch_type}(N={self._N}, S={self._S})"
+
+
+def deserialize_sketch(obj: Union[str, dict[str, Any]]) -> SketchTransform:
+    """Reconstruct a transform from its JSON form
+    (ref: sketch/sketch_add.hpp from_ptree; python sketch.py deserialize_sketch:118)."""
+    d = json.loads(obj) if isinstance(obj, str) else obj
+    stype = d.get("sketch_type")
+    cls = _REGISTRY.get(stype)
+    if cls is None:
+        raise errors.SketchError(f"unknown sketch type {stype!r}")
+    alloc = Allocation.from_dict(d["creation_context"])
+    return cls._from_parts(int(d["N"]), int(d["S"]), alloc, d)

@@ -1,0 +1,51 @@
+"""Uniform sampling transform (UST): S = row-sampling operator.
+
+TPU-native analog of ref: sketch/UST_data.hpp:19-130, sketch/UST_Elemental.hpp.
+With replacement: S_dim independent uniform indices. Without replacement:
+the first S_dim entries of a random permutation of [0, N) — semantically
+matching the reference's inside-out Fisher-Yates (ref: UST_data.hpp:90-99),
+realized here with jax.random.permutation on a sub-stream key.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import jax.random as jr
+
+from libskylark_tpu.base import randgen
+from libskylark_tpu.sketch.transform import SketchTransform, register
+
+
+@register
+class UST(SketchTransform):
+    sketch_type = "UST"
+
+    def __init__(self, N, S, context, replace: bool = True):
+        self._replace = bool(replace)
+        super().__init__(N, S, context)
+
+    def sample_indices(self) -> jnp.ndarray:
+        if self._replace:
+            return randgen.stream_slice(
+                self.subkey(0),
+                randgen.UniformInt(0, self._N - 1),
+                0,
+                self._S,
+                dtype=jnp.int32,
+            )
+        return jr.permutation(self.subkey(1), self._N)[: self._S].astype(jnp.int32)
+
+    def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        return A[self.sample_indices(), :]
+
+    def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        return A[:, self.sample_indices()]
+
+    def _extra_params(self) -> dict[str, Any]:
+        return {"replace": self._replace}
+
+    @classmethod
+    def _from_parts(cls, N, S, alloc, d):
+        return cls(N, S, alloc, replace=bool(d.get("replace", True)))

@@ -1,0 +1,278 @@
+"""Tests for fast transforms: FUT (DCT/DHT/WHT), RFUT, FJLT, Fastfood, PPT, QRFT.
+
+Oracle patterns mirror the reference's unit tests (SURVEY.md §4): explicit
+dense operator equivalence, orthogonality, sharded-vs-local equality, kernel
+approximation, and serialization round-trips.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.fft as sfft
+import scipy.linalg
+
+from libskylark_tpu import Context
+from libskylark_tpu import parallel as par
+from libskylark_tpu import sketch as sk
+from libskylark_tpu.sketch import fut
+
+ATOL = 1e-3
+
+
+def _rand(m, n, seed=0):
+    return np.random.default_rng(seed).standard_normal((m, n)).astype(np.float32)
+
+
+class TestFUT:
+    def test_dct_matches_fftw_convention(self):
+        x = _rand(16, 4)
+        got = np.asarray(fut.dct(jnp.asarray(x)))
+        want = sfft.dct(x, type=2, axis=0)  # scipy default == FFTW REDFT10
+        np.testing.assert_allclose(got, want, atol=1e-3)
+
+    def test_dct_inverse_roundtrip(self):
+        """REDFT01(REDFT10(x)) == 2N·x (FFTW convention)."""
+        x = _rand(16, 4)
+        y = np.asarray(fut.idct(fut.dct(jnp.asarray(x))))
+        np.testing.assert_allclose(y, 2 * 16 * x, rtol=1e-4, atol=1e-3)
+
+    def test_dht_self_inverse(self):
+        x = _rand(16, 4)
+        y = np.asarray(fut.dht(fut.dht(jnp.asarray(x))))
+        np.testing.assert_allclose(y, 16 * x, rtol=1e-4, atol=1e-3)
+
+    def test_wht_matches_hadamard(self):
+        x = _rand(16, 4)
+        H = scipy.linalg.hadamard(16).astype(np.float32)
+        got = np.asarray(fut.wht(jnp.asarray(x)))
+        np.testing.assert_allclose(got, H @ x, atol=1e-3)
+
+    def test_wht_rejects_non_pow2(self):
+        with pytest.raises(ValueError, match="power-of-2"):
+            fut.wht(jnp.zeros((12, 2)))
+
+    @pytest.mark.parametrize("name,n", [("dct", 20), ("dht", 20), ("wht", 16)])
+    def test_scaled_fut_near_orthogonal(self, name, n):
+        """scale·F preserves norms approximately (exactly for WHT/DHT;
+        DCT-II's k=0 row is off by √2 — same as the reference's FFTW usage)."""
+        T = fut.make_fut(name, n)
+        F = np.asarray(T.apply(jnp.eye(n, dtype=jnp.float32))) * T.scale()
+        G = F @ F.T  # DCT-II basis is orthogonal across rows
+        if name in ("dht", "wht"):
+            np.testing.assert_allclose(G, np.eye(n), atol=1e-4)
+        else:
+            want = np.eye(n)
+            want[0, 0] = 2.0  # unnormalized DCT-II k=0 row is √2 heavy
+            np.testing.assert_allclose(G, want, atol=1e-4)
+
+
+class TestRFUTFJLT:
+    def test_rfut_explicit_operator(self):
+        """RFUT == scale·F·D as an explicit matrix."""
+        N, m = 32, 5
+        T = sk.RFUT(N, Context(seed=3), fut="dct")
+        D = np.asarray(T.diagonal())
+        F = sfft.dct(np.eye(N), type=2, axis=0)
+        S_explicit = (1.0 / np.sqrt(2 * N)) * F @ np.diag(D)
+        A = _rand(N, m)
+        got = np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+        np.testing.assert_allclose(got, S_explicit @ A, atol=ATOL, rtol=1e-4)
+
+    def test_rfut_preserves_norm(self):
+        N = 64
+        T = sk.RFUT(N, Context(seed=5), fut="wht")
+        A = _rand(N, 3)
+        out = np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+        np.testing.assert_allclose(
+            np.linalg.norm(out, axis=0), np.linalg.norm(A, axis=0), rtol=1e-4
+        )
+
+    def test_fjlt_explicit_operator(self):
+        N, S, m = 32, 8, 5
+        T = sk.FJLT(N, S, Context(seed=7))
+        D = np.asarray(T.diagonal())
+        R = np.asarray(T.sample_indices())
+        F = sfft.dct(np.eye(N), type=2, axis=0)
+        S_explicit = (
+            np.sqrt(N / S) * (1.0 / np.sqrt(2 * N)) * F[R, :] @ np.diag(D)
+        )
+        A = _rand(N, m)
+        got = np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+        np.testing.assert_allclose(got, S_explicit @ A, atol=ATOL, rtol=1e-4)
+        B = _rand(m, N)
+        got_r = np.asarray(T.apply(jnp.asarray(B), sk.ROWWISE))
+        np.testing.assert_allclose(got_r, B @ S_explicit.T, atol=ATOL, rtol=1e-4)
+
+    def test_fjlt_subspace_embedding(self):
+        eps = 0.5
+        n, d = 512, 8
+        R = 256
+        A = _rand(n, d, seed=9)
+        sv_a = np.linalg.svd(A, compute_uv=False)
+        ok = 0
+        for rep in range(5):
+            T = sk.FJLT(n, R, Context(seed=200 + rep))
+            SA = np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+            sv = np.linalg.svd(SA, compute_uv=False)
+            ok += int(((sv >= (1 - eps) * sv_a) & (sv <= (1 + eps) * sv_a)).all())
+        assert ok >= 4
+
+    def test_fjlt_sharded_oracle(self, mesh1d):
+        N, S, m = 128, 32, 8
+        A = _rand(N, m, seed=3)
+        T = sk.FJLT(N, S, Context(seed=11))
+        local = np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+        sharded = np.asarray(
+            T.apply(par.distribute(A, par.row_sharded(mesh1d)), sk.COLUMNWISE)
+        )
+        np.testing.assert_allclose(sharded, local, atol=1e-4, rtol=1e-4)
+
+
+class TestFastfood:
+    def test_shapes_and_range(self):
+        N, S, m = 24, 80, 6  # S > NB forces multiple blocks
+        T = sk.FastGaussianRFT(N, S, Context(seed=13), sigma=2.0)
+        A = _rand(N, m)
+        Z = np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+        assert Z.shape == (S, m)
+        assert (np.abs(Z) <= np.sqrt(2.0 / S) + 1e-6).all()
+
+    def test_wht_variant(self):
+        N, S, m = 24, 40, 4  # NB = 32 (next pow2)
+        T = sk.FastGaussianRFT(N, S, Context(seed=17), sigma=1.5, fut="wht")
+        Z = np.asarray(T.apply(jnp.asarray(_rand(N, m)), sk.COLUMNWISE))
+        assert Z.shape == (S, m) and np.isfinite(Z).all()
+
+    def test_kernel_approximation(self):
+        """Fastfood features approximate the Gaussian kernel — the defining
+        property (Le-Sarlos-Smola; ref: examples/random_features.cpp)."""
+        d, S, sigma = 16, 8192, 3.0
+        rng = np.random.default_rng(19)
+        X = rng.standard_normal((d, 5)).astype(np.float32)
+        T = sk.FastGaussianRFT(d, S, Context(seed=23), sigma=sigma, fut="wht")
+        Z = np.asarray(T.apply(jnp.asarray(X), sk.COLUMNWISE))
+        approx = Z.T @ Z
+        d2 = ((X[:, :, None] - X[:, None, :]) ** 2).sum(axis=0)
+        exact = np.exp(-d2 / (2 * sigma * sigma))
+        np.testing.assert_allclose(approx, exact, atol=0.12)
+
+    def test_kernel_approximation_nonpow2_wht(self):
+        """With WHT padding (NB=32 > N=24) the Sm normalization must use NB,
+        or the kernel bandwidth is biased by NB/N."""
+        d, S, sigma = 24, 8192, 3.0
+        rng = np.random.default_rng(21)
+        X = rng.standard_normal((d, 5)).astype(np.float32)
+        T = sk.FastGaussianRFT(d, S, Context(seed=25), sigma=sigma, fut="wht")
+        Z = np.asarray(T.apply(jnp.asarray(X), sk.COLUMNWISE))
+        d2 = ((X[:, :, None] - X[:, None, :]) ** 2).sum(axis=0)
+        exact = np.exp(-d2 / (2 * sigma * sigma))
+        np.testing.assert_allclose(Z.T @ Z, exact, atol=0.06)
+
+    def test_ppt_invalid_params(self):
+        with pytest.raises(Exception, match="q must be >= 1"):
+            sk.PPT(8, 16, Context(0), q=0)
+        with pytest.raises(Exception, match="nonnegative"):
+            sk.PPT(8, 16, Context(0), c=-1.0)
+
+    def test_matern_finite(self):
+        T = sk.FastMaternRFT(16, 48, Context(seed=29), nu=1.5, l=2.0)
+        Z = np.asarray(T.apply(jnp.asarray(_rand(16, 4)), sk.COLUMNWISE))
+        assert np.isfinite(Z).all()
+
+    def test_rowwise_equals_columnwise_transpose(self):
+        N, S, m = 16, 24, 5
+        T = sk.FastGaussianRFT(N, S, Context(seed=31), sigma=1.0)
+        A = _rand(m, N)
+        r = np.asarray(T.apply(jnp.asarray(A), sk.ROWWISE))
+        c = np.asarray(T.apply(jnp.asarray(A.T), sk.COLUMNWISE))
+        np.testing.assert_allclose(r, c.T, atol=1e-5)
+
+
+class TestPPT:
+    def test_polynomial_kernel_approximation(self):
+        """E[TS(x)ᵀTS(y)] = (γ·xᵀy + c)^q — TensorSketch's defining property
+        (Pham-Pagh; ref: sketch/PPT_Elemental.hpp)."""
+        d, S, q, c, gamma = 6, 4096, 2, 1.0, 0.5
+        rng = np.random.default_rng(37)
+        X = (rng.standard_normal((d, 4)) / np.sqrt(d)).astype(np.float32)
+        T = sk.PPT(d, S, Context(seed=41), q=q, c=c, gamma=gamma)
+        Z = np.asarray(T.apply(jnp.asarray(X), sk.COLUMNWISE))
+        approx = Z.T @ Z
+        exact = (gamma * (X.T @ X) + c) ** q
+        np.testing.assert_allclose(approx, exact, atol=0.15)
+
+    def test_homogeneity_constant_term(self):
+        """PPT of the zero vector must sketch the constant c^q."""
+        d, S, q, c = 5, 512, 3, 2.0
+        T = sk.PPT(d, S, Context(seed=43), q=q, c=c, gamma=1.0)
+        Z = np.asarray(T.apply(jnp.zeros((d, 1), jnp.float32), sk.COLUMNWISE))
+        np.testing.assert_allclose((Z**2).sum(), c**q, rtol=0.05)
+
+    def test_rowwise(self):
+        T = sk.PPT(8, 64, Context(seed=47))
+        A = _rand(3, 8)
+        out = np.asarray(T.apply(jnp.asarray(A), sk.ROWWISE))
+        assert out.shape == (3, 64)
+
+
+class TestQRFT:
+    def test_gaussian_qrft_kernel_approximation(self):
+        """QMC features converge to the Gaussian kernel like RFT but with a
+        deterministic sequence (ref: tests in python-skylark)."""
+        d, S, sigma = 6, 2048, 2.0
+        rng = np.random.default_rng(53)
+        X = rng.standard_normal((d, 5)).astype(np.float32)
+        T = sk.GaussianQRFT(d, S, Context(seed=59), sigma=sigma)
+        Z = np.asarray(T.apply(jnp.asarray(X), sk.COLUMNWISE))
+        approx = Z.T @ Z
+        d2 = ((X[:, :, None] - X[:, None, :]) ** 2).sum(axis=0)
+        exact = np.exp(-d2 / (2 * sigma * sigma))
+        np.testing.assert_allclose(approx, exact, atol=0.1)
+
+    def test_deterministic_given_skip(self):
+        """QRFT is a pure function of (sequence, skip) — context RNG unused."""
+        T1 = sk.GaussianQRFT(8, 32, Context(seed=1), sigma=1.0, skip=10)
+        T2 = sk.GaussianQRFT(8, 32, Context(seed=999), sigma=1.0, skip=10)
+        A = jnp.asarray(_rand(8, 3))
+        np.testing.assert_array_equal(
+            np.asarray(T1.apply(A, sk.COLUMNWISE)),
+            np.asarray(T2.apply(A, sk.COLUMNWISE)),
+        )
+
+    def test_laplacian_qrft_finite(self):
+        T = sk.LaplacianQRFT(8, 64, Context(seed=61), sigma=1.0)
+        Z = np.asarray(T.apply(jnp.asarray(_rand(8, 4)), sk.COLUMNWISE))
+        assert np.isfinite(Z).all()
+
+    def test_qrlt_nonnegative(self):
+        T = sk.ExpSemigroupQRLT(8, 64, Context(seed=67), beta=0.5)
+        X = np.abs(_rand(8, 4))
+        Z = np.asarray(T.apply(jnp.asarray(X), sk.COLUMNWISE))
+        assert (Z >= 0).all() and np.isfinite(Z).all()
+
+
+class TestSerializationFast:
+    @pytest.mark.parametrize(
+        "make",
+        [
+            lambda ctx: sk.FJLT(32, 8, ctx),
+            lambda ctx: sk.RFUT(32, ctx),
+            lambda ctx: sk.FastGaussianRFT(16, 40, ctx, sigma=1.5),
+            lambda ctx: sk.FastMaternRFT(16, 40, ctx, nu=1.2, l=0.7),
+            lambda ctx: sk.PPT(16, 32, ctx, q=2, c=0.5, gamma=2.0),
+            lambda ctx: sk.GaussianQRFT(16, 24, ctx, sigma=1.5, skip=5),
+            lambda ctx: sk.LaplacianQRFT(16, 24, ctx, sigma=0.5),
+            lambda ctx: sk.ExpSemigroupQRLT(16, 24, ctx, beta=0.3),
+        ],
+    )
+    def test_roundtrip_identical_apply(self, make):
+        T = make(Context(seed=71))
+        T2 = sk.deserialize_sketch(json.loads(T.to_json()))
+        N = T.input_dim
+        A = jnp.asarray(_rand(N, 4, seed=14))
+        np.testing.assert_array_equal(
+            np.asarray(T.apply(A, sk.COLUMNWISE)),
+            np.asarray(T2.apply(A, sk.COLUMNWISE)),
+        )
