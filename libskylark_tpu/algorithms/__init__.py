@@ -1,0 +1,42 @@
+"""Algorithms layer: Krylov solvers, prox operators, regression framework
+(SURVEY.md §2.3)."""
+
+from libskylark_tpu.algorithms import asynch, krylov, precond, prox, regression
+from libskylark_tpu.algorithms.krylov import KrylovParams, cg, chebyshev, flexible_cg, lsqr
+from libskylark_tpu.algorithms.precond import (
+    FunctionPrecond,
+    IdPrecond,
+    MatPrecond,
+    Precond,
+    TriInversePrecond,
+)
+from libskylark_tpu.algorithms.regression import (
+    AcceleratedParams,
+    RegressionProblem,
+    solve_l2_accelerated,
+    solve_l2_exact,
+    solve_l2_sketched,
+)
+
+__all__ = [
+    "asynch",
+    "krylov",
+    "precond",
+    "prox",
+    "regression",
+    "KrylovParams",
+    "cg",
+    "chebyshev",
+    "flexible_cg",
+    "lsqr",
+    "Precond",
+    "IdPrecond",
+    "MatPrecond",
+    "TriInversePrecond",
+    "FunctionPrecond",
+    "RegressionProblem",
+    "AcceleratedParams",
+    "solve_l2_exact",
+    "solve_l2_sketched",
+    "solve_l2_accelerated",
+]

@@ -1,0 +1,148 @@
+"""Randomized block solvers — the synchronous TPU analog of AsyRGS/AsyFCG.
+
+The reference's asynchronous solvers (ref: algorithms/asynch/AsyRGS.hpp:82,
+AsyFCG.hpp:8) exploit lock-free shared-memory updates (`#pragma omp atomic`)
+— a CPU-threading idiom with no TPU analog (SURVEY.md §2.9 P8 documents this
+divergence). The mathematical content — randomized (block) Gauss-Seidel
+sweeps on an SPD system, usable standalone or as a flexible-CG inner
+preconditioner — is preserved in a deterministic, jittable form: block order
+is drawn per sweep from a context key (replayable), and the sweep is a
+`lax.scan` over sequential block updates, each block solved exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import jax.random as jr
+from jax import lax
+
+from libskylark_tpu.algorithms import krylov
+from libskylark_tpu.base.context import Context
+from libskylark_tpu.base.params import Params
+
+
+@dataclasses.dataclass
+class RandBlockParams(Params):
+    """ref: algorithms/asynch/asy_iter_params.hpp:8-40 (sweeps_lim ~ sweeps
+    between convergence checks; syncs_lim ~ outer checks)."""
+
+    block_size: int = 64
+    sweeps: int = 4
+    tolerance: float = 1e-6
+    max_outer: int = 20
+
+
+class _BlockSystem:
+    """SPD system padded to uniform blocks (identity on padded rows), with a
+    single randomized-sweep primitive shared by the GS and FCG entry points."""
+
+    def __init__(self, A: jnp.ndarray, block_size: int):
+        A = jnp.asarray(A)
+        n = A.shape[0]
+        bs = min(block_size, n)
+        nblocks = -(-n // bs)
+        pad = nblocks * bs - n
+        if pad:
+            A_p = jnp.zeros((n + pad, n + pad), A.dtype)
+            A_p = (
+                A_p.at[:n, :n].set(A)
+                .at[jnp.arange(n, n + pad), jnp.arange(n, n + pad)].set(1.0)
+            )
+        else:
+            A_p = A
+        self.A_p = A_p
+        self.n, self.bs, self.nblocks, self.pad = n, bs, nblocks, pad
+        self.block_rows = jnp.arange(nblocks) * bs
+
+    def pad_cols(self, X: jnp.ndarray) -> jnp.ndarray:
+        if not self.pad:
+            return X
+        return jnp.concatenate(
+            [X, jnp.zeros((self.pad, X.shape[1]), X.dtype)], axis=0
+        )
+
+    def sweep(self, X: jnp.ndarray, B_p: jnp.ndarray, skey) -> jnp.ndarray:
+        """One randomized block Gauss-Seidel sweep over the padded system."""
+        order = jr.permutation(skey, self.nblocks)
+        A_p, bs = self.A_p, self.bs
+
+        def update(X, bidx):
+            rows = self.block_rows[bidx] + jnp.arange(bs)
+            A_J = A_p[rows, :]
+            A_JJ = A_p[rows[:, None], rows[None, :]]
+            resid = B_p[rows, :] - A_J @ X + A_JJ @ X[rows, :]
+            x_J = jnp.linalg.solve(A_JJ, resid)
+            return X.at[rows, :].set(x_J), None
+
+        X, _ = lax.scan(update, X, order)
+        return X
+
+
+def rand_block_gauss_seidel(
+    A: jnp.ndarray,
+    B: jnp.ndarray,
+    context: Context,
+    params: Optional[RandBlockParams] = None,
+    X0: Optional[jnp.ndarray] = None,
+):
+    """Randomized block Gauss-Seidel on SPD A (AsyRGS analog).
+
+    Per sweep: visit the blocks in a fresh random order; for each block J,
+    solve A[J,J]·x_J = b_J − A[J,:]·x + A[J,J]·x_J exactly. Returns
+    (X, sweeps_done).
+    """
+    params = params or RandBlockParams()
+    B = jnp.asarray(B)
+    squeeze = B.ndim == 1
+    if squeeze:
+        B = B[:, None]
+    n, k = B.shape
+    sys = _BlockSystem(A, params.block_size)
+    key = context.allocate().key
+
+    B_p = sys.pad_cols(B)
+    X = sys.pad_cols(
+        jnp.zeros((n, k), B.dtype) if X0 is None else jnp.asarray(X0).reshape(n, k)
+    )
+    nrm_b = jnp.maximum(jnp.linalg.norm(B_p), jnp.finfo(B.dtype).eps)
+
+    sweeps_done = 0
+    for _outer in range(params.max_outer):
+        for _s in range(params.sweeps):
+            X = sys.sweep(X, B_p, jr.fold_in(key, sweeps_done))
+            sweeps_done += 1
+        res = jnp.linalg.norm(B_p - sys.A_p @ X) / nrm_b
+        if float(res) <= params.tolerance:
+            break
+
+    X = X[:n, :]
+    return (X[:, 0] if squeeze else X), sweeps_done
+
+
+def rand_block_fcg(
+    A: jnp.ndarray,
+    B: jnp.ndarray,
+    context: Context,
+    params: Optional[RandBlockParams] = None,
+    krylov_params: Optional[krylov.KrylovParams] = None,
+):
+    """Flexible CG with one randomized block Gauss-Seidel sweep as the
+    (varying) inner preconditioner — the AsyFCG analog
+    (ref: algorithms/asynch/AsyFCG.hpp:8). The padded system is built once;
+    inside the flexible-CG trace it is a loop-invariant constant."""
+    params = params or RandBlockParams()
+    A = jnp.asarray(A)
+    sys = _BlockSystem(A, params.block_size)
+    key = context.allocate().key
+    n = sys.n
+
+    def apply_gs(R, it):
+        Rp = sys.pad_cols(R)
+        Z = jnp.zeros_like(Rp)
+        Z = sys.sweep(Z, Rp, jr.fold_in(key, it))
+        return Z[:n, :]
+
+    return krylov.flexible_cg(A, B, params=krylov_params, precond=apply_gs)

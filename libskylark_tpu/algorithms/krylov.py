@@ -1,0 +1,305 @@
+"""Krylov solvers: LSQR, CG, FlexibleCG, Chebyshev semi-iteration.
+
+TPU-native analog of ref: algorithms/Krylov/{LSQR,CG,FlexibleCG,Chebyshev}.hpp.
+All solvers are jittable: the iteration is a ``lax.while_loop`` whose carry
+holds the Krylov vectors plus per-column scalar recurrences as (k,) arrays —
+the TPU form of the reference's "replicated scalars" pattern
+(ref: algorithms/Krylov/internal.hpp:13-39, where scalar containers are
+[STAR,STAR] so every rank steps the recurrence identically). Under a sharded
+operator the matvecs carry the collectives; the scalar math is replicated.
+
+Operators are either jnp matrices or (matvec, rmatvec) callables, so the same
+code serves dense sharded arrays, sparse containers, and implicit operators
+(e.g. Gram matrices, SMW-preconditioned systems).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import lax
+
+from libskylark_tpu.algorithms.precond import IdPrecond, Precond
+from libskylark_tpu.base.params import Params
+
+Operator = Union[jnp.ndarray, Tuple[Callable, Callable]]
+
+
+@dataclasses.dataclass
+class KrylovParams(Params):
+    """ref: algorithms/Krylov/krylov_iter_params.hpp:8."""
+
+    tolerance: float = 1e-6
+    iter_lim: int = -1
+
+
+def _as_ops(A: Operator):
+    if isinstance(A, tuple):
+        return A
+    M = jnp.asarray(A)
+    return (lambda x: M @ x), (lambda x: M.T @ x)
+
+
+def _colnorms(X):
+    return jnp.sqrt(jnp.sum(X * X, axis=0))
+
+
+def lsqr(
+    A: Operator,
+    B: jnp.ndarray,
+    params: Optional[KrylovParams] = None,
+    precond: Optional[Precond] = None,
+    shape: Optional[Tuple[int, int]] = None,
+):
+    """Paige-Saunders LSQR for min ‖A·X − B‖ with optional right
+    preconditioner R (ref: algorithms/Krylov/LSQR.hpp:21-299): the iteration
+    runs on A·R and the solution accumulates in the original space via
+    Z = R·V, exactly as the reference threads ``R.apply``/``apply_adjoint``.
+
+    Returns (X, iterations). B may have k columns; each column has its own
+    scalar recurrence and stopping state.
+    """
+    params = params or KrylovParams()
+    mv, rmv = _as_ops(A)
+    R = precond or IdPrecond()
+    B = jnp.asarray(B)
+    squeeze = B.ndim == 1
+    if squeeze:
+        B = B[:, None]
+    if shape is None:
+        if isinstance(A, tuple):
+            raise ValueError("shape=(m, n) required for operator-pair A")
+        shape = jnp.asarray(A).shape
+    m, n = shape
+    k = B.shape[1]
+    dt = B.dtype
+
+    eps = 32 * jnp.finfo(dt).eps
+    tol = min(max(params.tolerance, float(eps)), 1.0 - float(eps))
+    iter_lim = params.iter_lim if params.iter_lim > 0 else max(20, 2 * min(m, n))
+
+    beta = _colnorms(B)
+    U = B / jnp.maximum(beta, eps)[None, :]
+    V = R.apply_adjoint(rmv(U))
+    alpha = _colnorms(V)
+    V = V / jnp.maximum(alpha, eps)[None, :]
+    Z = R.apply(V)
+    W = Z
+    X = jnp.zeros((n, k), dt)
+    nrm_ar_0 = alpha * beta
+
+    state = dict(
+        X=X, U=U, V=V, Z=Z, W=W,
+        alpha=alpha, beta=beta,
+        phibar=beta, rhobar=alpha,
+        nrm_a=jnp.zeros((k,), dt),
+        nrm_r=beta,
+        done=(nrm_ar_0 == 0),
+        it=jnp.int32(0),
+    )
+
+    def cond(s):
+        return (s["it"] < iter_lim) & (~jnp.all(s["done"]))
+
+    def body(s):
+        # Bidiagonalization step (ref: LSQR.hpp:114-135)
+        U = mv(s["Z"]) - s["alpha"][None, :] * s["U"]
+        beta = _colnorms(U)
+        U = U / jnp.maximum(beta, eps)[None, :]
+        V = R.apply_adjoint(rmv(U)) - beta[None, :] * s["V"]
+        alpha = _colnorms(V)
+        V = V / jnp.maximum(alpha, eps)[None, :]
+        Z = R.apply(V)
+
+        nrm_a = jnp.sqrt(s["nrm_a"] ** 2 + s["alpha"] ** 2 + beta**2)
+
+        # Givens rotation (ref: LSQR.hpp:150-170)
+        rho = jnp.sqrt(s["rhobar"] ** 2 + beta**2)
+        cs = s["rhobar"] / rho
+        sn = beta / rho
+        theta = sn * alpha
+        rhobar = -cs * alpha
+        phi = cs * s["phibar"]
+        phibar = sn * s["phibar"]
+
+        step = (phi / rho)[None, :] * s["W"]
+        X = jnp.where(s["done"][None, :], s["X"], s["X"] + step)
+        W = Z - (theta / rho)[None, :] * s["W"]
+
+        nrm_r = phibar
+        nrm_ar = phibar * alpha * jnp.abs(cs)
+        done = s["done"] | (nrm_ar <= tol * jnp.maximum(nrm_a * nrm_r, eps)) | (
+            nrm_ar <= tol * nrm_ar_0
+        )
+        return dict(
+            X=X, U=U, V=V, Z=Z, W=W, alpha=alpha, beta=beta,
+            phibar=phibar, rhobar=rhobar, nrm_a=nrm_a, nrm_r=nrm_r,
+            done=done, it=s["it"] + 1,
+        )
+
+    out = lax.while_loop(cond, body, state)
+    X = out["X"][:, 0] if squeeze else out["X"]
+    return X, out["it"]
+
+
+def cg(
+    A: Operator,
+    B: jnp.ndarray,
+    params: Optional[KrylovParams] = None,
+    precond: Optional[Precond] = None,
+    X0: Optional[jnp.ndarray] = None,
+    shape: Optional[Tuple[int, int]] = None,
+):
+    """Preconditioned conjugate gradient for SPD A
+    (ref: algorithms/Krylov/CG.hpp:23). Returns (X, iterations)."""
+    params = params or KrylovParams()
+    mv, _ = _as_ops(A)
+    M = precond or IdPrecond()
+    B = jnp.asarray(B)
+    squeeze = B.ndim == 1
+    if squeeze:
+        B = B[:, None]
+    n, k = B.shape
+    dt = B.dtype
+    eps = jnp.finfo(dt).eps
+    iter_lim = params.iter_lim if params.iter_lim > 0 else max(20, 2 * n)
+    tol = params.tolerance
+
+    X = jnp.zeros_like(B) if X0 is None else jnp.asarray(X0).reshape(n, k)
+    Rr = B - mv(X)
+    Zz = M.apply(Rr)
+    P = Zz
+    rz = jnp.sum(Rr * Zz, axis=0)
+    nrm_b = jnp.maximum(_colnorms(B), eps)
+
+    state = dict(X=X, R=Rr, P=P, rz=rz, it=jnp.int32(0),
+                 done=(_colnorms(Rr) <= tol * nrm_b))
+
+    def cond(s):
+        return (s["it"] < iter_lim) & (~jnp.all(s["done"]))
+
+    def body(s):
+        AP = mv(s["P"])
+        pap = jnp.sum(s["P"] * AP, axis=0)
+        alpha = s["rz"] / jnp.where(pap == 0, 1.0, pap)
+        alpha = jnp.where(s["done"], 0.0, alpha)
+        X = s["X"] + alpha[None, :] * s["P"]
+        Rr = s["R"] - alpha[None, :] * AP
+        Zz = M.apply(Rr)
+        rz_new = jnp.sum(Rr * Zz, axis=0)
+        beta = rz_new / jnp.where(s["rz"] == 0, 1.0, s["rz"])
+        P = Zz + beta[None, :] * s["P"]
+        done = s["done"] | (_colnorms(Rr) <= tol * nrm_b)
+        return dict(X=X, R=Rr, P=P, rz=rz_new, it=s["it"] + 1, done=done)
+
+    out = lax.while_loop(cond, body, state)
+    X = out["X"][:, 0] if squeeze else out["X"]
+    return X, out["it"]
+
+
+def flexible_cg(
+    A: Operator,
+    B: jnp.ndarray,
+    params: Optional[KrylovParams] = None,
+    precond: Optional[Precond] = None,
+    X0: Optional[jnp.ndarray] = None,
+):
+    """Flexible CG (Polak-Ribiere beta) tolerating a varying preconditioner
+    (ref: algorithms/Krylov/FlexibleCG.hpp:23). The preconditioner may be a
+    ``Precond`` or a callable ``(R, it) -> Z`` (inner iterative solves)."""
+    params = params or KrylovParams()
+    mv, _ = _as_ops(A)
+    B = jnp.asarray(B)
+    squeeze = B.ndim == 1
+    if squeeze:
+        B = B[:, None]
+    n, k = B.shape
+    dt = B.dtype
+    eps = jnp.finfo(dt).eps
+    iter_lim = params.iter_lim if params.iter_lim > 0 else max(20, 2 * n)
+    tol = params.tolerance
+
+    if precond is None:
+        apply_m = lambda Rr, it: Rr
+    elif isinstance(precond, Precond):
+        apply_m = lambda Rr, it: precond.apply(Rr)
+    else:
+        apply_m = precond
+
+    X = jnp.zeros_like(B) if X0 is None else jnp.asarray(X0).reshape(n, k)
+    Rr = B - mv(X)
+    nrm_b = jnp.maximum(_colnorms(B), eps)
+    Z = apply_m(Rr, jnp.int32(0))
+    P = Z
+
+    state = dict(X=X, R=Rr, P=P, Zprev=Z, it=jnp.int32(0),
+                 done=(_colnorms(Rr) <= tol * nrm_b))
+
+    def cond(s):
+        return (s["it"] < iter_lim) & (~jnp.all(s["done"]))
+
+    def body(s):
+        AP = mv(s["P"])
+        pap = jnp.sum(s["P"] * AP, axis=0)
+        rz = jnp.sum(s["R"] * s["Zprev"], axis=0)
+        alpha = rz / jnp.where(pap == 0, 1.0, pap)
+        alpha = jnp.where(s["done"], 0.0, alpha)
+        X = s["X"] + alpha[None, :] * s["P"]
+        Rn = s["R"] - alpha[None, :] * AP
+        Zn = apply_m(Rn, s["it"] + 1)
+        # Polak-Ribiere: beta = z_new·(r_new − r_old) / z_old·r_old
+        num = jnp.sum(Zn * (Rn - s["R"]), axis=0)
+        beta = num / jnp.where(rz == 0, 1.0, rz)
+        P = Zn + beta[None, :] * s["P"]
+        done = s["done"] | (_colnorms(Rn) <= tol * nrm_b)
+        return dict(X=X, R=Rn, P=P, Zprev=Zn, it=s["it"] + 1, done=done)
+
+    out = lax.while_loop(cond, body, state)
+    X = out["X"][:, 0] if squeeze else out["X"]
+    return X, out["it"]
+
+
+def chebyshev(
+    A: Operator,
+    B: jnp.ndarray,
+    lambda_min: float,
+    lambda_max: float,
+    params: Optional[KrylovParams] = None,
+    precond: Optional[Precond] = None,
+    X0: Optional[jnp.ndarray] = None,
+):
+    """Chebyshev semi-iteration for SPD A with spectrum in
+    [lambda_min, lambda_max] (ref: algorithms/Krylov/Chebyshev.hpp:18).
+    Matvec-only inner loop — no inner products, hence no collectives beyond
+    the operator itself: the communication-optimal choice on a mesh."""
+    params = params or KrylovParams()
+    mv, _ = _as_ops(A)
+    M = precond or IdPrecond()
+    B = jnp.asarray(B)
+    squeeze = B.ndim == 1
+    if squeeze:
+        B = B[:, None]
+    dt = B.dtype
+    iter_lim = params.iter_lim if params.iter_lim > 0 else 50
+
+    d = (lambda_max + lambda_min) / 2.0
+    c = (lambda_max - lambda_min) / 2.0
+    X = jnp.zeros_like(B) if X0 is None else jnp.asarray(X0).reshape(B.shape)
+
+    def body(i, carry):
+        X, P, alpha_prev = carry
+        Rr = B - mv(X)
+        Z = M.apply(Rr)
+        beta = jnp.where(i == 0, 0.0,
+                         jnp.where(i == 1, 0.5 * (c * alpha_prev) ** 2,
+                                   (c * alpha_prev / 2.0) ** 2))
+        alpha = jnp.where(i == 0, 1.0 / d, 1.0 / (d - beta / alpha_prev))
+        P = Z + beta * P
+        X = X + alpha * P
+        return (X, P, alpha)
+
+    X, _, _ = lax.fori_loop(0, iter_lim, body,
+                            (X, jnp.zeros_like(B), jnp.asarray(1.0, dt)))
+    return (X[:, 0] if squeeze else X), jnp.int32(iter_lim)

@@ -1,0 +1,73 @@
+"""Preconditioner protocol for Krylov solvers.
+
+TPU-native analog of ref: algorithms/Krylov/precond.hpp:14-120 — identity,
+matrix-multiply, and triangular-inverse preconditioners. The reference's
+inplace/outplace split disappears (jax arrays are immutable); a preconditioner
+is an object with ``apply`` and ``apply_adjoint`` acting on (n, k) blocks.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+
+
+class Precond:
+    def apply(self, X: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def apply_adjoint(self, X: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+
+class IdPrecond(Precond):
+    """Identity (ref: precond.hpp:14-31)."""
+
+    def apply(self, X):
+        return X
+
+    def apply_adjoint(self, X):
+        return X
+
+
+class MatPrecond(Precond):
+    """Multiply by a fixed matrix M (ref: precond.hpp mat_precond_t)."""
+
+    def __init__(self, M: jnp.ndarray):
+        self.M = jnp.asarray(M)
+
+    def apply(self, X):
+        return self.M @ X
+
+    def apply_adjoint(self, X):
+        return self.M.T @ X
+
+
+class TriInversePrecond(Precond):
+    """Apply R⁻¹ for a triangular R via trsm (ref: precond.hpp
+    tri_inverse_precond_t) — the Blendenpik right-preconditioner."""
+
+    def __init__(self, R: jnp.ndarray, lower: bool = False):
+        self.R = jnp.asarray(R)
+        self.lower = lower
+
+    def apply(self, X):
+        return jsl.solve_triangular(self.R, X, lower=self.lower)
+
+    def apply_adjoint(self, X):
+        return jsl.solve_triangular(self.R, X, lower=self.lower, trans="T")
+
+
+class FunctionPrecond(Precond):
+    """Arbitrary callable pair — used by e.g. the random-features KRR
+    preconditioner (ml/krr.hpp:310-398 analog)."""
+
+    def __init__(self, fn, fn_adjoint=None):
+        self._fn = fn
+        self._fn_adj = fn_adjoint or fn
+
+    def apply(self, X):
+        return self._fn(X)
+
+    def apply_adjoint(self, X):
+        return self._fn_adj(X)

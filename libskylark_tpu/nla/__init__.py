@@ -1,0 +1,32 @@
+"""NLA layer: randomized SVD, least squares, condition estimation, spectral
+helpers (SURVEY.md §2.4)."""
+
+from libskylark_tpu.nla import condest, least_squares, spectral, svd
+from libskylark_tpu.nla.condest import condest as estimate_condition
+from libskylark_tpu.nla.least_squares import (
+    approximate_least_squares,
+    fast_least_squares,
+)
+from libskylark_tpu.nla.spectral import chebyshev_diff_matrix, chebyshev_points
+from libskylark_tpu.nla.svd import (
+    ApproximateSVDParams,
+    approximate_svd,
+    approximate_symmetric_svd,
+    power_iteration,
+)
+
+__all__ = [
+    "condest",
+    "least_squares",
+    "spectral",
+    "svd",
+    "estimate_condition",
+    "approximate_least_squares",
+    "fast_least_squares",
+    "chebyshev_points",
+    "chebyshev_diff_matrix",
+    "ApproximateSVDParams",
+    "approximate_svd",
+    "approximate_symmetric_svd",
+    "power_iteration",
+]

@@ -1,0 +1,50 @@
+"""High-level least squares: ApproximateLeastSquares and FastLeastSquares.
+
+TPU-native analog of ref: nla/least_squares.hpp:41-241.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from libskylark_tpu.algorithms import regression
+from libskylark_tpu.base.context import Context
+
+
+def approximate_least_squares(
+    A: jnp.ndarray,
+    B: jnp.ndarray,
+    context: Context,
+    sketch_size: Optional[int] = None,
+    sketch: str = "fjlt",
+):
+    """Sketch-and-solve least squares (Drineas et al.); default sketch size
+    4×Width(A) with an FJLT (ref: nla/least_squares.hpp:41-83)."""
+    from libskylark_tpu import sketch as sk
+
+    A = jnp.asarray(A)
+    m, n = A.shape
+    s = int(sketch_size) if sketch_size else 4 * n
+    s = min(max(s, n + 1), m)
+    if sketch == "fjlt":
+        T = sk.FJLT(m, s, context)
+    elif sketch == "cwt":
+        T = sk.CWT(m, s, context)
+    else:
+        T = sk.JLT(m, s, context)
+    return regression.solve_l2_sketched(A, B, T)
+
+
+def fast_least_squares(
+    A: jnp.ndarray,
+    B: jnp.ndarray,
+    context: Context,
+    params: Optional[regression.AcceleratedParams] = None,
+):
+    """Accurate sketch-preconditioned solve — Blendenpik with condition
+    fallback (ref: nla/least_squares.hpp:216-236). Returns (X, lsqr_iters)."""
+    return regression.solve_l2_accelerated(
+        A, B, context, method="blendenpik", params=params
+    )

@@ -1,0 +1,271 @@
+"""Tests for the algorithms layer: Krylov, prox, regression solvers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libskylark_tpu import Context
+from libskylark_tpu import algorithms as alg
+from libskylark_tpu.algorithms import prox
+
+
+def _lstsq_problem(m, n, k=1, seed=0, cond=10.0):
+    rng = np.random.default_rng(seed)
+    U, _ = np.linalg.qr(rng.standard_normal((m, n)))
+    V, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    s = np.logspace(0, -np.log10(cond), n)
+    A = (U * s) @ V.T
+    X = rng.standard_normal((n, k))
+    B = A @ X + 0.01 * rng.standard_normal((m, k))
+    return (A.astype(np.float32), B.astype(np.float32))
+
+
+class TestLSQR:
+    def test_matches_lstsq(self):
+        A, B = _lstsq_problem(120, 20)
+        x_np = np.linalg.lstsq(A, B, rcond=None)[0]
+        x, it = alg.lsqr(jnp.asarray(A), jnp.asarray(B),
+                         alg.KrylovParams(tolerance=1e-7, iter_lim=500))
+        assert int(it) > 0
+        np.testing.assert_allclose(np.asarray(x), x_np, atol=2e-3)
+
+    def test_multiple_rhs(self):
+        A, B = _lstsq_problem(100, 15, k=4, seed=1)
+        x_np = np.linalg.lstsq(A, B, rcond=None)[0]
+        x, _ = alg.lsqr(jnp.asarray(A), jnp.asarray(B),
+                        alg.KrylovParams(tolerance=1e-7, iter_lim=500))
+        np.testing.assert_allclose(np.asarray(x), x_np, atol=2e-3)
+
+    def test_preconditioned_converges_fast(self):
+        """With R from QR(A) as right precond, LSQR must converge in a
+        handful of iterations — the Blendenpik principle."""
+        A, B = _lstsq_problem(200, 30, seed=2, cond=1e4)
+        R = np.linalg.qr(A, mode="r")
+        x_pre, it_pre = alg.lsqr(
+            jnp.asarray(A), jnp.asarray(B),
+            alg.KrylovParams(tolerance=1e-9, iter_lim=200),
+            precond=alg.TriInversePrecond(jnp.asarray(R)),
+        )
+        _, it_plain = alg.lsqr(jnp.asarray(A), jnp.asarray(B),
+                               alg.KrylovParams(tolerance=1e-9, iter_lim=200))
+        assert int(it_pre) <= 5
+        assert int(it_pre) < int(it_plain)
+        # At cond=1e4 in f32, coefficients are ill-determined; judge by
+        # residual optimality instead.
+        x_np = np.linalg.lstsq(A, B, rcond=None)[0]
+        res_opt = np.linalg.norm(A @ x_np - B)
+        res_pre = np.linalg.norm(A @ np.asarray(x_pre) - B)
+        assert res_pre <= res_opt * 1.001 + 1e-6
+
+    def test_operator_pair(self):
+        A, B = _lstsq_problem(80, 10, seed=3)
+        Aj = jnp.asarray(A)
+        ops = ((lambda x: Aj @ x), (lambda x: Aj.T @ x))
+        x, _ = alg.lsqr(ops, jnp.asarray(B),
+                        alg.KrylovParams(tolerance=1e-7, iter_lim=300),
+                        shape=A.shape)
+        x_np = np.linalg.lstsq(A, B, rcond=None)[0]
+        np.testing.assert_allclose(np.asarray(x), x_np, atol=2e-3)
+
+    def test_jittable(self):
+        A, B = _lstsq_problem(60, 8, seed=4)
+
+        @jax.jit
+        def solve(Aj, Bj):
+            x, it = alg.lsqr(Aj, Bj, alg.KrylovParams(tolerance=1e-6, iter_lim=100))
+            return x
+
+        x = solve(jnp.asarray(A), jnp.asarray(B))
+        x_np = np.linalg.lstsq(A, B, rcond=None)[0]
+        np.testing.assert_allclose(np.asarray(x), x_np, atol=2e-3)
+
+
+def _spd_problem(n, k=1, seed=0, cond=100.0):
+    rng = np.random.default_rng(seed)
+    Q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    s = np.logspace(0, -np.log10(cond), n)
+    A = (Q * s) @ Q.T
+    B = rng.standard_normal((n, k))
+    return A.astype(np.float32), B.astype(np.float32)
+
+
+class TestCG:
+    def test_spd_solve(self):
+        A, B = _spd_problem(50, k=2)
+        x, it = alg.cg(jnp.asarray(A), jnp.asarray(B),
+                       alg.KrylovParams(tolerance=1e-8, iter_lim=500))
+        np.testing.assert_allclose(np.asarray(x), np.linalg.solve(A, B),
+                                   atol=2e-3)
+
+    def test_preconditioned(self):
+        A, B = _spd_problem(60, seed=1, cond=1e4)
+        Minv = np.linalg.inv(A + 0.01 * np.eye(60)).astype(np.float32)
+        x_pre, it_pre = alg.cg(jnp.asarray(A), jnp.asarray(B),
+                               alg.KrylovParams(tolerance=1e-8, iter_lim=300),
+                               precond=alg.MatPrecond(jnp.asarray(Minv)))
+        _, it_plain = alg.cg(jnp.asarray(A), jnp.asarray(B),
+                             alg.KrylovParams(tolerance=1e-8, iter_lim=300))
+        assert int(it_pre) < int(it_plain)
+
+    def test_flexible_cg(self):
+        A, B = _spd_problem(40, seed=2)
+        x, _ = alg.flexible_cg(jnp.asarray(A), jnp.asarray(B),
+                               alg.KrylovParams(tolerance=1e-8, iter_lim=300))
+        np.testing.assert_allclose(np.asarray(x), np.linalg.solve(A, B),
+                                   atol=2e-3)
+
+    def test_chebyshev(self):
+        A, B = _spd_problem(40, seed=3, cond=50.0)
+        ev = np.linalg.eigvalsh(A)
+        x, _ = alg.chebyshev(jnp.asarray(A), jnp.asarray(B),
+                             float(ev[0] * 0.9), float(ev[-1] * 1.1),
+                             alg.KrylovParams(iter_lim=120))
+        np.testing.assert_allclose(np.asarray(x), np.linalg.solve(A, B),
+                                   atol=5e-3)
+
+
+class TestRandBlock:
+    def test_gauss_seidel_converges(self):
+        A, B = _spd_problem(100, seed=5, cond=20.0)
+        x, sweeps = alg.asynch.rand_block_gauss_seidel(
+            jnp.asarray(A), jnp.asarray(B), Context(seed=7),
+            alg.asynch.RandBlockParams(block_size=32, sweeps=3, tolerance=1e-6,
+                                       max_outer=40),
+        )
+        np.testing.assert_allclose(np.asarray(x), np.linalg.solve(A, B),
+                                   atol=5e-3)
+
+    def test_fcg_with_gs_preconditioner(self):
+        A, B = _spd_problem(64, seed=6, cond=200.0)
+        x, it = alg.asynch.rand_block_fcg(
+            jnp.asarray(A), jnp.asarray(B), Context(seed=11),
+            alg.asynch.RandBlockParams(block_size=16),
+            alg.KrylovParams(tolerance=1e-8, iter_lim=200),
+        )
+        np.testing.assert_allclose(np.asarray(x), np.linalg.solve(A, B),
+                                   atol=5e-3)
+
+
+class TestExactSolvers:
+    @pytest.mark.parametrize("method", ["qr", "sne", "ne", "svd"])
+    def test_all_methods_agree(self, method):
+        A, B = _lstsq_problem(100, 12, k=3, seed=7)
+        x_np = np.linalg.lstsq(A, B, rcond=None)[0]
+        x = alg.solve_l2_exact(jnp.asarray(A), jnp.asarray(B), method=method)
+        np.testing.assert_allclose(np.asarray(x), x_np, atol=2e-3)
+
+    def test_unknown_method_raises(self):
+        with pytest.raises(Exception, match="unknown exact l2"):
+            alg.solve_l2_exact(jnp.eye(3), jnp.ones(3), method="nope")
+
+
+class TestSketchedSolver:
+    def test_residual_near_optimal(self):
+        """Sketch-and-solve residual ≤ (1+ε)·optimal (Drineas et al.; the
+        reference's ApproximateLeastSquares contract)."""
+        from libskylark_tpu import sketch as sk
+
+        A, B = _lstsq_problem(2000, 10, seed=8)
+        T = sk.CWT(2000, 400, Context(seed=13))
+        x = alg.solve_l2_sketched(jnp.asarray(A), jnp.asarray(B), T)
+        res_opt = np.linalg.norm(A @ np.linalg.lstsq(A, B, rcond=None)[0] - B)
+        res_sk = np.linalg.norm(A @ np.asarray(x) - B)
+        assert res_sk <= 1.5 * res_opt + 1e-6
+
+
+class TestAccelerated:
+    @pytest.mark.parametrize("method", ["blendenpik", "lsrn", "simplified_blendenpik"])
+    def test_solves_to_high_accuracy(self, method):
+        A, B = _lstsq_problem(1500, 25, seed=9, cond=1e3)
+        x, it = alg.solve_l2_accelerated(
+            jnp.asarray(A), jnp.asarray(B), Context(seed=17), method=method,
+        )
+        assert int(it) > 0, "should use LSQR path, not fallback"
+        x_np = np.linalg.lstsq(A, B, rcond=None)[0]
+        np.testing.assert_allclose(np.asarray(x), x_np, atol=5e-3)
+        # sketch-preconditioned LSQR should converge quickly
+        assert int(it) <= 60
+
+    def test_fallback_on_rank_deficiency(self):
+        rng = np.random.default_rng(10)
+        A = rng.standard_normal((300, 10)).astype(np.float32)
+        A[:, -1] = A[:, 0]  # exactly rank-deficient
+        B = rng.standard_normal((300, 1)).astype(np.float32)
+        x, it = alg.solve_l2_accelerated(
+            jnp.asarray(A), jnp.asarray(B), Context(seed=19), method="blendenpik",
+        )
+        assert int(it) == 0, "should fall back to exact SVD solver"
+        assert np.isfinite(np.asarray(x)).all()
+
+
+class TestProx:
+    def test_squared_loss(self):
+        O = jnp.asarray([[1.0, 2.0, -1.0]])
+        T = jnp.asarray([1.0, 0.0, 1.0])
+        assert float(prox.SquaredLoss().evaluate(O, T)) == pytest.approx(
+            0.5 * (0 + 4 + 4)
+        )
+        Y = prox.SquaredLoss().prox(O, 1.0, T)
+        np.testing.assert_allclose(np.asarray(Y), [[1.0, 1.0, 0.0]])
+
+    def test_lad_prox_properties(self):
+        X = jnp.asarray([[3.0, 0.5, -2.0]])
+        T = jnp.asarray([0.0, 0.0, 0.0])
+        Y = np.asarray(prox.LADLoss().prox(X, 1.0, T))
+        np.testing.assert_allclose(Y, [[2.0, 0.0, -1.0]])
+
+    def test_hinge_loss(self):
+        O = jnp.asarray([[0.5, 2.0, -1.0]])
+        T = jnp.asarray([1.0, 1.0, -1.0])
+        # losses: max(1-0.5,0)+max(1-2,0)+max(1-1,0) = 0.5
+        assert float(prox.HingeLoss().evaluate(O, T)) == pytest.approx(0.5)
+
+    def test_hinge_prox_piecewise(self):
+        lam = 0.5
+        X = jnp.asarray([[2.0, 0.9, -1.0]])
+        T = jnp.asarray([1.0, 1.0, 1.0])
+        Y = np.asarray(prox.HingeLoss().prox(X, lam, T))
+        # yv=2>1 -> keep; yv=0.9 in [1-lam,1] -> set to t=1; yv=-1<1-lam -> x+lam*t
+        np.testing.assert_allclose(Y, [[2.0, 1.0, -0.5]])
+
+    def test_logistic_prox_reduces_objective(self):
+        rng = np.random.default_rng(11)
+        k, n = 5, 12
+        X = jnp.asarray(rng.standard_normal((k, n)).astype(np.float32))
+        T = jnp.asarray(rng.integers(0, k, n))
+        lam = 0.7
+        L = prox.LogisticLoss()
+        Y = L.prox(X, lam, T)
+
+        def objective(Z):
+            return float(L.evaluate(Z, T)) + float(
+                jnp.sum((Z - X) ** 2)
+            ) / (2 * lam)
+
+        assert objective(Y) < objective(X) - 1e-3
+        # near-stationarity: gradient norm small
+        labels = np.asarray(T)
+        E = (np.arange(k)[:, None] == labels[None, :]).astype(np.float32)
+        P = np.asarray(jax.nn.softmax(Y, axis=0))
+        grad = P - E + (np.asarray(Y) - np.asarray(X)) / lam
+        assert np.abs(grad).max() < 0.05
+
+    def test_multiclass_expansion(self):
+        O = jnp.zeros((3, 2))
+        T = jnp.asarray([0, 2])
+        # squared loss vs one-vs-all ±1: each column has one (0-1)^2 and two (0+1)^2
+        assert float(prox.SquaredLoss().evaluate(O, T)) == pytest.approx(3.0)
+
+    def test_regularizers(self):
+        W = jnp.asarray([[2.0, -0.5], [0.1, -3.0]])
+        mu = jnp.zeros_like(W)
+        np.testing.assert_allclose(
+            np.asarray(prox.L2Regularizer().prox(W, 1.0, mu)), np.asarray(W) / 2
+        )
+        Y = np.asarray(prox.L1Regularizer().prox(W, 1.0, mu))
+        np.testing.assert_allclose(Y, [[1.0, 0.0], [0.0, -2.0]])
+        np.testing.assert_allclose(
+            np.asarray(prox.EmptyRegularizer().prox(W, 1.0, mu)), np.asarray(W)
+        )
+        assert float(prox.L1Regularizer().evaluate(W)) == pytest.approx(5.6)

@@ -1,0 +1,187 @@
+"""NLA-layer tests: approximate SVD (reconstruction oracle), least squares,
+condition estimation, spectral helpers.
+
+Mirrors the reference's SVD reconstruction checks
+(ref: tests/unit/test_utils.hpp:61-148, SVDElementalTest.cpp) and the
+regression-test spectral bounds.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libskylark_tpu import Context, nla
+from libskylark_tpu import parallel as par
+
+
+def _lowrank(m, n, r, seed=0, noise=0.0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((m, r)) @ rng.standard_normal((r, n))
+    if noise:
+        A = A + noise * rng.standard_normal((m, n))
+    return A.astype(dtype)
+
+
+class TestApproximateSVD:
+    def test_exact_rank_reconstruction(self):
+        """Rank-r matrix recovered to the reference's 1e-4-style tolerance."""
+        A = _lowrank(200, 80, 6, seed=1)
+        U, S, V = nla.approximate_svd(jnp.asarray(A), 6, Context(seed=3),
+                                      nla.ApproximateSVDParams(num_iterations=2))
+        recon = np.asarray(U) * np.asarray(S) @ np.asarray(V).T
+        err = np.linalg.norm(recon - A) / np.linalg.norm(A)
+        assert err < 1e-4
+
+    def test_wide_matrix_branch(self):
+        A = _lowrank(60, 300, 5, seed=2)
+        U, S, V = nla.approximate_svd(jnp.asarray(A), 5, Context(seed=5),
+                                      nla.ApproximateSVDParams(num_iterations=2))
+        assert U.shape == (60, 5) and V.shape == (300, 5)
+        recon = np.asarray(U) * np.asarray(S) @ np.asarray(V).T
+        assert np.linalg.norm(recon - A) / np.linalg.norm(A) < 1e-4
+
+    def test_singular_values_match_exact(self):
+        A = _lowrank(150, 100, 20, seed=3, noise=0.01)
+        sv_exact = np.linalg.svd(A, compute_uv=False)[:5]
+        _, S, _ = nla.approximate_svd(jnp.asarray(A), 5, Context(seed=7),
+                                      nla.ApproximateSVDParams(num_iterations=3))
+        np.testing.assert_allclose(np.asarray(S), sv_exact, rtol=0.05)
+
+    def test_orthonormal_factors(self):
+        A = _lowrank(100, 60, 8, seed=4, noise=0.05)
+        U, S, V = nla.approximate_svd(jnp.asarray(A), 8, Context(seed=11),
+                                      nla.ApproximateSVDParams(num_iterations=2))
+        np.testing.assert_allclose(np.asarray(U.T @ U), np.eye(8), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(V.T @ V), np.eye(8), atol=1e-4)
+        assert (np.diff(np.asarray(S)) <= 1e-6).all()  # descending
+
+    def test_power_iteration_improves_noisy(self):
+        A = _lowrank(300, 200, 10, seed=5, noise=0.5)
+        best = np.linalg.svd(A, compute_uv=False)
+        tail = np.sqrt((best[10:] ** 2).sum())
+
+        def err(q):
+            U, S, V = nla.approximate_svd(
+                jnp.asarray(A), 10, Context(seed=13),
+                nla.ApproximateSVDParams(num_iterations=q))
+            recon = np.asarray(U) * np.asarray(S) @ np.asarray(V).T
+            return np.linalg.norm(recon - A)
+
+        e0, e3 = err(0), err(3)
+        assert e3 <= e0 + 1e-5
+        assert e3 <= 1.05 * tail  # near-optimal with power iterations
+
+    def test_sharded_input(self, mesh1d):
+        A = _lowrank(256, 64, 4, seed=6)
+        A_sh = par.distribute(A, par.row_sharded(mesh1d))
+        U, S, V = nla.approximate_svd(A_sh, 4, Context(seed=17),
+                                      nla.ApproximateSVDParams(num_iterations=2))
+        recon = np.asarray(U) * np.asarray(S) @ np.asarray(V).T
+        assert np.linalg.norm(recon - A) / np.linalg.norm(A) < 1e-3
+
+    def test_jittable(self):
+        A = jnp.asarray(_lowrank(80, 40, 4, seed=7))
+        ctx = Context(seed=19)
+        # pre-allocate so the jitted fn closes over a fixed transform
+        f = jax.jit(lambda M: nla.approximate_svd(
+            M, 4, Context(seed=19), nla.ApproximateSVDParams(num_iterations=1)))
+        U, S, V = f(A)
+        recon = np.asarray(U) * np.asarray(S) @ np.asarray(V).T
+        assert np.linalg.norm(recon - np.asarray(A)) / np.linalg.norm(A) < 1e-3
+
+    def test_invalid_rank(self):
+        with pytest.raises(Exception, match="rank"):
+            nla.approximate_svd(jnp.eye(4), 0, Context(0))
+
+
+class TestSymmetricSVD:
+    def test_symmetric_reconstruction(self):
+        rng = np.random.default_rng(8)
+        Q, _ = np.linalg.qr(rng.standard_normal((80, 80)))
+        w = np.zeros(80)
+        w[:6] = [10, -8, 6, 4, -2, 1]
+        A = ((Q * w) @ Q.T).astype(np.float32)
+        V, S = nla.approximate_symmetric_svd(jnp.asarray(A), 6, Context(seed=23),
+                                             nla.ApproximateSVDParams(num_iterations=3))
+        recon = np.asarray(V) * np.asarray(S) @ np.asarray(V).T
+        assert np.linalg.norm(recon - A) / np.linalg.norm(A) < 1e-3
+        # eigenvalues with signs, sorted by magnitude
+        np.testing.assert_allclose(np.asarray(S), w[:6], rtol=1e-3, atol=1e-3)
+
+    def test_rejects_nonsquare(self):
+        with pytest.raises(Exception, match="square"):
+            nla.approximate_symmetric_svd(jnp.zeros((3, 4)), 2, Context(0))
+
+
+class TestLeastSquares:
+    def _problem(self, m=2000, n=12, seed=9):
+        rng = np.random.default_rng(seed)
+        A = rng.standard_normal((m, n)).astype(np.float32)
+        x = rng.standard_normal((n,)).astype(np.float32)
+        b = A @ x + 0.1 * rng.standard_normal(m).astype(np.float32)
+        return A, b
+
+    def test_approximate_ls_residual(self):
+        A, b = self._problem()
+        x = nla.approximate_least_squares(jnp.asarray(A), jnp.asarray(b),
+                                          Context(seed=29))
+        res_opt = np.linalg.norm(A @ np.linalg.lstsq(A, b, rcond=None)[0] - b)
+        res = np.linalg.norm(A @ np.asarray(x) - b)
+        assert res <= 1.5 * res_opt
+
+    def test_fast_ls_high_accuracy(self):
+        A, b = self._problem(seed=10)
+        x, it = nla.fast_least_squares(jnp.asarray(A), jnp.asarray(b),
+                                       Context(seed=31))
+        assert int(it) > 0
+        x_np = np.linalg.lstsq(A, b, rcond=None)[0]
+        res_opt = np.linalg.norm(A @ x_np - b)
+        res = np.linalg.norm(A @ np.asarray(x) - b)
+        assert res <= 1.0001 * res_opt
+
+
+class TestCondEst:
+    def test_estimates_condition(self):
+        rng = np.random.default_rng(11)
+        m, n, cond = 300, 40, 50.0
+        U, _ = np.linalg.qr(rng.standard_normal((m, n)))
+        V, _ = np.linalg.qr(rng.standard_normal((n, n)))
+        s = np.logspace(0, -np.log10(cond), n)
+        A = ((U * s) @ V.T).astype(np.float32)
+        est, smax, smin = nla.estimate_condition(jnp.asarray(A), Context(seed=37),
+                                                 max_iter=150)
+        assert smax == pytest.approx(1.0, rel=0.05)
+        assert est == pytest.approx(cond, rel=0.35)
+
+    def test_deterministic(self):
+        A = jnp.asarray(np.random.default_rng(12).standard_normal((50, 10)),
+                        dtype=jnp.float32)
+        e1 = nla.estimate_condition(A, Context(seed=41))
+        e2 = nla.estimate_condition(A, Context(seed=41))
+        assert e1 == e2
+
+
+class TestSpectral:
+    def test_chebyshev_points(self):
+        x = nla.chebyshev_points(5)
+        np.testing.assert_allclose(x, [1.0, np.sqrt(2) / 2, 0.0,
+                                       -np.sqrt(2) / 2, -1.0], atol=1e-12)
+
+    def test_chebyshev_points_general_interval(self):
+        x = nla.chebyshev_points(5, a=2.0, b=3.0)
+        assert x.max() == pytest.approx(3.0) and x.min() == pytest.approx(2.0)
+        assert x[2] == pytest.approx(2.5)  # midpoint snapped to center
+
+    def test_diff_matrix_differentiates_polynomials(self):
+        """D applied to values of p(x)=x³ must give 3x² exactly (degree < N)."""
+        D, x = nla.chebyshev_diff_matrix(8)
+        p = x**3
+        dp = D @ p
+        np.testing.assert_allclose(dp, 3 * x**2, atol=1e-10)
+
+    def test_diff_matrix_rescaled_interval(self):
+        D, x = nla.chebyshev_diff_matrix(10, a=0.0, b=2.0)
+        assert x.min() == pytest.approx(0.0) and x.max() == pytest.approx(2.0)
+        p = x**2
+        np.testing.assert_allclose(D @ p, 2 * x, atol=1e-9)
