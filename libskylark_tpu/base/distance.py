@@ -1,0 +1,50 @@
+"""Distance matrices — kernel Gram support.
+
+TPU-native analog of ref: base/distance.hpp:11-339. The reference computes
+C = −2·AᵀB then adds column-norm outer sums with hand-written loops (plus
+symmetric variants that fill only one triangle); here the whole thing is one
+fused XLA expression, and "symmetric" just means Y is X — on TPU there is no
+win in computing half a matrix, so the symmetric variants delegate.
+
+Convention: rows are points — ``X`` is (m, d), ``Y`` is (n, d), result is
+(m, n). (The reference's ``dir=COLUMNS`` form is this with transposed inputs.)
+Like the reference's ``EuclideanDistanceMatrix``, the Euclidean variant
+returns **squared** distances.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def euclidean_distance_matrix(X: jnp.ndarray, Y: jnp.ndarray) -> jnp.ndarray:
+    """Squared Euclidean distances D[i,j] = ‖xᵢ − yⱼ‖²
+    (ref: base/distance.hpp:11-36 — Gemm(−2·AᵀB) + norm outer sums)."""
+    X = jnp.asarray(X)
+    Y = jnp.asarray(Y)
+    nx = jnp.sum(X * X, axis=1)
+    ny = jnp.sum(Y * Y, axis=1)
+    D = nx[:, None] + ny[None, :] - 2.0 * (X @ Y.T)
+    return jnp.maximum(D, 0.0)
+
+
+def symmetric_euclidean_distance_matrix(X: jnp.ndarray) -> jnp.ndarray:
+    """Squared Euclidean distances among rows of X
+    (ref: base/distance.hpp:73-134 symmetric variant)."""
+    return euclidean_distance_matrix(X, X)
+
+
+def l1_distance_matrix(X: jnp.ndarray, Y: jnp.ndarray) -> jnp.ndarray:
+    """L1 distances D[i,j] = ‖xᵢ − yⱼ‖₁ (ref: base/distance.hpp:136-217).
+
+    O(m·n·d) with a broadcast — no inner-product shortcut exists for L1; the
+    reference's triple loop maps to one vectorized reduction.
+    """
+    X = jnp.asarray(X)
+    Y = jnp.asarray(Y)
+    return jnp.sum(jnp.abs(X[:, None, :] - Y[None, :, :]), axis=-1)
+
+
+def symmetric_l1_distance_matrix(X: jnp.ndarray) -> jnp.ndarray:
+    """L1 distances among rows of X (ref: base/distance.hpp:219-297)."""
+    return l1_distance_matrix(X, X)

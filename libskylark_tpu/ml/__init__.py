@@ -1,0 +1,66 @@
+"""ML layer: kernels, KRR/RLSC, ADMM kernel machines, models, graph
+algorithms (SURVEY.md §2.5)."""
+
+from libskylark_tpu.ml import coding, kernels, krr, rlsc
+from libskylark_tpu.ml.coding import dummy_coding, dummy_decode
+from libskylark_tpu.ml.kernels import (
+    ExpSemigroup,
+    Gaussian,
+    Kernel,
+    KERNELS,
+    Laplacian,
+    Linear,
+    Matern,
+    Polynomial,
+    deserialize_kernel,
+    make_kernel,
+)
+from libskylark_tpu.ml.krr import (
+    FeatureMapPrecond,
+    KrrParams,
+    approximate_kernel_ridge,
+    faster_kernel_ridge,
+    kernel_ridge,
+    large_scale_kernel_ridge,
+    sketched_approximate_kernel_ridge,
+)
+from libskylark_tpu.ml.rlsc import (
+    RlscParams,
+    approximate_kernel_rlsc,
+    faster_kernel_rlsc,
+    kernel_rlsc,
+    large_scale_kernel_rlsc,
+    sketched_approximate_kernel_rlsc,
+)
+
+__all__ = [
+    "coding",
+    "kernels",
+    "krr",
+    "rlsc",
+    "dummy_coding",
+    "dummy_decode",
+    "Kernel",
+    "KERNELS",
+    "Linear",
+    "Gaussian",
+    "Polynomial",
+    "Laplacian",
+    "ExpSemigroup",
+    "Matern",
+    "deserialize_kernel",
+    "make_kernel",
+    "KrrParams",
+    "FeatureMapPrecond",
+    "kernel_ridge",
+    "approximate_kernel_ridge",
+    "sketched_approximate_kernel_ridge",
+    "faster_kernel_ridge",
+    "large_scale_kernel_ridge",
+    "RlscParams",
+    "kernel_rlsc",
+    "approximate_kernel_rlsc",
+    "sketched_approximate_kernel_rlsc",
+    "faster_kernel_rlsc",
+    "large_scale_kernel_rlsc",
+]

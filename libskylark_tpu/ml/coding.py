@@ -1,0 +1,41 @@
+"""Label coding: one-vs-all ±1 dummy coding and argmax decoding.
+
+TPU-native analog of ref: ml/coding.hpp:7-146 (``DummyCoding`` /
+``DummyDecode``, local & distributed variants — here one jnp function covers
+every layout).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def dummy_coding(
+    labels, coding: Sequence = None, dtype=jnp.float32
+) -> Tuple[jnp.ndarray, list]:
+    """Labels (n,) → (n, k) matrix with +1 at the label's column, −1 elsewhere
+    (ref: ml/coding.hpp:7-63). Returns (Y, coding) where ``coding`` lists the
+    distinct label values in column order; pass it back in to reuse a coding
+    computed on training data.
+    """
+    labels = np.asarray(labels).reshape(-1)
+    if coding is None:
+        coding = sorted(set(labels.tolist()))
+    coding = list(coding)
+    index = {v: i for i, v in enumerate(coding)}
+    cols = np.array([index[v] for v in labels.tolist()], dtype=np.int32)
+    Y = jnp.where(
+        jnp.arange(len(coding))[None, :] == jnp.asarray(cols)[:, None], 1.0, -1.0
+    ).astype(dtype)
+    return Y, coding
+
+
+def dummy_decode(Y: jnp.ndarray, coding: Sequence) -> np.ndarray:
+    """(n, k) score matrix → (n,) labels by argmax over columns
+    (ref: ml/coding.hpp:65-120)."""
+    idx = np.asarray(jnp.argmax(jnp.asarray(Y), axis=1))
+    coding = np.asarray(coding)
+    return coding[idx]

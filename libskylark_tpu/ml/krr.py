@@ -1,0 +1,309 @@
+"""Kernel ridge regression — the 5-regime solver family.
+
+TPU-native analog of ref: ml/krr.hpp:6-690:
+
+=============================  ==============================================
+``kernel_ridge``               exact Gram + Cholesky solve (:47-90)
+``approximate_kernel_ridge``   random features + (optionally sketched) ridge
+                               regression (:92-196)
+``sketched_approximate_kernel_ridge``
+                               features computed in splits, each sketched
+                               down before the solve — memory-bounded
+                               (:197-309)
+``faster_kernel_ridge``        exact Gram solved by CG with a random-features
+                               preconditioner applied via Sherman-Morrison-
+                               Woodbury (:310-499)
+``large_scale_kernel_ridge``   block coordinate descent over split feature
+                               maps with cached Cholesky factors (:500-690)
+=============================  ==============================================
+
+Convention: rows are examples — X is (n, d), Y is (n, t); feature maps apply
+ROWWISE giving Z (n, s); W is (s, t); Gram coefficients A are (n, t). This is
+the reference's ``direction == base::ROWS`` orientation; the COLUMNS variant
+is a transpose away and not duplicated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+
+from libskylark_tpu.algorithms.krylov import KrylovParams, cg
+from libskylark_tpu.algorithms.precond import FunctionPrecond, IdPrecond
+from libskylark_tpu.base.context import Context
+from libskylark_tpu.base.params import Params
+from libskylark_tpu.ml.kernels import Kernel
+
+
+@dataclasses.dataclass
+class KrrParams(Params):
+    """ref: ml/krr.hpp:6-44 krr_params_t."""
+
+    use_fast: bool = False          # fast feature transforms (FJLT/Fastfood)
+    sketched_rr: bool = False       # sketch the regression problem
+    sketch_size: int = -1           # -1 -> 4*s
+    fast_sketch: bool = False       # CWT instead of FJLT for the sketch
+    iter_lim: int = 1000
+    res_print: int = 10
+    tolerance: float = 1e-3
+    max_split: int = 0              # feature-split bound (0 = input dim)
+
+
+def _feature_tag(params: KrrParams) -> str:
+    return "fast" if params.use_fast else "regular"
+
+
+def _ridge_solve(Z: jnp.ndarray, Y: jnp.ndarray, lam: float) -> jnp.ndarray:
+    """W = argmin ‖Z·W − Y‖²_F + λ‖W‖²_F (the El::Ridge(√λ) analog)."""
+    s = Z.shape[1]
+    G = Z.T @ Z + lam * jnp.eye(s, dtype=Z.dtype)
+    L = jsl.cholesky(G, lower=True)
+    return jsl.cho_solve((L, True), Z.T @ Y)
+
+
+def _split_sizes(s: int, d: int, max_split: int) -> list[int]:
+    """Feature-split schedule (ref: ml/krr.hpp:246-248,527-529): chunks of
+    ``sinc`` = max_split/2 (or d when unbounded), final chunk absorbing up to
+    2·sinc."""
+    sinc = d if max_split == 0 else max(1, max_split // 2)
+    sizes, remains = [], s
+    while remains > 0:
+        thiss = remains if remains <= 2 * sinc else sinc
+        sizes.append(thiss)
+        remains -= thiss
+    return sizes
+
+
+def kernel_ridge(
+    k: Kernel,
+    X: jnp.ndarray,
+    Y: jnp.ndarray,
+    lam: float,
+    params: Optional[KrrParams] = None,
+) -> jnp.ndarray:
+    """Exact KRR: A = (K + λI)⁻¹·Y via Cholesky (ref: ml/krr.hpp:47-90
+    SymmetricGram + HPDSolve). Predict with gram(X_new, X) @ A."""
+    params = params or KrrParams()
+    X = jnp.asarray(X)
+    Y = jnp.asarray(Y)
+    n = X.shape[0]
+    K = k.symmetric_gram(X) + lam * jnp.eye(n, dtype=X.dtype)
+    params.log(1, "kernel_ridge: solving (K + lambda I) A = Y")
+    L = jsl.cholesky(K, lower=True)
+    return jsl.cho_solve((L, True), Y if Y.ndim > 1 else Y[:, None])
+
+
+def approximate_kernel_ridge(
+    k: Kernel,
+    X: jnp.ndarray,
+    Y: jnp.ndarray,
+    lam: float,
+    s: int,
+    context: Context,
+    params: Optional[KrrParams] = None,
+):
+    """Random-features KRR (ref: ml/krr.hpp:92-196): Z = S(X) with an
+    s-feature map, then ridge-solve for W — optionally after sketching the
+    (n, s) regression down to (t, s) rows with FJLT (or CWT when
+    ``fast_sketch``). Returns (S, W); predict with S.apply(X_new, ROWWISE) @ W.
+    """
+    from libskylark_tpu import sketch as sk
+
+    params = params or KrrParams()
+    X = jnp.asarray(X)
+    Y = jnp.asarray(Y)
+    if Y.ndim == 1:
+        Y = Y[:, None]
+    S = k.create_rft(s, context, _feature_tag(params))
+    Z = S.apply(X, sk.ROWWISE)
+
+    if params.sketched_rr:
+        n = Z.shape[0]
+        t = 4 * s if params.sketch_size == -1 else params.sketch_size
+        R = (
+            sk.CWT(n, t, context)
+            if params.fast_sketch
+            else sk.FJLT(n, t, context)
+        )
+        SZ = R.apply(Z, sk.COLUMNWISE)
+        SY = R.apply(Y, sk.COLUMNWISE)
+    else:
+        SZ, SY = Z, Y
+
+    W = _ridge_solve(SZ, SY, lam)
+    return S, W
+
+
+def sketched_approximate_kernel_ridge(
+    k: Kernel,
+    X: jnp.ndarray,
+    Y: jnp.ndarray,
+    lam: float,
+    s: int,
+    context: Context,
+    t: int = -1,
+    params: Optional[KrrParams] = None,
+):
+    """Memory-bounded variant (ref: ml/krr.hpp:197-309): the s features are
+    produced by a list of split maps (each scaled by √(s_c/s)); each block is
+    immediately compressed by a shared row sketch R to t rows, so the full
+    (n, s) feature matrix never exists. Returns (transforms, W); at predict
+    time apply each map, scale by √(s_c/s), and concatenate (``scale_maps``
+    is always true here — the reference returns it as a flag)."""
+    from libskylark_tpu import sketch as sk
+
+    params = params or KrrParams()
+    X = jnp.asarray(X)
+    Y = jnp.asarray(Y)
+    if Y.ndim == 1:
+        Y = Y[:, None]
+    n, d = X.shape
+    t = 4 * s if t == -1 else t
+
+    R = sk.CWT(n, t, context) if params.fast_sketch else sk.FJLT(n, t, context)
+    SY = R.apply(Y, sk.COLUMNWISE)
+
+    transforms = []
+    blocks = []
+    for thiss in _split_sizes(s, d, params.max_split):
+        S = k.create_rft(thiss, context, _feature_tag(params))
+        transforms.append(S)
+        Z = S.apply(X, sk.ROWWISE) * math.sqrt(thiss / s)
+        blocks.append(R.apply(Z, sk.COLUMNWISE))  # (t, thiss)
+    SZ = jnp.concatenate(blocks, axis=1)  # (t, s)
+
+    W = _ridge_solve(SZ, SY, lam)
+    return transforms, W
+
+
+class FeatureMapPrecond(FunctionPrecond):
+    """Random-features preconditioner for (K + λI)
+    (ref: ml/krr.hpp:310-398 feature_map_precond_t): with U = (s, n) features,
+    approximate K ≈ UᵀU, so apply (λI + UᵀU)⁻¹ via SMW:
+    P(B) = B/λ − Uᵀ·(I + U·Uᵀ/λ)⁻¹·(U·B)/λ².
+    """
+
+    def __init__(self, k, lam, X, s, context, use_fast: bool = False):
+        from libskylark_tpu import sketch as sk
+
+        X = jnp.asarray(X)
+        S = k.create_rft(s, context, "fast" if use_fast else "regular")
+        U = S.apply(X, sk.ROWWISE).T  # (s, n)
+        C = jnp.eye(s, dtype=U.dtype) + (U @ U.T) / lam
+        L = jsl.cholesky(C, lower=True)
+
+        def apply(B):
+            CUB = jsl.cho_solve((L, True), U @ B)
+            return B / lam - (U.T @ CUB) / (lam * lam)
+
+        super().__init__(apply)
+        self.U = U
+        self.L = L
+        self.lam = lam
+
+
+def faster_kernel_ridge(
+    k: Kernel,
+    X: jnp.ndarray,
+    Y: jnp.ndarray,
+    lam: float,
+    s: int,
+    context: Context,
+    params: Optional[KrrParams] = None,
+) -> jnp.ndarray:
+    """Exact-Gram KRR solved by preconditioned CG with the random-features
+    SMW preconditioner (ref: ml/krr.hpp:400-499). ``s == 0`` falls back to
+    unpreconditioned CG. Returns A = (K + λI)⁻¹·Y."""
+    params = params or KrrParams()
+    X = jnp.asarray(X)
+    Y = jnp.asarray(Y)
+    if Y.ndim == 1:
+        Y = Y[:, None]
+    n = X.shape[0]
+    K = k.symmetric_gram(X) + lam * jnp.eye(n, dtype=X.dtype)
+
+    P = (
+        IdPrecond()
+        if s == 0
+        else FeatureMapPrecond(k, lam, X, s, context, use_fast=params.use_fast)
+    )
+    cg_params = KrylovParams(
+        tolerance=params.tolerance, iter_lim=params.iter_lim
+    )
+    A, _ = cg(K, Y, cg_params, precond=P)
+    return A
+
+
+def large_scale_kernel_ridge(
+    k: Kernel,
+    X: jnp.ndarray,
+    Y: jnp.ndarray,
+    lam: float,
+    s: int,
+    context: Context,
+    params: Optional[KrrParams] = None,
+):
+    """Block coordinate descent over split feature maps
+    (ref: ml/krr.hpp:500-690): per block c, cache L_c = chol(Z_cᵀZ_c + λI) on
+    the first sweep, then iterate
+    ΔW_c = L_c⁻ᵀL_c⁻¹·(Z_cᵀR − λW_c),  W_c += ΔW_c,  R −= Z_c·ΔW_c
+    until the relative update falls below tolerance. The feature maps are
+    regenerated from their (seed, counter) every sweep instead of being stored
+    — the reference's memory-saving trick, which the counter-based RNG makes
+    free. Returns (transforms, W) with W the concatenated block solution;
+    predict by applying each map in order and multiplying the stacked
+    features with W."""
+    from libskylark_tpu import sketch as sk
+
+    params = params or KrrParams()
+    X = jnp.asarray(X)
+    Y = jnp.asarray(Y)
+    if Y.ndim == 1:
+        Y = Y[:, None]
+    n, d = X.shape
+    t = Y.shape[1]
+
+    transforms = [
+        k.create_rft(thiss, context, _feature_tag(params))
+        for thiss in _split_sizes(s, d, params.max_split)
+    ]
+
+    W_blocks = [
+        jnp.zeros((S.sketch_dim, t), dtype=X.dtype) for S in transforms
+    ]
+    R = Y
+    Ls = []
+
+    # First sweep: build + cache Cholesky factors (ref: :568-612).
+    for c, S in enumerate(transforms):
+        Z = S.apply(X, sk.ROWWISE)  # (n, s_c)
+        G = Z.T @ Z + lam * jnp.eye(Z.shape[1], dtype=Z.dtype)
+        L = jsl.cholesky(G, lower=True)
+        Ls.append(L)
+        ZR = Z.T @ R - lam * W_blocks[c]
+        delW = jsl.cho_solve((L, True), ZR)
+        W_blocks[c] = W_blocks[c] + delW
+        R = R - Z @ delW
+
+    # More sweeps with cached factors (ref: :625-682).
+    for it in range(1, params.iter_lim):
+        delsize = 0.0
+        for c, S in enumerate(transforms):
+            Z = S.apply(X, sk.ROWWISE)
+            ZR = Z.T @ R - lam * W_blocks[c]
+            delW = jsl.cho_solve((Ls[c], True), ZR)
+            W_blocks[c] = W_blocks[c] + delW
+            R = R - Z @ delW
+            delsize += float(jnp.sum(delW * delW))
+        wnorm = math.sqrt(sum(float(jnp.sum(w * w)) for w in W_blocks))
+        reldel = math.sqrt(delsize) / max(wnorm, 1e-30)
+        params.log(2, f"large_scale_krr: iter {it}, relupdate = {reldel:.2e}")
+        if reldel < params.tolerance:
+            params.log(2, "large_scale_krr: convergence!")
+            break
+
+    return transforms, jnp.concatenate(W_blocks, axis=0)

@@ -1,0 +1,137 @@
+"""Regularized least squares classification (RLSC).
+
+TPU-native analog of ref: ml/rlsc.hpp:6-311 — thin classification wrappers
+around the KRR family: dummy-code the labels into a ±1 one-vs-all target
+matrix, run the matching KRR solver, return the solution together with the
+coding (label order) needed to decode argmax predictions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from libskylark_tpu.base.context import Context
+from libskylark_tpu.base.params import Params
+from libskylark_tpu.ml import krr
+from libskylark_tpu.ml.coding import dummy_coding
+from libskylark_tpu.ml.kernels import Kernel
+
+
+@dataclasses.dataclass
+class RlscParams(Params):
+    """ref: ml/rlsc.hpp:6-43 rlsc_params_t."""
+
+    use_fast: bool = False
+    sketched_rls: bool = False
+    sketch_size: int = -1
+    fast_sketch: bool = False
+    iter_lim: int = 1000
+    res_print: int = 10
+    tolerance: float = 1e-3
+    max_split: int = 0
+
+
+def _krr_params(params: RlscParams) -> krr.KrrParams:
+    """ref: rlsc.hpp:78-84 — forward the shared knobs, demote log level."""
+    return krr.KrrParams(
+        am_i_printing=params.am_i_printing,
+        log_level=params.log_level - 1,
+        prefix=params.prefix + "\t",
+        use_fast=params.use_fast,
+        sketched_rr=params.sketched_rls,
+        sketch_size=params.sketch_size,
+        fast_sketch=params.fast_sketch,
+        iter_lim=params.iter_lim,
+        res_print=params.res_print,
+        tolerance=params.tolerance,
+        max_split=params.max_split,
+    )
+
+
+def kernel_rlsc(
+    k: Kernel, X, labels, lam: float, params: Optional[RlscParams] = None
+):
+    """Exact RLSC (ref: ml/rlsc.hpp:44-92). Returns (A, coding); predict with
+    ``dummy_decode(gram(X_new, X) @ A, coding)``."""
+    params = params or RlscParams()
+    Y, coding = dummy_coding(labels, dtype=jnp.asarray(X).dtype)
+    A = krr.kernel_ridge(k, X, Y, lam, _krr_params(params))
+    return A, coding
+
+
+def approximate_kernel_rlsc(
+    k: Kernel,
+    X,
+    labels,
+    lam: float,
+    s: int,
+    context: Context,
+    params: Optional[RlscParams] = None,
+):
+    """Random-features RLSC (ref: ml/rlsc.hpp:94-145). Returns
+    (S, W, coding)."""
+    params = params or RlscParams()
+    Y, coding = dummy_coding(labels, dtype=jnp.asarray(X).dtype)
+    S, W = krr.approximate_kernel_ridge(
+        k, X, Y, lam, s, context, _krr_params(params)
+    )
+    return S, W, coding
+
+
+def sketched_approximate_kernel_rlsc(
+    k: Kernel,
+    X,
+    labels,
+    lam: float,
+    s: int,
+    context: Context,
+    t: int = -1,
+    params: Optional[RlscParams] = None,
+):
+    """Sketched split-features RLSC (ref: ml/rlsc.hpp:147-201). Returns
+    (transforms, W, coding)."""
+    params = params or RlscParams()
+    Y, coding = dummy_coding(labels, dtype=jnp.asarray(X).dtype)
+    transforms, W = krr.sketched_approximate_kernel_ridge(
+        k, X, Y, lam, s, context, t, _krr_params(params)
+    )
+    return transforms, W, coding
+
+
+def faster_kernel_rlsc(
+    k: Kernel,
+    X,
+    labels,
+    lam: float,
+    s: int,
+    context: Context,
+    params: Optional[RlscParams] = None,
+):
+    """CG + random-features-preconditioner RLSC (ref: ml/rlsc.hpp:203-252).
+    Returns (A, coding)."""
+    params = params or RlscParams()
+    Y, coding = dummy_coding(labels, dtype=jnp.asarray(X).dtype)
+    A = krr.faster_kernel_ridge(k, X, Y, lam, s, context, _krr_params(params))
+    return A, coding
+
+
+def large_scale_kernel_rlsc(
+    k: Kernel,
+    X,
+    labels,
+    lam: float,
+    s: int,
+    context: Context,
+    params: Optional[RlscParams] = None,
+):
+    """Block-coordinate-descent RLSC (ref: ml/rlsc.hpp:254-311). Returns
+    (transforms, W, coding)."""
+    params = params or RlscParams()
+    Y, coding = dummy_coding(labels, dtype=jnp.asarray(X).dtype)
+    transforms, W = krr.large_scale_kernel_ridge(
+        k, X, Y, lam, s, context, _krr_params(params)
+    )
+    return transforms, W, coding

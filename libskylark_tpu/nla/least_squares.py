@@ -10,6 +10,7 @@ from typing import Optional
 import jax.numpy as jnp
 
 from libskylark_tpu.algorithms import regression
+from libskylark_tpu.base import errors
 from libskylark_tpu.base.context import Context
 
 
@@ -32,8 +33,12 @@ def approximate_least_squares(
         T = sk.FJLT(m, s, context)
     elif sketch == "cwt":
         T = sk.CWT(m, s, context)
-    else:
+    elif sketch == "jlt":
         T = sk.JLT(m, s, context)
+    else:
+        raise errors.InvalidParametersError(
+            f"unknown sketch {sketch!r}; expected 'fjlt', 'cwt', or 'jlt'"
+        )
     return regression.solve_l2_sketched(A, B, T)
 
 

@@ -1,0 +1,157 @@
+"""ML kernel layer tests: distance matrices, label coding, Gram matrices,
+random-feature-map consistency (E[z(x)·z(y)] ≈ k(x,y)), serialization.
+
+The feature-map consistency checks are the statistical analog of the
+reference's regression tests (ref: tests/regression/svd_test.py) — loose
+tolerances, fixed seeds.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from libskylark_tpu import Context
+from libskylark_tpu import ml
+from libskylark_tpu import sketch as sk
+from libskylark_tpu.base.distance import (
+    euclidean_distance_matrix,
+    l1_distance_matrix,
+)
+
+
+def _data(n, d, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return (scale * rng.standard_normal((n, d))).astype(np.float32)
+
+
+class TestDistance:
+    def test_euclidean_squared(self):
+        X = _data(7, 4, 1)
+        Y = _data(5, 4, 2)
+        D = np.asarray(euclidean_distance_matrix(X, Y))
+        brute = ((X[:, None, :] - Y[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_allclose(D, brute, rtol=1e-4, atol=1e-5)
+
+    def test_l1(self):
+        X = _data(6, 3, 3)
+        Y = _data(4, 3, 4)
+        D = np.asarray(l1_distance_matrix(X, Y))
+        brute = np.abs(X[:, None, :] - Y[None, :, :]).sum(-1)
+        np.testing.assert_allclose(D, brute, rtol=1e-5, atol=1e-6)
+
+
+class TestCoding:
+    def test_round_trip(self):
+        labels = np.array([3, 1, 2, 1, 3, 2, 2])
+        Y, coding = ml.dummy_coding(labels)
+        assert Y.shape == (7, 3)
+        assert np.all(np.asarray(Y).sum(axis=1) == -(len(coding) - 2))
+        back = ml.dummy_decode(Y, coding)
+        np.testing.assert_array_equal(back, labels)
+
+    def test_reuse_coding(self):
+        Y, coding = ml.dummy_coding([5, 7], coding=[5, 6, 7])
+        assert Y.shape == (2, 3)
+        assert np.asarray(Y)[0, 0] == 1 and np.asarray(Y)[1, 2] == 1
+
+
+class TestGram:
+    def test_gaussian_entries(self):
+        X = _data(6, 3, 5)
+        k = ml.Gaussian(3, sigma=1.7)
+        K = np.asarray(k.symmetric_gram(X))
+        i, j = 2, 4
+        expect = np.exp(-np.sum((X[i] - X[j]) ** 2) / (2 * 1.7**2))
+        assert abs(K[i, j] - expect) < 1e-5
+        np.testing.assert_allclose(np.diag(K), 1.0, atol=1e-5)
+
+    def test_polynomial(self):
+        X = _data(5, 3, 6)
+        k = ml.Polynomial(3, q=3, c=0.5, gamma=2.0)
+        K = np.asarray(k.gram(X, X))
+        expect = (2.0 * X @ X.T + 0.5) ** 3
+        np.testing.assert_allclose(K, expect, rtol=1e-4)
+
+    def test_laplacian(self):
+        X = _data(5, 3, 7)
+        k = ml.Laplacian(3, sigma=2.0)
+        K = np.asarray(k.symmetric_gram(X))
+        D = np.abs(X[:, None, :] - X[None, :, :]).sum(-1)
+        np.testing.assert_allclose(K, np.exp(-D / 2.0), rtol=1e-4)
+
+    def test_matern_half_is_exponential(self):
+        X = _data(5, 3, 8)
+        k = ml.Matern(3, nu=0.5, l=1.3)
+        K = np.asarray(k.symmetric_gram(X))
+        r = np.sqrt(((X[:, None, :] - X[None, :, :]) ** 2).sum(-1))
+        np.testing.assert_allclose(K, np.exp(-r / 1.3), rtol=1e-3, atol=1e-5)
+
+    def test_matern_general_nu_matches_closed_form(self):
+        pytest.importorskip("scipy")
+        X = _data(5, 3, 9)
+        closed = np.asarray(ml.Matern(3, nu=1.5, l=1.1).symmetric_gram(X))
+        general = np.asarray(ml.Matern(3, nu=1.5000001, l=1.1).symmetric_gram(X))
+        np.testing.assert_allclose(closed, general, rtol=1e-3, atol=1e-4)
+
+    def test_expsemigroup(self):
+        rng = np.random.default_rng(10)
+        X = rng.uniform(0.1, 2.0, (5, 3)).astype(np.float32)
+        k = ml.ExpSemigroup(3, beta=0.5)
+        K = np.asarray(k.symmetric_gram(X))
+        expect = np.exp(-0.5 * np.sqrt(X[:, None, :] + X[None, :, :]).sum(-1))
+        np.testing.assert_allclose(K, expect, rtol=1e-4)
+
+    def test_linear(self):
+        X = _data(4, 3, 11)
+        K = np.asarray(ml.Linear(3).symmetric_gram(X))
+        np.testing.assert_allclose(K, X @ X.T, rtol=1e-4, atol=1e-5)
+
+
+class TestFeatureMapConsistency:
+    """Z·Zᵀ ≈ K for large feature counts — the defining property of
+    create_rft (ref: ml/kernels.hpp create_rft + sketch RFT family)."""
+
+    @pytest.mark.parametrize(
+        "kernel,tag",
+        [
+            (ml.Gaussian(6, sigma=2.0), "regular"),
+            (ml.Gaussian(6, sigma=2.0), "quasi"),
+            (ml.Laplacian(6, sigma=4.0), "regular"),
+            (ml.Polynomial(6, q=2, c=0.0, gamma=1.0), "regular"),
+        ],
+    )
+    def test_gram_approximation(self, kernel, tag):
+        X = _data(10, 6, 12, scale=0.5)
+        K = np.asarray(kernel.symmetric_gram(X))
+        S = kernel.create_rft(4096, Context(seed=13), tag)
+        Z = np.asarray(S.apply(jnp.asarray(X), sk.ROWWISE))
+        Kz = Z @ Z.T
+        assert np.max(np.abs(Kz - K)) < 0.15, np.max(np.abs(Kz - K))
+
+    def test_linear_jlt(self):
+        X = _data(10, 6, 14)
+        S = ml.Linear(6).create_rft(2048, Context(seed=15), "regular")
+        Z = np.asarray(S.apply(jnp.asarray(X), sk.ROWWISE))
+        np.testing.assert_allclose(Z @ Z.T, X @ X.T, atol=0.9)
+
+
+class TestKernelSerialization:
+    @pytest.mark.parametrize(
+        "k",
+        [
+            ml.Linear(5),
+            ml.Gaussian(5, sigma=2.5),
+            ml.Polynomial(5, q=4, c=0.1, gamma=0.3),
+            ml.Laplacian(5, sigma=1.5),
+            ml.ExpSemigroup(5, beta=0.7),
+            ml.Matern(5, nu=1.5, l=2.0),
+        ],
+    )
+    def test_round_trip(self, k):
+        k2 = ml.deserialize_kernel(k.to_json())
+        assert type(k2) is type(k)
+        assert k2.to_dict() == k.to_dict()
+
+    def test_make_kernel(self):
+        k = ml.make_kernel("gaussian", 8, sigma=3.0)
+        assert isinstance(k, ml.Gaussian) and k.sigma == 3.0

@@ -1,0 +1,190 @@
+"""KRR/RLSC family tests.
+
+Oracles: (a) exact algebraic identities — the solvers produce solutions of
+known linear systems, checkable via normal equations; (b) regime agreement —
+faster_kernel_ridge must match kernel_ridge (same system, different solver);
+(c) end-to-end classification accuracy on separable data (the reference's
+skylark_ml-style smoke test).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from libskylark_tpu import Context, ml
+from libskylark_tpu import sketch as sk
+
+
+def _regression_data(n=60, d=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    w = rng.standard_normal((d, 1)).astype(np.float32)
+    Y = (X @ w + 0.01 * rng.standard_normal((n, 1))).astype(np.float32)
+    return X, Y
+
+
+def _blobs(n_per=40, d=4, seed=1):
+    """Two well-separated Gaussian blobs."""
+    rng = np.random.default_rng(seed)
+    X0 = rng.standard_normal((n_per, d)) - 2.5
+    X1 = rng.standard_normal((n_per, d)) + 2.5
+    X = np.vstack([X0, X1]).astype(np.float32)
+    y = np.array([0] * n_per + [1] * n_per)
+    perm = rng.permutation(2 * n_per)
+    return X[perm], y[perm]
+
+
+class TestKernelRidge:
+    def test_exact_solves_system(self):
+        X, Y = _regression_data()
+        k = ml.Gaussian(X.shape[1], sigma=2.0)
+        lam = 0.1
+        A = ml.kernel_ridge(k, X, Y, lam)
+        K = np.asarray(k.symmetric_gram(X))
+        resid = (K + lam * np.eye(len(X))) @ np.asarray(A) - Y
+        assert np.max(np.abs(resid)) < 1e-3
+
+    def test_faster_matches_exact(self):
+        X, Y = _regression_data(seed=2)
+        k = ml.Gaussian(X.shape[1], sigma=2.0)
+        lam = 0.5
+        A_exact = np.asarray(ml.kernel_ridge(k, X, Y, lam))
+        A_cg = np.asarray(
+            ml.faster_kernel_ridge(
+                k, X, Y, lam, 128, Context(seed=7),
+                ml.KrrParams(tolerance=1e-7, iter_lim=400),
+            )
+        )
+        np.testing.assert_allclose(A_cg, A_exact, rtol=1e-2, atol=1e-3)
+
+    def test_faster_unpreconditioned(self):
+        X, Y = _regression_data(seed=3)
+        k = ml.Gaussian(X.shape[1], sigma=2.0)
+        A_exact = np.asarray(ml.kernel_ridge(k, X, Y, 1.0))
+        A_cg = np.asarray(
+            ml.faster_kernel_ridge(
+                k, X, Y, 1.0, 0, Context(seed=8),
+                ml.KrrParams(tolerance=1e-7, iter_lim=400),
+            )
+        )
+        np.testing.assert_allclose(A_cg, A_exact, rtol=1e-2, atol=1e-3)
+
+
+class TestApproximateKernelRidge:
+    def test_normal_equations(self):
+        """W solves (ZᵀZ + λI)W = ZᵀY for the returned feature map."""
+        X, Y = _regression_data(seed=4)
+        k = ml.Gaussian(X.shape[1], sigma=2.0)
+        lam = 0.2
+        S, W = ml.approximate_kernel_ridge(k, X, Y, lam, 64, Context(seed=9))
+        Z = np.asarray(S.apply(jnp.asarray(X), sk.ROWWISE))
+        resid = (Z.T @ Z + lam * np.eye(64)) @ np.asarray(W) - Z.T @ Y
+        assert np.max(np.abs(resid)) < 1e-3
+
+    def test_sketched_rr_close_to_unsketched(self):
+        X, Y = _regression_data(n=200, seed=5)
+        k = ml.Gaussian(X.shape[1], sigma=2.0)
+        ctx = Context(seed=10)
+        S, W = ml.approximate_kernel_ridge(k, X, Y, 0.5, 32, ctx)
+        S2, W2 = ml.approximate_kernel_ridge(
+            k, X, Y, 0.5, 32, Context(seed=10),
+            ml.KrrParams(sketched_rr=True, sketch_size=160),
+        )
+        # Same context seed/counter -> same feature map; sketching only
+        # perturbs the solve.
+        Z = np.asarray(S.apply(jnp.asarray(X), sk.ROWWISE))
+        pred1 = Z @ np.asarray(W)
+        pred2 = Z @ np.asarray(W2)
+        rel = np.linalg.norm(pred1 - pred2) / np.linalg.norm(pred1)
+        assert rel < 0.5
+
+    def test_predicts(self):
+        X, Y = _regression_data(n=100, seed=6)
+        k = ml.Gaussian(X.shape[1], sigma=3.0)
+        S, W = ml.approximate_kernel_ridge(k, X, Y, 0.01, 256, Context(seed=11))
+        Z = np.asarray(S.apply(jnp.asarray(X), sk.ROWWISE))
+        pred = Z @ np.asarray(W)
+        rel = np.linalg.norm(pred - Y) / np.linalg.norm(Y)
+        assert rel < 0.35
+
+
+class TestSketchedApproximateKernelRidge:
+    def test_splits_and_shapes(self):
+        X, Y = _regression_data(n=80, seed=7)
+        k = ml.Gaussian(X.shape[1], sigma=2.0)
+        transforms, W = ml.sketched_approximate_kernel_ridge(
+            k, X, Y, 0.1, 48, Context(seed=12),
+            params=ml.KrrParams(max_split=20),
+        )
+        assert sum(t.sketch_dim for t in transforms) == 48
+        assert len(transforms) > 1
+        assert W.shape == (48, 1)
+
+    def test_unbounded_split_schedule(self):
+        """max_split=0 -> sinc = input dim, last chunk absorbs <= 2*sinc
+        (ref: ml/krr.hpp:246-248)."""
+        X, Y = _regression_data(n=50, seed=8)
+        k = ml.Gaussian(X.shape[1], sigma=2.0)
+        transforms, W = ml.sketched_approximate_kernel_ridge(
+            k, X, Y, 0.1, 16, Context(seed=13), t=200,
+        )
+        assert [t.sketch_dim for t in transforms] == [5, 5, 6]
+
+
+class TestLargeScaleKernelRidge:
+    def test_normal_equations_at_convergence(self):
+        X, Y = _regression_data(n=70, seed=9)
+        k = ml.Gaussian(X.shape[1], sigma=2.0)
+        lam = 0.3
+        transforms, W = ml.large_scale_kernel_ridge(
+            k, X, Y, lam, 24, Context(seed=14),
+            ml.KrrParams(max_split=16, tolerance=1e-8, iter_lim=500),
+        )
+        Z = np.concatenate(
+            [np.asarray(t.apply(jnp.asarray(X), sk.ROWWISE)) for t in transforms],
+            axis=1,
+        )
+        resid = (Z.T @ Z + lam * np.eye(Z.shape[1])) @ np.asarray(W) - Z.T @ Y
+        assert np.max(np.abs(resid)) < 1e-2
+
+
+class TestRLSC:
+    def test_exact_rlsc_separates(self):
+        X, y = _blobs()
+        k = ml.Gaussian(X.shape[1], sigma=3.0)
+        A, coding = ml.kernel_rlsc(k, X, y, 0.1)
+        scores = np.asarray(k.gram(X, X)) @ np.asarray(A)
+        pred = ml.dummy_decode(jnp.asarray(scores), coding)
+        assert (pred == y).mean() > 0.95
+
+    def test_approximate_rlsc_separates(self):
+        X, y = _blobs(seed=2)
+        k = ml.Gaussian(X.shape[1], sigma=3.0)
+        S, W, coding = ml.approximate_kernel_rlsc(
+            k, X, y, 0.1, 128, Context(seed=15)
+        )
+        scores = np.asarray(S.apply(jnp.asarray(X), sk.ROWWISE)) @ np.asarray(W)
+        pred = ml.dummy_decode(jnp.asarray(scores), coding)
+        assert (pred == y).mean() > 0.95
+
+    def test_faster_rlsc_separates(self):
+        X, y = _blobs(seed=3)
+        k = ml.Gaussian(X.shape[1], sigma=3.0)
+        A, coding = ml.faster_kernel_rlsc(k, X, y, 0.1, 64, Context(seed=16))
+        scores = np.asarray(k.gram(X, X)) @ np.asarray(A)
+        pred = ml.dummy_decode(jnp.asarray(scores), coding)
+        assert (pred == y).mean() > 0.95
+
+    def test_large_scale_rlsc_separates(self):
+        X, y = _blobs(seed=4)
+        k = ml.Gaussian(X.shape[1], sigma=3.0)
+        transforms, W, coding = ml.large_scale_kernel_rlsc(
+            k, X, y, 0.1, 64, Context(seed=17),
+            ml.RlscParams(max_split=32, iter_lim=200, tolerance=1e-6),
+        )
+        Z = np.concatenate(
+            [np.asarray(t.apply(jnp.asarray(X), sk.ROWWISE)) for t in transforms],
+            axis=1,
+        )
+        pred = ml.dummy_decode(jnp.asarray(Z @ np.asarray(W)), coding)
+        assert (pred == y).mean() > 0.95
