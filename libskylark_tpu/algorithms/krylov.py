@@ -23,6 +23,7 @@ from jax import lax
 
 from libskylark_tpu.algorithms.precond import IdPrecond, Precond
 from libskylark_tpu.base.params import Params
+from libskylark_tpu.base.precision import with_solver_precision
 
 Operator = Union[jnp.ndarray, Tuple[Callable, Callable]]
 
@@ -46,6 +47,7 @@ def _colnorms(X):
     return jnp.sqrt(jnp.sum(X * X, axis=0))
 
 
+@with_solver_precision
 def lsqr(
     A: Operator,
     B: jnp.ndarray,
@@ -144,6 +146,7 @@ def lsqr(
     return X, out["it"]
 
 
+@with_solver_precision
 def cg(
     A: Operator,
     B: jnp.ndarray,
@@ -199,6 +202,7 @@ def cg(
     return X, out["it"]
 
 
+@with_solver_precision
 def flexible_cg(
     A: Operator,
     B: jnp.ndarray,
@@ -261,6 +265,7 @@ def flexible_cg(
     return X, out["it"]
 
 
+@with_solver_precision
 def chebyshev(
     A: Operator,
     B: jnp.ndarray,

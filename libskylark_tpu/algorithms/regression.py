@@ -25,6 +25,7 @@ from libskylark_tpu.algorithms.precond import MatPrecond, Precond, TriInversePre
 from libskylark_tpu.base import errors
 from libskylark_tpu.base.context import Context
 from libskylark_tpu.base.params import Params
+from libskylark_tpu.base.precision import with_solver_precision
 
 
 @dataclasses.dataclass
@@ -41,6 +42,7 @@ class RegressionProblem:
 # -- exact L2 solvers (ref: linearl2_regression_solver_Elemental.hpp) --
 
 
+@with_solver_precision
 def solve_l2_exact(A: jnp.ndarray, B: jnp.ndarray, method: str = "qr") -> jnp.ndarray:
     """Exact least squares min ‖A·X − B‖ by the requested algorithm tag
     (ref: linearl2_regression_solver.hpp:11-37 — qr/sne/ne/svd)."""
@@ -74,6 +76,7 @@ def solve_l2_exact(A: jnp.ndarray, B: jnp.ndarray, method: str = "qr") -> jnp.nd
 # -- sketch-and-solve (ref: sketched_regression_solver.hpp:12-28) --
 
 
+@with_solver_precision
 def solve_l2_sketched(
     A: jnp.ndarray,
     B: jnp.ndarray,
@@ -108,6 +111,7 @@ class AcceleratedParams(Params):
     sketch: str = "fjlt"  # fjlt | jlt | cwt
 
 
+@with_solver_precision
 def build_blendenpik_precond(
     A: jnp.ndarray, context: Context, params: AcceleratedParams
 ) -> tuple[Precond, jnp.ndarray]:
@@ -131,6 +135,7 @@ def build_blendenpik_precond(
     return TriInversePrecond(R), R
 
 
+@with_solver_precision
 def build_lsrn_precond(
     A: jnp.ndarray, context: Context, params: AcceleratedParams
 ) -> tuple[Precond, jnp.ndarray]:
@@ -148,6 +153,7 @@ def build_lsrn_precond(
     return MatPrecond(Ninv), sv
 
 
+@with_solver_precision
 def solve_l2_accelerated(
     A: jnp.ndarray,
     B: jnp.ndarray,

@@ -1,0 +1,56 @@
+"""Matmul-precision policy for solver paths.
+
+The reference is float64 end-to-end (SURVEY.md §7 "f64 policy"). On TPU,
+float32 matmuls lower to bfloat16 MXU passes by default — harmless for
+sketch *application* (random projections are statistically robust to
+rounding) but destructive for iterative solvers, cached factorizations, and
+power iterations, where rounding compounds across iterations (observed:
+Block-ADMM converging on CPU but stalling on TPU with identical inputs).
+
+Policy: solver entry points are wrapped in ``solver_precision()`` which
+raises matmul precision to full float32 ("highest" = 6-pass bf16) for
+everything traced inside; sketch applies stay at the fast default. Override
+globally with ``set_solver_precision`` (e.g. "default" to reclaim MXU speed
+when accuracy is known to tolerate it, or for benchmarking)."""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+
+_SOLVER_PRECISION = "highest"
+
+
+def set_solver_precision(value: str) -> None:
+    """Set the global solver matmul precision: "default", "float32"/"highest",
+    or "tensorfloat32"."""
+    global _SOLVER_PRECISION
+    _SOLVER_PRECISION = value
+
+
+def get_solver_precision() -> str:
+    return _SOLVER_PRECISION
+
+
+@contextlib.contextmanager
+def solver_precision():
+    """Context raising matmul precision for ops traced within."""
+    if _SOLVER_PRECISION == "default":
+        yield
+    else:
+        with jax.default_matmul_precision(_SOLVER_PRECISION):
+            yield
+
+
+def with_solver_precision(fn):
+    """Decorator applying :func:`solver_precision` around ``fn`` — used on
+    every iterative-solver and factorization entry point."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with solver_precision():
+            return fn(*args, **kwargs)
+
+    return wrapped

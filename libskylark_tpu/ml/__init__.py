@@ -1,8 +1,16 @@
 """ML layer: kernels, KRR/RLSC, ADMM kernel machines, models, graph
 algorithms (SURVEY.md §2.5)."""
 
-from libskylark_tpu.ml import coding, kernels, krr, rlsc
+from libskylark_tpu.ml import admm, coding, graph, kernels, krr, model, rlsc
+from libskylark_tpu.ml.admm import BlockADMMSolver
+from libskylark_tpu.ml.graph import (
+    Graph,
+    approximate_ase,
+    find_local_cluster,
+    time_dependent_ppr,
+)
 from libskylark_tpu.ml.coding import dummy_coding, dummy_decode
+from libskylark_tpu.ml.model import HilbertModel
 from libskylark_tpu.ml.kernels import (
     ExpSemigroup,
     Gaussian,
@@ -34,6 +42,15 @@ from libskylark_tpu.ml.rlsc import (
 )
 
 __all__ = [
+    "admm",
+    "graph",
+    "Graph",
+    "approximate_ase",
+    "find_local_cluster",
+    "time_dependent_ppr",
+    "model",
+    "BlockADMMSolver",
+    "HilbertModel",
     "coding",
     "kernels",
     "krr",

@@ -1,0 +1,285 @@
+"""Graph algorithms: adjacency spectral embedding and seeded local community
+detection via time-dependent personalized PageRank.
+
+TPU-native analog of ref: ml/graph/spectral_embedding.hpp (ApproximateASE),
+ml/graph/local_computations.hpp (TimeDependentPPR, FindLocalCluster), and the
+driver-side graph container (ref: ml/skylark_community.cpp:20-95,
+base/graph_adapters.hpp:6-29).
+
+Division of labor mirrors the reference: the spectral embedding is bulk
+linear algebra and runs through the randomized symmetric SVD on device; the
+local diffusion is an inherently sequential queue-driven push algorithm over
+a tiny active set ("all **local/sequential**", SURVEY.md §2.5) and runs on
+host in numpy — putting it on the TPU would serialize scalar work through
+the accelerator.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Hashable, Iterable, Optional, Sequence, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from libskylark_tpu.base import errors
+from libskylark_tpu.base.context import Context
+from libskylark_tpu.nla.spectral import chebyshev_diff_matrix, chebyshev_points
+from libskylark_tpu.nla.svd import ApproximateSVDParams, approximate_symmetric_svd
+
+
+class Graph:
+    """Undirected graph over hashable vertices
+    (ref: ml/skylark_community.cpp:20-95 — adjacency via hash maps;
+    ``num_edges`` counts both directions of every edge, i.e. the graph
+    volume, matching the reference's ``_num_edges += 2`` per edge)."""
+
+    def __init__(self, edges: Iterable[Tuple[Hashable, Hashable]] = ()):
+        self._adj: Dict[Hashable, dict] = {}
+        self._num_edges = 0
+        for u, v in edges:
+            self.add_edge(u, v)
+
+    def add_edge(self, u, v) -> None:
+        if u == v:
+            return
+        nu = self._adj.setdefault(u, {})
+        if v in nu:
+            return
+        nu[v] = None  # dict as insertion-ordered set: O(1) membership
+        self._adj.setdefault(v, {})[u] = None
+        self._num_edges += 2
+
+    @property
+    def vertices(self) -> list:
+        return list(self._adj.keys())
+
+    def num_vertices(self) -> int:
+        return len(self._adj)
+
+    def num_edges(self) -> int:
+        return self._num_edges
+
+    def degree(self, v) -> int:
+        return len(self._adj[v])
+
+    def neighbors(self, v):
+        return self._adj[v].keys()
+
+    def has_vertex(self, v) -> bool:
+        return v in self._adj
+
+    def adjacency_matrix(self, dtype=np.float32):
+        """Dense adjacency + index map (ref: GraphType::adjacency_matrix).
+        Returns (A, indexmap) where indexmap[i] is the vertex of row i."""
+        indexmap = self.vertices
+        index = {v: i for i, v in enumerate(indexmap)}
+        n = len(indexmap)
+        A = np.zeros((n, n), dtype)
+        for u, nbrs in self._adj.items():
+            for v in nbrs:
+                A[index[u], index[v]] = 1.0
+        return A, indexmap
+
+
+def approximate_ase(
+    G: Graph,
+    k: int,
+    context: Context,
+    params: Optional[ApproximateSVDParams] = None,
+):
+    """Approximate Adjacency Spectral Embedding (Lyzinski et al.;
+    ref: ml/graph/spectral_embedding.hpp:19-94): X = V·√|Λ| from the
+    randomized symmetric eigendecomposition of the adjacency matrix.
+    Returns (X, indexmap) with X (n, k) on device."""
+    A, indexmap = G.adjacency_matrix()
+    V, w = approximate_symmetric_svd(jnp.asarray(A), k, context, params)
+    X = V * jnp.sqrt(jnp.abs(w))[None, :]
+    return X, indexmap
+
+
+# ---------------------------------------------------------------------------
+# Time-dependent PPR (Avron & Horesh, "Community Detection Using
+# Time-Dependent PageRank") — host-side push algorithm.
+# ---------------------------------------------------------------------------
+
+_N_CACHE: Dict[Tuple[float, float], int] = {}
+_D_CACHE: Dict[Tuple[int, float], Tuple[np.ndarray, np.ndarray]] = {}
+
+
+def _min_chebyshev_order(epsilon: float, gamma: float) -> int:
+    """Smallest discretization order meeting the error bound
+    (ref: local_computations.hpp:64-78 — Bessel-function tail bound)."""
+    key = (epsilon, gamma)
+    if key not in _N_CACHE:
+        from scipy.special import iv
+
+        minN = 10
+        C = 20.0 * math.sqrt(minN) * math.exp(-gamma / 2.0)
+        while (
+            C * iv(minN, gamma) * 0.8**minN
+            > epsilon / (gamma * (1 + (2 / math.pi) * math.log(minN - 1)))
+        ):
+            minN += 1
+        _N_CACHE[key] = minN
+    return _N_CACHE[key]
+
+
+def _diffusion_matrix(N: int, gamma: float) -> Tuple[np.ndarray, np.ndarray]:
+    """The push-step matrix D (ref: local_computations.hpp:85-118):
+    QR-factor (D_cheb + I); the top N−1 rows of D apply R₁⁻¹Q₁ᵀ (the
+    least-squares solve) and the last row holds Q's last column (the
+    residual direction q). Returns (D, q)."""
+    key = (N, gamma)
+    if key not in _D_CACHE:
+        D0, _ = chebyshev_diff_matrix(N, 0.0, gamma)
+        D0 = D0 + np.eye(N)
+        Q, R = np.linalg.qr(D0)
+        q = Q[:, N - 1].copy()
+        D = np.empty((N, N))
+        D[N - 1, :] = q
+        D[: N - 1, :] = np.linalg.pinv(R[: N - 1, : N - 1]) @ Q[:, : N - 1].T
+        _D_CACHE[key] = (D, q)
+    return _D_CACHE[key]
+
+
+def time_dependent_ppr(
+    G: Graph,
+    s: Dict[Hashable, float],
+    alpha: float = 0.85,
+    gamma: float = 5.0,
+    epsilon: float = 0.001,
+    NX: int = 4,
+):
+    """Localized time-dependent personalized PageRank
+    (ref: ml/graph/local_computations.hpp:50-265).
+
+    ``s`` maps seed vertices to weights. Returns (y, x): ``y`` maps each
+    touched vertex to its NX diffusion values at the time samples ``x``
+    (descending Chebyshev samples in [0, gamma]).
+    """
+    minN = _min_chebyshev_order(epsilon, gamma)
+    N = minN if minN % NX == 0 else (minN // NX + 1) * NX
+    NR = N // NX
+
+    D, q = _diffusion_matrix(N, gamma)
+    x1 = chebyshev_points(N, 0.0, gamma)
+    x = x1[np.arange(NX) * NR].copy()
+
+    # Push threshold per node: B = C·deg (ref: :126-130).
+    LC = 1 + (2 / math.pi) * math.log(N - 1)
+    if alpha < 1:
+        C = (1 - alpha) * epsilon / ((1 - math.exp((alpha - 1) * gamma)) * LC)
+    else:
+        C = epsilon / (gamma * LC)
+
+    # State per node: [r (N), y (NX)] plus an in-queue flag.
+    rymap: Dict[Hashable, np.ndarray] = {}
+    inq: Dict[Hashable, bool] = {}
+    from collections import deque
+
+    violating = deque()
+
+    def _entry(node):
+        if node not in rymap:
+            rymap[node] = np.zeros(N + NX)
+            inq[node] = False
+        return rymap[node]
+
+    # Seed init (ref: :138-166).
+    for node, v in s.items():
+        if not G.has_vertex(node):
+            raise errors.InvalidParametersError(f"seed {node!r} not in graph")
+        ry = _entry(node)
+        ry[:N] = -alpha * v
+        ry[N:] = v
+        inq[node] = True
+        violating.append(node)
+    for node in s:
+        for onode in G.neighbors(node):
+            _entry(onode)
+    for node in s:
+        ry = rymap[node]
+        v = alpha * ry[N] / G.degree(node)
+        for onode in G.neighbors(node):
+            ro = rymap[onode]
+            ro[:N] += v
+            if not inq[onode] and np.any(np.abs(ro[:N]) > C * G.degree(onode)):
+                violating.append(onode)
+                inq[onode] = True
+
+    # Main push loop (ref: :195-250).
+    while violating:
+        node = violating.popleft()
+        ry = rymap[node]
+        dyp = D @ ry[:N]
+        ry[N:] += dyp[np.arange(NX) * NR]
+        ry[:N] = dyp[N - 1] * q
+        inq[node] = False
+
+        c = alpha / G.degree(node)
+        for onode in G.neighbors(node):
+            ryo = _entry(onode)
+            ryo[: N - 1] += c * dyp[: N - 1]
+            if not inq[onode]:
+                B = C * G.degree(onode)
+                if np.any(np.abs(ryo[: N - 1]) > B) or abs(ryo[N - 1]) > B:
+                    violating.append(onode)
+                    inq[onode] = True
+
+    y = {
+        node: ry[N:].copy()
+        for node, ry in rymap.items()
+        if ry[N] != 0
+    }
+    return y, x
+
+
+def find_local_cluster(
+    G: Graph,
+    seeds: Iterable[Hashable],
+    alpha: float = 0.85,
+    gamma: float = 5.0,
+    epsilon: float = 0.001,
+    NX: int = 4,
+    recursive: bool = False,
+) -> Tuple[Set, float]:
+    """Seeded community detection by sweep-cut conductance minimization over
+    the TD-PPR diffusion (ref: ml/graph/local_computations.hpp:288-374).
+    Returns (cluster, conductance)."""
+    currentcond = -1.0
+    cluster: Set = set(seeds)
+    Gvol = G.num_edges()
+
+    while True:
+        s = {v: 1.0 / len(cluster) for v in cluster}
+        y, _ = time_dependent_ppr(G, s, alpha, gamma, epsilon, NX)
+
+        improve = False
+        for t in range(NX):
+            # Sweep order: descending degree-normalized diffusion (ref: :313-322).
+            vals = sorted(
+                ((-yv[t] / G.degree(node), node) for node, yv in y.items())
+            )
+            volS, cutS = 0, 0
+            bestcond, bestprefix = 1.0, 0
+            currentset: Set = set()
+            for i, (_, node) in enumerate(vals):
+                volS += G.degree(node)
+                for onode in G.neighbors(node):
+                    cutS += -1 if onode in currentset else 1
+                denom = min(volS, Gvol - volS)
+                condS = cutS / denom if denom > 0 else 1.0
+                if condS < bestcond:
+                    bestcond, bestprefix = condS, i
+                currentset.add(node)
+
+            if currentcond == -1 or bestcond < 0.999999 * currentcond:
+                improve = True
+                cluster = {node for _, node in vals[: bestprefix + 1]}
+                currentcond = bestcond
+
+        if not (recursive and improve):
+            break
+
+    return cluster, currentcond

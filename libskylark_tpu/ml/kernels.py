@@ -266,12 +266,13 @@ class Matern(Kernel):
     """Matérn kernel k(r) = 2^{1−ν}/Γ(ν) · (√(2ν)·r/l)^ν · K_ν(√(2ν)·r/l)
     (ref: ml/kernels.hpp:800-846; gram is TODO in the reference).
 
-    Half-integer ν ∈ {1/2, 3/2, 5/2} use the standard closed forms (pure XLA);
-    other ν fall back to scipy's Bessel K_ν on host."""
+    Half-integer ν ∈ {1/2, 3/2, 5/2} use the standard closed forms (pure XLA,
+    jittable); other ν fall back to scipy's Bessel K_ν on host — hence the
+    half-integer default."""
 
     kernel_type = "matern"
 
-    def __init__(self, N: int, nu: float = 1.0, l: float = 1.0):
+    def __init__(self, N: int, nu: float = 1.5, l: float = 1.0):
         super().__init__(N)
         self._nu = float(nu)
         self._l = float(l)

@@ -37,6 +37,7 @@ from libskylark_tpu.algorithms.precond import FunctionPrecond, IdPrecond
 from libskylark_tpu.base.context import Context
 from libskylark_tpu.base.params import Params
 from libskylark_tpu.ml.kernels import Kernel
+from libskylark_tpu.base.precision import with_solver_precision
 
 
 @dataclasses.dataclass
@@ -78,6 +79,7 @@ def _split_sizes(s: int, d: int, max_split: int) -> list[int]:
     return sizes
 
 
+@with_solver_precision
 def kernel_ridge(
     k: Kernel,
     X: jnp.ndarray,
@@ -97,6 +99,7 @@ def kernel_ridge(
     return jsl.cho_solve((L, True), Y if Y.ndim > 1 else Y[:, None])
 
 
+@with_solver_precision
 def approximate_kernel_ridge(
     k: Kernel,
     X: jnp.ndarray,
@@ -138,6 +141,7 @@ def approximate_kernel_ridge(
     return S, W
 
 
+@with_solver_precision
 def sketched_approximate_kernel_ridge(
     k: Kernel,
     X: jnp.ndarray,
@@ -206,6 +210,7 @@ class FeatureMapPrecond(FunctionPrecond):
         self.lam = lam
 
 
+@with_solver_precision
 def faster_kernel_ridge(
     k: Kernel,
     X: jnp.ndarray,
@@ -238,6 +243,7 @@ def faster_kernel_ridge(
     return A
 
 
+@with_solver_precision
 def large_scale_kernel_ridge(
     k: Kernel,
     X: jnp.ndarray,

@@ -1,0 +1,149 @@
+"""Hilbert-space model: linear coefficients on top of feature maps.
+
+TPU-native analog of ref: ml/model.hpp:50-277 (``hilbert_model_t``): a
+coefficient matrix plus a list of serialized feature transforms. Prediction
+applies each stored map to the input, scales by √(s_j/d) when the maps were
+scaled during training (the reference's ``_scale_maps`` convention,
+ref: model.hpp:176-178), accumulates the per-block linear pieces, and decodes
+classification outputs by sign/argmax (ref: model.hpp:190-210).
+
+Save/load round-trips through JSON with every feature map embedded as its
+(seed, counter) serialization — the model file fully determines prediction,
+exactly like the reference's ptree model files (ref: model.hpp:103-137).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from libskylark_tpu import __version__
+from libskylark_tpu.base import errors
+from libskylark_tpu.sketch import ROWWISE, SketchTransform, deserialize_sketch
+
+
+class HilbertModel:
+    """Linear-on-features model (ref: ml/model.hpp:50)."""
+
+    def __init__(
+        self,
+        maps: Sequence[SketchTransform],
+        scale_maps: bool,
+        num_features: int,
+        num_outputs: int,
+        regression: bool,
+        input_size: Optional[int] = None,
+        coef: Optional[jnp.ndarray] = None,
+    ):
+        self.maps = list(maps)
+        self.scale_maps = bool(scale_maps)
+        self.regression = bool(regression)
+        self.starts = []
+        nf = 0
+        for m in self.maps:
+            self.starts.append(nf)
+            nf += m.sketch_dim
+        if self.maps and nf != num_features:
+            raise errors.InvalidParametersError(
+                f"feature maps produce {nf} features, expected {num_features}"
+            )
+        self.num_features = int(num_features)
+        self.num_outputs = int(num_outputs)
+        self.input_size = int(
+            input_size
+            if input_size is not None
+            else (self.maps[0].input_dim if self.maps else num_features)
+        )
+        self.coef = (
+            jnp.zeros((self.num_features, self.num_outputs), jnp.float32)
+            if coef is None
+            else jnp.asarray(coef)
+        )
+
+    # -- prediction (ref: model.hpp:146-210) --
+
+    def decision_values(self, X) -> jnp.ndarray:
+        """DV = Σⱼ scaleⱼ·Zⱼ(X)·Wⱼ — the raw scores (n, k)."""
+        X = jnp.asarray(X)
+        if not self.maps:
+            return X @ self.coef
+        d = self.input_size
+        DV = jnp.zeros((X.shape[0], self.num_outputs), X.dtype)
+        for m, start in zip(self.maps, self.starts):
+            sj = m.sketch_dim
+            Z = m.apply(X, ROWWISE)
+            if self.scale_maps:
+                Z = Z * math.sqrt(sj / d)
+            DV = DV + Z @ self.coef[start : start + sj]
+        return DV
+
+    def predict(self, X):
+        """Returns (labels, decision_values). Regression: labels are the
+        decision values. Classification: sign for one output, argmax column
+        index otherwise (ref: model.hpp:190-210)."""
+        DV = self.decision_values(X)
+        if self.regression:
+            return DV, DV
+        if self.num_outputs == 1:
+            labels = jnp.where(DV[:, 0] >= 0, 1, -1)
+        else:
+            labels = jnp.argmax(DV, axis=1)
+        return labels, DV
+
+    # -- serialization (ref: model.hpp:103-137,221-240) --
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "skylark_object_type": "model:linear-on-features",
+            "skylark_version": __version__,
+            "num_features": self.num_features,
+            "num_outputs": self.num_outputs,
+            "input_size": self.input_size,
+            "regression": self.regression,
+            "feature_mapping": {
+                "number_maps": len(self.maps),
+                "scale_maps": self.scale_maps,
+                "maps": [m.to_dict() for m in self.maps],
+            },
+            "coef_matrix": np.asarray(self.coef).tolist(),
+        }
+
+    def save(self, fname: str, header: str = "") -> None:
+        with open(fname, "w") as f:
+            if header:
+                for line in header.rstrip("\n").split("\n"):
+                    f.write(f"# {line}\n" if not line.startswith("#") else line + "\n")
+            json.dump(self.to_dict(), f)
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "HilbertModel":
+        fm = d["feature_mapping"]
+        maps = [deserialize_sketch(m) for m in fm["maps"]]
+        return HilbertModel(
+            maps,
+            bool(fm["scale_maps"]),
+            int(d["num_features"]),
+            int(d["num_outputs"]),
+            bool(d["regression"]),
+            input_size=int(d["input_size"]),
+            coef=jnp.asarray(d["coef_matrix"], jnp.float32),
+        )
+
+    @staticmethod
+    def load(fname_or_json: Union[str, dict]) -> "HilbertModel":
+        """Load from a file path, a JSON string, or a dict. Files may start
+        with '#' comment lines (ref: model.hpp:85-92)."""
+        if isinstance(fname_or_json, dict):
+            return HilbertModel.from_dict(fname_or_json)
+        s = fname_or_json
+        if "\n" in s or s.lstrip().startswith("{"):
+            text = s
+        else:
+            with open(s) as f:
+                text = f.read()
+        lines = [l for l in text.split("\n") if not l.startswith("#")]
+        return HilbertModel.from_dict(json.loads("\n".join(lines)))

@@ -19,8 +19,10 @@ import jax.random as jr
 import numpy as np
 
 from libskylark_tpu.base.context import Context
+from libskylark_tpu.base.precision import with_solver_precision
 
 
+@with_solver_precision
 def condest(
     A: jnp.ndarray,
     context: Context,

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from libskylark_tpu.base import errors
 from libskylark_tpu.base.context import Context
 from libskylark_tpu.base.params import Params
+from libskylark_tpu.base.precision import with_solver_precision
 
 
 @dataclasses.dataclass
@@ -34,6 +35,7 @@ class ApproximateSVDParams(Params):
     skip_qr: bool = False
 
 
+@with_solver_precision
 def power_iteration(
     A: jnp.ndarray,
     Q: jnp.ndarray,
@@ -54,6 +56,7 @@ def power_iteration(
     return Q
 
 
+@with_solver_precision
 def approximate_svd(
     A: jnp.ndarray,
     rank: int,
@@ -105,6 +108,7 @@ def approximate_svd(
     return U, S[:k], Vt[:k, :].T
 
 
+@with_solver_precision
 def approximate_symmetric_svd(
     A: jnp.ndarray,
     rank: int,

@@ -1,0 +1,91 @@
+"""Graph-algorithm tests: spectral embedding separates a planted partition;
+TD-PPR diffusion is localized and seeded; sweep cut recovers a planted
+community. Mirrors the reference's graph drivers (skylark_graph_se,
+skylark_community) as library-level checks."""
+
+import numpy as np
+import pytest
+
+from libskylark_tpu import Context, ml
+from libskylark_tpu.nla.svd import ApproximateSVDParams
+
+
+def _two_blocks(n_per=20, p_in=0.9, p_out=0.05, seed=0):
+    """Planted 2-community graph."""
+    rng = np.random.default_rng(seed)
+    G = ml.Graph()
+    n = 2 * n_per
+    for i in range(n):
+        for j in range(i + 1, n):
+            same = (i < n_per) == (j < n_per)
+            p = p_in if same else p_out
+            if rng.random() < p:
+                G.add_edge(i, j)
+    return G
+
+
+class TestGraph:
+    def test_basic_counts(self):
+        G = ml.Graph([(0, 1), (1, 2), (2, 0)])
+        assert G.num_vertices() == 3
+        assert G.num_edges() == 6  # both directions, ref convention
+        assert G.degree(1) == 2
+
+    def test_no_self_loops_no_dups(self):
+        G = ml.Graph([(0, 0), (0, 1), (1, 0)])
+        assert G.num_edges() == 2
+
+    def test_adjacency_matrix(self):
+        G = ml.Graph([(0, 1), (1, 2)])
+        A, idx = G.adjacency_matrix()
+        assert A.sum() == 4
+        np.testing.assert_array_equal(A, A.T)
+
+
+class TestApproximateASE:
+    def test_separates_blocks(self):
+        G = _two_blocks()
+        X, idx = ml.approximate_ase(
+            G, 2, Context(seed=5), ApproximateSVDParams(num_iterations=3)
+        )
+        X = np.asarray(X)
+        # 2nd embedding coordinate splits the two blocks (1st is the
+        # Perron direction).
+        side = X[:, 1] > 0
+        labels = np.array([v < 20 for v in idx])
+        agree = (side == labels).mean()
+        assert agree > 0.9 or agree < 0.1
+
+
+class TestTimeDependentPPR:
+    def test_localized_and_seeded(self):
+        G = _two_blocks(seed=3)
+        y, x = ml.time_dependent_ppr(G, {0: 1.0})
+        assert len(x) == 4
+        assert all(xi >= 0 and xi <= 5.0 for xi in x)
+        assert 0 in y
+        # Mass concentrates on the seed's community.
+        in_mass = sum(v[0] for n, v in y.items() if n < 20)
+        out_mass = sum(v[0] for n, v in y.items() if n >= 20)
+        assert in_mass > out_mass
+
+    def test_seed_not_in_graph_raises(self):
+        G = ml.Graph([(0, 1)])
+        with pytest.raises(Exception):
+            ml.time_dependent_ppr(G, {99: 1.0})
+
+
+class TestFindLocalCluster:
+    def test_recovers_planted_community(self):
+        G = _two_blocks(seed=7)
+        cluster, cond = ml.find_local_cluster(G, {0, 1, 2})
+        inside = sum(1 for v in cluster if v < 20)
+        assert len(cluster) > 0
+        assert inside / len(cluster) > 0.8
+        assert 0 <= cond <= 1
+
+    def test_recursive_does_not_worsen(self):
+        G = _two_blocks(seed=9)
+        _, cond1 = ml.find_local_cluster(G, {0})
+        _, cond2 = ml.find_local_cluster(G, {0}, recursive=True)
+        assert cond2 <= cond1 + 1e-12
