@@ -1,0 +1,101 @@
+"""Headline benchmark: dense JLT sketch-apply throughput (GB/s/chip).
+
+BASELINE.json config 1 scaled to saturate one chip: rowwise JLT apply
+A·Sᵀ on a dense matrix (ref: sketch/JLT.hpp +
+sketch/dense_transform_Elemental_local.hpp). The sketch operator is
+generated on the fly from (seed, counter) and fused into the matmul, so
+effective bytes = read(A) + write(SA); the reference has no published
+numbers (BASELINE.md), so ``vs_baseline`` is the ratio against the
+previous round's recorded value when a BENCH_r*.json exists, else 1.0.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def run(m: int = 8192, n: int = 8192, s: int = 1024, repeats: int = 5):
+    from jax import lax
+
+    from libskylark_tpu.base.context import Context
+    from libskylark_tpu.sketch import JLT, ROWWISE
+
+    ctx = Context(seed=0)
+    jlt = JLT(n, s, ctx)
+
+    rng = np.random.default_rng(1)
+    A = jax.device_put(jnp.asarray(
+        rng.standard_normal((m, n), dtype=np.float32)))
+
+    # K on-device apply iterations chained by a data dependence (so XLA
+    # cannot CSE them), synced by a scalar host readback. Per-iteration
+    # time = slope between two K values — cancels dispatch/tunnel
+    # round-trip latency, which on this platform `block_until_ready`
+    # does not capture.
+    def iterate(X, K):
+        def body(_, acc):
+            SA = jlt.apply(X + acc * 1e-30, ROWWISE)
+            return jnp.float32(SA[0, 0])
+
+        return lax.fori_loop(0, K, body, jnp.float32(0.0))
+
+    k1, k2 = 2, 12
+    f1 = jax.jit(lambda X: iterate(X, k1))
+    f2 = jax.jit(lambda X: iterate(X, k2))
+    float(f1(A))  # compile + warm
+    float(f2(A))
+
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(f1(A))
+        t1 = time.perf_counter()
+        float(f2(A))
+        t2 = time.perf_counter()
+        best = min(best, ((t2 - t1) - (t1 - t0)) / (k2 - k1))
+
+    bytes_moved = 4 * (m * n + m * s)
+    return bytes_moved / best / 1e9, best
+
+
+def _previous_value() -> float | None:
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = []
+    for p in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        mm = re.search(r"BENCH_r(\d+)\.json$", p)
+        if not mm:
+            continue
+        try:
+            with open(p) as fh:
+                rec = json.load(fh)
+            rounds.append((int(mm.group(1)), float(rec["value"])))
+        except Exception:
+            continue
+    return max(rounds)[1] if rounds else None
+
+
+def main():
+    gbps, secs = run()
+    prev = _previous_value()
+    vs = gbps / prev if prev else 1.0
+    print(json.dumps({
+        "metric": "jlt_sketch_apply_GBps_per_chip",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
