@@ -29,6 +29,7 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import jax.scipy.linalg as jsl
 import numpy as np
 
 from libskylark_tpu.algorithms.prox import Loss, Regularizer
@@ -164,10 +165,16 @@ class BlockADMMSolver:
         if regression:
             k = 1
         else:
+            Yh = np.asarray(Y)
+            if Yh.min() < 0:
+                raise errors.InvalidParametersError(
+                    "classification labels must be integers in 0..k-1 "
+                    "(recode ±1 labels to 0/1)"
+                )
             k = (
                 int(num_targets)
                 if num_targets is not None
-                else int(np.max(np.asarray(Y))) + 1
+                else int(Yh.max()) + 1
             )
         D = self.num_features
         P = len(self.block_sizes)  # feature-partition consensus count
@@ -186,7 +193,7 @@ class BlockADMMSolver:
             Z = self._block_features(X, j)
             sj = self.block_sizes[j]
             caches.append(
-                jnp.linalg.inv(Z.T @ Z + jnp.eye(sj, dtype=dt))
+                jsl.cho_factor(Z.T @ Z + jnp.eye(sj, dtype=dt))
             )
             if self.cache_transforms:
                 Zs.append(Z)
@@ -217,7 +224,7 @@ class BlockADMMSolver:
                 Z = Zs[j] if self.cache_transforms else self._block_features(X, j)
                 wbar_output = wbar_output + (Z @ Wbar[sl]).T
                 rhs = Wbar[sl] - mu_ij[sl] + ZtObar_ij[sl] + Z.T @ dsum
-                Wi_J = caches[j] @ rhs               # ref: :475-476
+                Wi_J = jsl.cho_solve(caches[j], rhs)  # ref: :475-476
                 o = (Z @ Wi_J).T                     # (k, n); ref: :478-480
                 new_mu_ij = new_mu_ij.at[sl].add(Wi_J)
                 new_ZtObar = new_ZtObar.at[sl].set(Z.T @ o.T)

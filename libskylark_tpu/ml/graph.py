@@ -115,9 +115,9 @@ def _min_chebyshev_order(epsilon: float, gamma: float) -> int:
         from scipy.special import iv
 
         minN = 10
-        C = 20.0 * math.sqrt(minN) * math.exp(-gamma / 2.0)
+        C = 20.0 * math.exp(-gamma / 2.0)
         while (
-            C * iv(minN, gamma) * 0.8**minN
+            C * math.sqrt(minN) * iv(minN, gamma) * 0.8**minN
             > epsilon / (gamma * (1 + (2 / math.pi) * math.log(minN - 1)))
         ):
             minN += 1
@@ -138,7 +138,11 @@ def _diffusion_matrix(N: int, gamma: float) -> Tuple[np.ndarray, np.ndarray]:
         q = Q[:, N - 1].copy()
         D = np.empty((N, N))
         D[N - 1, :] = q
-        D[: N - 1, :] = np.linalg.pinv(R[: N - 1, : N - 1]) @ Q[:, : N - 1].T
+        from scipy.linalg import solve_triangular
+
+        D[: N - 1, :] = solve_triangular(
+            R[: N - 1, : N - 1], Q[:, : N - 1].T
+        )
         _D_CACHE[key] = (D, q)
     return _D_CACHE[key]
 
@@ -259,7 +263,8 @@ def find_local_cluster(
         for t in range(NX):
             # Sweep order: descending degree-normalized diffusion (ref: :313-322).
             vals = sorted(
-                ((-yv[t] / G.degree(node), node) for node, yv in y.items())
+                ((-yv[t] / G.degree(node), node) for node, yv in y.items()),
+                key=lambda sv: sv[0],
             )
             volS, cutS = 0, 0
             bestcond, bestprefix = 1.0, 0
