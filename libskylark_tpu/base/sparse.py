@@ -1,0 +1,256 @@
+"""Local sparse matrix (CSC) and sparse×dense products.
+
+TPU-native analog of ref: base/sparse_matrix.hpp:23-346 (``sparse_matrix_t``):
+a CSC container with zero-copy attach from scipy buffers, duplicate-summing
+COO construction (ref: set():136), transpose (ref: Transpose:303) and
+read-only column views (ref: view:256).
+
+The device-side representation is COO triplets — on TPU, sparse×dense
+products are dataflow ``segment_sum`` contractions over the nonzeros (the
+XLA-friendly formulation of the reference's CSC scatter loops,
+ref: base/Gemm.hpp:335-519), so the CSC column pointers stay host-side and
+the (row, col, value) arrays are what lands in HBM. All nnz-shaped arrays
+have static shapes, so products are jittable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from libskylark_tpu.base import errors
+
+
+class SparseMatrix:
+    """Immutable local sparse matrix, CSC on host, COO on device.
+
+    Construction never copies the supplied numpy buffers (the reference's
+    external-ownership ``attach`` semantics, ref: base/sparse_matrix.hpp:82);
+    device placement happens lazily on first ``coo()``.
+    """
+
+    def __init__(
+        self,
+        colptr: np.ndarray,
+        rowind: np.ndarray,
+        values: np.ndarray,
+        shape: Tuple[int, int],
+    ):
+        self._colptr = np.asarray(colptr, dtype=np.int64)
+        self._rowind = np.asarray(rowind, dtype=np.int32)
+        self._values = np.asarray(values)
+        self._shape = (int(shape[0]), int(shape[1]))
+        if len(self._colptr) != self._shape[1] + 1:
+            raise errors.InvalidParametersError(
+                f"colptr length {len(self._colptr)} != width+1 "
+                f"{self._shape[1] + 1}"
+            )
+        if len(self._rowind) != len(self._values):
+            raise errors.InvalidParametersError("rowind/values length mismatch")
+        self._coo_cache: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None
+
+    # -- constructors --
+
+    @classmethod
+    def from_scipy(cls, A) -> "SparseMatrix":
+        """Attach a ``scipy.sparse`` matrix (converted to CSC if needed;
+        zero-copy when already CSC — ref: python sketch.py _ScipyAdapter)."""
+        import scipy.sparse as sp
+
+        A = A.tocsc()
+        return cls(A.indptr, A.indices, A.data, A.shape)
+
+    @classmethod
+    def from_coo(
+        cls,
+        rows,
+        cols,
+        values,
+        shape: Tuple[int, int],
+    ) -> "SparseMatrix":
+        """Duplicate-summing COO→CSC build (ref: sparse_matrix.hpp set():136)."""
+        import scipy.sparse as sp
+
+        A = sp.coo_matrix(
+            (np.asarray(values), (np.asarray(rows), np.asarray(cols))),
+            shape=shape,
+        ).tocsc()
+        A.sum_duplicates()
+        return cls(A.indptr, A.indices, A.data, A.shape)
+
+    @classmethod
+    def from_dense(cls, A, threshold: float = 0.0) -> "SparseMatrix":
+        import scipy.sparse as sp
+
+        A = np.asarray(A)
+        if threshold > 0.0:
+            A = np.where(np.abs(A) > threshold, A, 0.0)
+        return cls.from_scipy(sp.csc_matrix(A))
+
+    # -- queries (ref: base/query.hpp Height/Width) --
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return self._shape
+
+    @property
+    def height(self) -> int:
+        return self._shape[0]
+
+    @property
+    def width(self) -> int:
+        return self._shape[1]
+
+    @property
+    def nnz(self) -> int:
+        return len(self._values)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def device_dtype(self):
+        """dtype of the device-side values (f64 host buffers land as f32 —
+        the TPU-native precision policy; pass an explicit dtype to ``coo``
+        to override)."""
+        return jnp.float32 if self._values.dtype == np.float64 else jnp.dtype(
+            self._values.dtype
+        )
+
+    @property
+    def indptr(self) -> np.ndarray:
+        return self._colptr
+
+    @property
+    def indices(self) -> np.ndarray:
+        return self._rowind
+
+    @property
+    def data(self) -> np.ndarray:
+        return self._values
+
+    # -- conversions --
+
+    def coo(self, dtype=None) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Device COO triplets (rows, cols, vals); cached after first call."""
+        if self._coo_cache is None or (
+            dtype is not None
+            and self._coo_cache[2].dtype != jnp.dtype(dtype)
+        ):
+            counts = np.diff(self._colptr)
+            cols = np.repeat(
+                np.arange(self.width, dtype=np.int32), counts
+            )
+            vals = self._values
+            if dtype is not None:
+                vals = vals.astype(np.dtype(dtype))
+            elif vals.dtype == np.float64:
+                vals = vals.astype(np.float32)
+            self._coo_cache = (
+                jnp.asarray(self._rowind),
+                jnp.asarray(cols),
+                jnp.asarray(vals),
+            )
+        return self._coo_cache
+
+    def todense(self, dtype=None) -> jax.Array:
+        r, c, v = self.coo(dtype)
+        return jnp.zeros(self._shape, v.dtype).at[r, c].add(v)
+
+    def to_scipy(self):
+        import scipy.sparse as sp
+
+        return sp.csc_matrix(
+            (self._values, self._rowind, self._colptr), shape=self._shape
+        )
+
+    # -- structural ops --
+
+    def transpose(self) -> "SparseMatrix":
+        """(ref: base/sparse_matrix.hpp Transpose:303)"""
+        return SparseMatrix.from_scipy(self.to_scipy().T)
+
+    @property
+    def T(self) -> "SparseMatrix":
+        return self.transpose()
+
+    def column_view(self, j0: int, j1: int) -> "SparseMatrix":
+        """Read-only view of columns [j0, j1) (ref: view:256) — shares the
+        rowind/values buffers."""
+        lo, hi = self._colptr[j0], self._colptr[j1]
+        return SparseMatrix(
+            self._colptr[j0 : j1 + 1] - lo,
+            self._rowind[lo:hi],
+            self._values[lo:hi],
+            (self.height, j1 - j0),
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"SparseMatrix({self.height}x{self.width}, nnz={self.nnz}, "
+            f"dtype={self.dtype})"
+        )
+
+
+def spmm(A: SparseMatrix, B) -> jax.Array:
+    """A @ B with A sparse (h×w), B dense (w×k) → dense (h×k).
+
+    Segment-sum over nonzeros (ref: base/Gemm.hpp:335-519 CSC kernels):
+    out[r] += v · B[c] for each (r, c, v)."""
+    B = jnp.asarray(B)
+    squeeze = B.ndim == 1
+    if squeeze:
+        B = B[:, None]
+    if B.shape[0] != A.width:
+        raise errors.InvalidParametersError(
+            f"spmm: A is {A.shape}, B is {B.shape}"
+        )
+    r, c, v = A.coo(B.dtype)
+    out = jax.ops.segment_sum(
+        v[:, None] * B[c], r, num_segments=A.height
+    )
+    return out[:, 0] if squeeze else out
+
+
+def spmm_t(A: SparseMatrix, B) -> jax.Array:
+    """Aᵀ @ B with A sparse (h×w), B dense (h×k) → dense (w×k)."""
+    B = jnp.asarray(B)
+    squeeze = B.ndim == 1
+    if squeeze:
+        B = B[:, None]
+    if B.shape[0] != A.height:
+        raise errors.InvalidParametersError(
+            f"spmm_t: A is {A.shape}, B is {B.shape}"
+        )
+    r, c, v = A.coo(B.dtype)
+    out = jax.ops.segment_sum(
+        v[:, None] * B[r], c, num_segments=A.width
+    )
+    return out[:, 0] if squeeze else out
+
+
+def gemm(A, B, transpose_a: bool = False) -> jax.Array:
+    """Unified dense/sparse matmul (ref: base/Gemm.hpp's overload set).
+
+    Sparse operands use the segment-sum kernels; dense×dense is a plain
+    jnp matmul (sharded inputs flow through, XLA inserts collectives)."""
+    a_sp = isinstance(A, SparseMatrix)
+    b_sp = isinstance(B, SparseMatrix)
+    if a_sp and b_sp:
+        # sparse×sparse stays on host (ref: CombBLAS path — out of TPU scope)
+        out = (A.to_scipy().T if transpose_a else A.to_scipy()) @ B.to_scipy()
+        return SparseMatrix.from_scipy(out)
+    if a_sp:
+        return spmm_t(A, B) if transpose_a else spmm(A, B)
+    if b_sp:
+        A = jnp.asarray(A)
+        if transpose_a:
+            A = A.T
+        # A @ B = (Bᵀ @ Aᵀ)ᵀ
+        return spmm_t(B, A.T).T
+    A = jnp.asarray(A)
+    return (A.T if transpose_a else A) @ jnp.asarray(B)

@@ -41,6 +41,17 @@ from libskylark_tpu.base.distance import (
 _KERNEL_REGISTRY: dict[str, type["Kernel"]] = {}
 
 
+def _as_dense(X) -> jnp.ndarray:
+    """Accept dense arrays or :class:`SparseMatrix` (Gram matrices are dense
+    regardless, so sparse inputs densify on device; ref: ml/kernels.hpp gram
+    overloads across matrix types)."""
+    from libskylark_tpu.base.sparse import SparseMatrix
+
+    if isinstance(X, SparseMatrix):
+        return X.todense()
+    return jnp.asarray(X)
+
+
 def _register(cls: type["Kernel"]) -> type["Kernel"]:
     _KERNEL_REGISTRY[cls.kernel_type] = cls
     return cls
@@ -118,8 +129,8 @@ class Linear(Kernel):
     kernel_type = "linear"
 
     def gram(self, X, Y=None):
-        X = jnp.asarray(X)
-        Y = X if Y is None else jnp.asarray(Y)
+        X = _as_dense(X)
+        Y = X if Y is None else _as_dense(Y)
         return X @ Y.T
 
     def create_rft(self, S, context, tag="regular"):
@@ -149,8 +160,8 @@ class Gaussian(Kernel):
         return self._sigma
 
     def gram(self, X, Y=None):
-        X = jnp.asarray(X)
-        Y = X if Y is None else jnp.asarray(Y)
+        X = _as_dense(X)
+        Y = X if Y is None else _as_dense(Y)
         D = euclidean_distance_matrix(X, Y)
         return jnp.exp(-D / (2.0 * self._sigma**2))
 
@@ -183,8 +194,8 @@ class Polynomial(Kernel):
         self._gamma = float(gamma)
 
     def gram(self, X, Y=None):
-        X = jnp.asarray(X)
-        Y = X if Y is None else jnp.asarray(Y)
+        X = _as_dense(X)
+        Y = X if Y is None else _as_dense(Y)
         return (self._gamma * (X @ Y.T) + self._c) ** self._q
 
     def create_rft(self, S, context, tag="regular"):
@@ -211,8 +222,8 @@ class Laplacian(Kernel):
         self._sigma = float(sigma)
 
     def gram(self, X, Y=None):
-        X = jnp.asarray(X)
-        Y = X if Y is None else jnp.asarray(Y)
+        X = _as_dense(X)
+        Y = X if Y is None else _as_dense(Y)
         D = l1_distance_matrix(X, Y)
         return jnp.exp(-D / self._sigma)
 
@@ -243,8 +254,8 @@ class ExpSemigroup(Kernel):
         self._beta = float(beta)
 
     def gram(self, X, Y=None):
-        X = jnp.asarray(X)
-        Y = X if Y is None else jnp.asarray(Y)
+        X = _as_dense(X)
+        Y = X if Y is None else _as_dense(Y)
         S = jnp.sqrt(jnp.maximum(X[:, None, :] + Y[None, :, :], 0.0))
         return jnp.exp(-self._beta * jnp.sum(S, axis=-1))
 
@@ -278,8 +289,8 @@ class Matern(Kernel):
         self._l = float(l)
 
     def gram(self, X, Y=None):
-        X = jnp.asarray(X)
-        Y = X if Y is None else jnp.asarray(Y)
+        X = _as_dense(X)
+        Y = X if Y is None else _as_dense(Y)
         r = jnp.sqrt(euclidean_distance_matrix(X, Y))
         nu, l = self._nu, self._l
         if nu == 0.5:

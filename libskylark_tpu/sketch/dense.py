@@ -79,6 +79,20 @@ class DenseTransform(SketchTransform):
         S = self.s_panel(0, self._N, A.dtype)
         return A @ S.T
 
+    # -- sparse input (ref: sketch/dense_transform_Mixed.hpp:19) --
+
+    def _apply_columnwise_sparse(self, A) -> jnp.ndarray:
+        from libskylark_tpu.base.sparse import spmm_t
+
+        S = self.s_panel(0, self._N, A.device_dtype)
+        return spmm_t(A, S.T).T          # S·A = (Aᵀ·Sᵀ)ᵀ
+
+    def _apply_rowwise_sparse(self, A) -> jnp.ndarray:
+        from libskylark_tpu.base.sparse import spmm
+
+        S = self.s_panel(0, self._N, A.device_dtype)
+        return spmm(A, S.T)              # A·Sᵀ
+
     # -- blocked (memory-bounded) apply: scan over column panels of S --
 
     def _panel_schedule(self, blocksize: int):

@@ -73,6 +73,20 @@ class RFT(SketchTransform):
         W = self.w_panel(0, self._N, A.dtype)
         return self._featurize(A @ W.T, feature_axis=1)
 
+    # -- sparse input: project with the segment-sum spmm kernels --
+
+    def _apply_columnwise_sparse(self, A) -> jnp.ndarray:
+        from libskylark_tpu.base.sparse import spmm_t
+
+        W = self.w_panel(0, self._N, A.device_dtype)
+        return self._featurize(spmm_t(A, W.T).T, feature_axis=0)
+
+    def _apply_rowwise_sparse(self, A) -> jnp.ndarray:
+        from libskylark_tpu.base.sparse import spmm
+
+        W = self.w_panel(0, self._N, A.device_dtype)
+        return self._featurize(spmm(A, W.T), feature_axis=1)
+
 
 @register
 class GaussianRFT(RFT):

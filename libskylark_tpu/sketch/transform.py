@@ -102,8 +102,25 @@ class SketchTransform:
 
         COLUMNWISE: A is (N, m) -> (S, m).  ROWWISE: A is (m, N) -> (m, S).
         Works on any jax.Array regardless of sharding; XLA handles the
-        distributed contraction.
+        distributed contraction. A :class:`~libskylark_tpu.base.sparse.SparseMatrix`
+        input routes to the transform's sparse kernel (ref: the reference's
+        per-(input,output)-type specializations, e.g.
+        sketch/hash_transform_local_sparse.hpp) and produces a dense result.
         """
+        from libskylark_tpu.base.sparse import SparseMatrix
+
+        if isinstance(A, SparseMatrix):
+            if dimension == Dimension.COLUMNWISE:
+                if A.height != self._N:
+                    raise errors.SketchError(
+                        f"columnwise apply expects {self._N} rows, got {A.shape}"
+                    )
+                return self._apply_columnwise_sparse(A)
+            if A.width != self._N:
+                raise errors.SketchError(
+                    f"rowwise apply expects {self._N} cols, got {A.shape}"
+                )
+            return self._apply_rowwise_sparse(A)
         A = jnp.asarray(A)
         if A.ndim == 1:
             A = A[:, None] if dimension == COLUMNWISE else A[None, :]
@@ -128,6 +145,16 @@ class SketchTransform:
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
         raise errors.NotImplementedYetError(
             f"{self.sketch_type}: rowwise apply not implemented"
+        )
+
+    def _apply_columnwise_sparse(self, A) -> jnp.ndarray:
+        raise errors.NotImplementedYetError(
+            f"{self.sketch_type}: columnwise sparse apply not implemented"
+        )
+
+    def _apply_rowwise_sparse(self, A) -> jnp.ndarray:
+        raise errors.NotImplementedYetError(
+            f"{self.sketch_type}: rowwise sparse apply not implemented"
         )
 
     # -- serialization (ref: sketch_transform_data.hpp:64-71 add_common) --

@@ -43,6 +43,26 @@ class UST(SketchTransform):
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
         return A[:, self.sample_indices()]
 
+    # -- sparse input: host-side row/column gather (sampling preserves
+    # sparsity; the small sampled result is densified on device,
+    # ref: sketch/UST_Elemental.hpp:69-87 local gather) --
+
+    def _apply_columnwise_sparse(self, A) -> jnp.ndarray:
+        import numpy as np
+
+        idx = np.asarray(self.sample_indices())
+        return jnp.asarray(
+            A.to_scipy()[idx, :].toarray().astype(A.device_dtype)
+        )
+
+    def _apply_rowwise_sparse(self, A) -> jnp.ndarray:
+        import numpy as np
+
+        idx = np.asarray(self.sample_indices())
+        return jnp.asarray(
+            A.to_scipy()[:, idx].toarray().astype(A.device_dtype)
+        )
+
     def _extra_params(self) -> dict[str, Any]:
         return {"replace": self._replace}
 

@@ -1,0 +1,189 @@
+"""Sparse matrix layer + sparse sketch applies.
+
+Oracle strategy (ref: tests/unit/SparseSketchApplyElementalTest.cpp,
+tests/unit/LocalSparseSketchApply.cpp): the same-seed dense apply is the
+oracle — sparse-input applies must match the dense-input apply of the
+densified matrix to 1e-4 (ref tolerance: tests/unit/test_utils.hpp:48).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from libskylark_tpu.base import Context, SparseMatrix, gemm, spmm, spmm_t
+from libskylark_tpu.sketch import (
+    COLUMNWISE,
+    CT,
+    CWT,
+    JLT,
+    MMT,
+    ROWWISE,
+    UST,
+    WZT,
+    GaussianRFT,
+    LaplacianRFT,
+)
+
+TOL = 1e-4
+
+
+def _rand_sparse(m, n, density=0.15, seed=0):
+    rng = np.random.default_rng(seed)
+    A = sp.random(
+        m, n, density=density, format="csc", random_state=rng,
+        data_rvs=rng.standard_normal,
+    )
+    return SparseMatrix.from_scipy(A.astype(np.float32))
+
+
+class TestSparseMatrix:
+    def test_scipy_round_trip(self):
+        A = _rand_sparse(23, 17)
+        B = A.to_scipy()
+        assert np.allclose(
+            B.toarray(), np.asarray(A.todense()), atol=TOL
+        )
+        assert A.shape == (23, 17)
+        assert A.nnz == B.nnz
+
+    def test_from_coo_sums_duplicates(self):
+        A = SparseMatrix.from_coo(
+            [0, 0, 1], [0, 0, 2], [1.0, 2.0, 5.0], (2, 3)
+        )
+        D = np.asarray(A.todense())
+        assert D[0, 0] == pytest.approx(3.0)
+        assert D[1, 2] == pytest.approx(5.0)
+        assert A.nnz == 2
+
+    def test_transpose(self):
+        A = _rand_sparse(9, 14)
+        assert np.allclose(
+            np.asarray(A.T.todense()), np.asarray(A.todense()).T, atol=TOL
+        )
+
+    def test_column_view_shares_buffers(self):
+        A = _rand_sparse(20, 12)
+        V = A.column_view(3, 8)
+        assert V.shape == (20, 5)
+        assert np.allclose(
+            np.asarray(V.todense()),
+            np.asarray(A.todense())[:, 3:8],
+            atol=TOL,
+        )
+        # view shares the underlying value buffer (attach semantics)
+        assert V.data.base is A.data or V.data.base is A.data.base
+
+    def test_attach_zero_copy(self):
+        B = sp.random(8, 8, density=0.3, format="csc").astype(np.float64)
+        A = SparseMatrix.from_scipy(B)
+        assert A.data is B.data  # no copy on attach
+
+    def test_from_dense_threshold(self):
+        M = np.array([[0.5, 1e-9], [0.0, -2.0]])
+        A = SparseMatrix.from_dense(M, threshold=1e-6)
+        assert A.nnz == 2
+
+
+class TestSparseProducts:
+    def test_spmm_matches_dense(self):
+        A = _rand_sparse(31, 17, seed=1)
+        B = np.random.default_rng(2).standard_normal((17, 5)).astype(np.float32)
+        got = np.asarray(spmm(A, B))
+        want = np.asarray(A.todense()) @ B
+        assert np.allclose(got, want, atol=TOL)
+
+    def test_spmm_t_matches_dense(self):
+        A = _rand_sparse(31, 17, seed=3)
+        B = np.random.default_rng(4).standard_normal((31, 4)).astype(np.float32)
+        got = np.asarray(spmm_t(A, B))
+        want = np.asarray(A.todense()).T @ B
+        assert np.allclose(got, want, atol=TOL)
+
+    def test_spmm_vector(self):
+        A = _rand_sparse(12, 9, seed=5)
+        x = np.random.default_rng(6).standard_normal(9).astype(np.float32)
+        got = np.asarray(spmm(A, x))
+        assert got.shape == (12,)
+        assert np.allclose(got, np.asarray(A.todense()) @ x, atol=TOL)
+
+    def test_gemm_dispatch(self):
+        A = _rand_sparse(10, 8, seed=7)
+        B = np.random.default_rng(8).standard_normal((8, 3)).astype(np.float32)
+        Ad = np.asarray(A.todense())
+        assert np.allclose(np.asarray(gemm(A, B)), Ad @ B, atol=TOL)
+        C = np.random.default_rng(9).standard_normal((10, 3)).astype(np.float32)
+        assert np.allclose(
+            np.asarray(gemm(A, C, transpose_a=True)), Ad.T @ C, atol=TOL
+        )
+        # dense × sparse
+        D = np.random.default_rng(10).standard_normal((5, 10)).astype(np.float32)
+        assert np.allclose(np.asarray(gemm(D, A)), D @ Ad, atol=TOL)
+        # sparse × sparse stays sparse
+        E = _rand_sparse(8, 6, seed=11)
+        out = gemm(A, E)
+        assert isinstance(out, SparseMatrix)
+        assert np.allclose(
+            np.asarray(out.todense()),
+            Ad @ np.asarray(E.todense()),
+            atol=TOL,
+        )
+
+
+@pytest.mark.parametrize(
+    "cls,kwargs",
+    [
+        (JLT, {}),
+        (CT, {"C": 1.0}),
+        (CWT, {}),
+        (MMT, {}),
+        (WZT, {"p": 1.5}),
+        (GaussianRFT, {"sigma": 1.3}),
+        (LaplacianRFT, {"sigma": 2.0}),
+        (UST, {}),
+    ],
+)
+class TestSparseApplyOracle:
+    """sparse-input apply == dense-input apply, same seed (the reference's
+    redundant-computation oracle)."""
+
+    def test_columnwise(self, cls, kwargs):
+        N, m, s = 40, 13, 12
+        A = _rand_sparse(N, m, seed=21)
+        T = cls(N, s, Context(seed=99), **kwargs)
+        got = np.asarray(T.apply(A, COLUMNWISE))
+        want = np.asarray(T.apply(A.todense(), COLUMNWISE))
+        assert got.shape == want.shape
+        assert np.allclose(got, want, atol=TOL)
+
+    def test_rowwise(self, cls, kwargs):
+        N, m, s = 40, 13, 12
+        A = _rand_sparse(m, N, seed=22)
+        T = cls(N, s, Context(seed=99), **kwargs)
+        got = np.asarray(T.apply(A, ROWWISE))
+        want = np.asarray(T.apply(A.todense(), ROWWISE))
+        assert got.shape == want.shape
+        assert np.allclose(got, want, atol=TOL)
+
+
+class TestSparseToSparse:
+    """hash sparse→sparse path (ref: hash_transform_local_sparse.hpp)."""
+
+    @pytest.mark.parametrize("cls", [CWT, MMT, WZT])
+    def test_matches_dense_path(self, cls):
+        N, m, s = 30, 11, 8
+        A = _rand_sparse(N, m, seed=33)
+        T = cls(N, s, Context(seed=5))
+        SA = T.apply_sparse(A, COLUMNWISE)
+        assert isinstance(SA, SparseMatrix)
+        want = np.asarray(T.apply(A.todense(), COLUMNWISE))
+        assert np.allclose(np.asarray(SA.todense()), want, atol=TOL)
+
+    def test_rowwise_sparse_output(self):
+        N, m, s = 30, 11, 8
+        A = _rand_sparse(m, N, seed=34)
+        T = CWT(N, s, Context(seed=6))
+        SA = T.apply_sparse(A, ROWWISE)
+        want = np.asarray(T.apply(A.todense(), ROWWISE))
+        assert np.allclose(np.asarray(SA.todense()), want, atol=TOL)
