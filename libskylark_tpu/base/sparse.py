@@ -136,24 +136,23 @@ class SparseMatrix:
     # -- conversions --
 
     def coo(self, dtype=None) -> Tuple[jax.Array, jax.Array, jax.Array]:
-        """Device COO triplets (rows, cols, vals); cached after first call."""
-        if self._coo_cache is None or (
-            dtype is not None
-            and self._coo_cache[2].dtype != jnp.dtype(dtype)
-        ):
+        """Device COO triplets (rows, cols, vals); cached per resolved dtype.
+
+        ``dtype=None`` always resolves to :meth:`device_dtype` (the f32
+        precision-policy default) — a cache left behind by an explicit-dtype
+        call is never returned for a default-dtype request."""
+        eff = jax.dtypes.canonicalize_dtype(
+            np.dtype(dtype) if dtype is not None else self.device_dtype
+        )
+        if self._coo_cache is None or self._coo_cache[2].dtype != eff:
             counts = np.diff(self._colptr)
             cols = np.repeat(
                 np.arange(self.width, dtype=np.int32), counts
             )
-            vals = self._values
-            if dtype is not None:
-                vals = vals.astype(np.dtype(dtype))
-            elif vals.dtype == np.float64:
-                vals = vals.astype(np.float32)
             self._coo_cache = (
                 jnp.asarray(self._rowind),
                 jnp.asarray(cols),
-                jnp.asarray(vals),
+                jnp.asarray(self._values, dtype=eff),
             )
         return self._coo_cache
 

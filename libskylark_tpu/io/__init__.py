@@ -1,0 +1,36 @@
+"""IO layer: libsvm / arc-list / HDF5 readers and writers, streaming sketch.
+
+TPU-native analog of the reference's IO stack (ref: utility/io/libsvm_io.hpp,
+utility/io/arc_list.hpp, utility/io/hdf5_io.hpp, ml/io.hpp,
+python-skylark/skylark/io.py, python-skylark/skylark/streaming.py).
+
+Where the reference reads on MPI rank 0 and scatters chunks, the TPU-native
+shape is: parse on the host into numpy/CSC buffers, then let the caller
+``jax.device_put`` with a sharding — the host is the reference's "root" and
+device placement is the scatter.
+"""
+
+from libskylark_tpu.io.libsvm import (
+    read_libsvm,
+    read_dir_libsvm,
+    write_libsvm,
+)
+from libskylark_tpu.io.arclist import read_arc_list, write_arc_list
+from libskylark_tpu.io.hdf5 import (
+    have_hdf5,
+    read_hdf5,
+    write_hdf5,
+)
+from libskylark_tpu.io.streaming import StreamingCWT
+
+__all__ = [
+    "read_libsvm",
+    "read_dir_libsvm",
+    "write_libsvm",
+    "read_arc_list",
+    "write_arc_list",
+    "have_hdf5",
+    "read_hdf5",
+    "write_hdf5",
+    "StreamingCWT",
+]

@@ -1,0 +1,84 @@
+"""Arc-list (edge list) graph IO.
+
+TPU-native analog of ref: utility/io/arc_list.hpp (``ReadArcList`` — parse
+``from to [weight]`` lines, '#' comments, optional symmetrization, square
+matrix sized by the max vertex index) feeding the graph drivers
+(ref: nla/skylark_svd.cpp:158-176, ml/skylark_graph_se.cpp).
+
+The reference splits the file across MPI ranks and queue_update()s into a
+``sparse_vc_star_matrix_t``; here the host parses into COO and the result is
+a local :class:`SparseMatrix` whose device COO can be sharded by the caller.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from libskylark_tpu.base import errors
+from libskylark_tpu.base.sparse import SparseMatrix
+
+
+def read_arc_list(
+    source,
+    symmetrize: bool = False,
+    dtype=np.float32,
+) -> SparseMatrix:
+    """Parse an edge list into a square sparse adjacency matrix.
+
+    Lines are ``from to [weight]`` (whitespace separated, weight defaults
+    to 1); lines starting with ``#`` are skipped (ref: arc_list.hpp parse()).
+    ``symmetrize=True`` also inserts the reverse edge, as the graph drivers
+    do for undirected graphs. Duplicate edges sum.
+    """
+    from libskylark_tpu.io import native
+
+    parsed = native.parse_arc_list(source)
+    if parsed is not None:
+        src, dst, w = parsed
+    else:
+        if hasattr(source, "read"):
+            lines = source.read().splitlines()
+        else:
+            with open(source, "r") as f:
+                lines = f.read().splitlines()
+        srcs, dsts, ws = [], [], []
+        for line in lines:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            toks = line.split()
+            if len(toks) < 2:
+                raise errors.IOError_(f"invalid arc-list line {line!r}")
+            try:
+                srcs.append(int(toks[0]))
+                dsts.append(int(toks[1]))
+                ws.append(float(toks[2]) if len(toks) > 2 else 1.0)
+            except ValueError as e:
+                raise errors.IOError_(
+                    f"invalid arc-list line {line!r}") from e
+        src = np.asarray(srcs, dtype=np.int64)
+        dst = np.asarray(dsts, dtype=np.int64)
+        w = np.asarray(ws, dtype=np.float64)
+
+    if src.size and (src.min() < 0 or dst.min() < 0):
+        raise errors.IOError_("negative vertex index in arc list")
+    nv = int(max(src.max(), dst.max())) + 1 if src.size else 0
+    if symmetrize:
+        off_diag = src != dst
+        src, dst, w = (
+            np.concatenate([src, dst[off_diag]]),
+            np.concatenate([dst, src[off_diag]]),
+            np.concatenate([w, w[off_diag]]),
+        )
+    return SparseMatrix.from_coo(src, dst, w.astype(dtype), (nv, nv))
+
+
+def write_arc_list(path, A: SparseMatrix, digits: int = 8) -> None:
+    """Write a sparse matrix as ``from to weight`` lines."""
+    sp = A.to_scipy().tocoo()
+    fmt = f"%.{digits}g"
+    with open(path, "w") as f:
+        for i, j, v in zip(sp.row, sp.col, sp.data):
+            f.write(f"{int(i)} {int(j)} {fmt % v}\n")

@@ -1,0 +1,146 @@
+"""ctypes bridge to the native C++ parsing accelerators.
+
+The reference's IO hot loops are compiled C++ (ref: utility/io/libsvm_io.hpp
+tokenizing passes, compiled into every CLI; capi/ being the compiled layer
+generally). Here the analogous native component is ``libskylark_io.so``,
+built from ``native/io_parsers.cpp`` by ``native/build.py`` (g++ -O3). All
+entry points degrade to ``None`` when the library is missing, which tells
+the caller to use the pure-Python fallback — mirroring the reference
+Python layer's lib-missing fallbacks (ref: python sketch.py:752).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "..", "native",
+                        "libskylark_io.so")
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = os.path.abspath(_lib_path())
+    if not os.path.exists(path):
+        from libskylark_tpu.native import build
+
+        path = build.ensure_built(quiet=True)
+        if path is None:
+            return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.sl_libsvm_count.restype = ctypes.c_int
+    lib.sl_libsvm_count.argtypes = [
+        ctypes.c_char_p, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_longlong),  # n
+        ctypes.POINTER(ctypes.c_longlong),  # nt
+        ctypes.POINTER(ctypes.c_longlong),  # d
+        ctypes.POINTER(ctypes.c_longlong),  # nnz
+        ctypes.c_longlong,  # max_n
+    ]
+    lib.sl_libsvm_fill.restype = ctypes.c_int
+    lib.sl_libsvm_fill.argtypes = [
+        ctypes.c_char_p, ctypes.c_longlong,
+        ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),  # Y
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),    # rowptr
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),    # colind
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),  # values
+    ]
+    lib.sl_arclist_count.restype = ctypes.c_int
+    lib.sl_arclist_count.argtypes = [
+        ctypes.c_char_p, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_longlong),
+    ]
+    lib.sl_arclist_fill.restype = ctypes.c_int
+    lib.sl_arclist_fill.argtypes = [
+        ctypes.c_char_p, ctypes.c_longlong, ctypes.c_longlong,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+    ]
+    _LIB = lib
+    return _LIB
+
+
+def _read_bytes(source) -> Optional[bytes]:
+    if hasattr(source, "read"):
+        data = source.read()
+        if hasattr(source, "seek"):
+            source.seek(0)
+        return data.encode() if isinstance(data, str) else data
+    with open(source, "rb") as f:
+        return f.read()
+
+
+def parse_libsvm(source, max_n: int = -1):
+    """Native libsvm parse -> (targets, indices, values, d, nt) per-line
+    lists matching the pure-Python parser's output, or None if the native
+    library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    data = _read_bytes(source)
+    n = ctypes.c_longlong()
+    nt = ctypes.c_longlong()
+    d = ctypes.c_longlong()
+    nnz = ctypes.c_longlong()
+    rc = lib.sl_libsvm_count(
+        data, len(data), ctypes.byref(n), ctypes.byref(nt),
+        ctypes.byref(d), ctypes.byref(nnz), max_n)
+    if rc != 0:
+        from libskylark_tpu.base import errors
+
+        raise errors.IOError_(f"native libsvm parse failed (code {rc})")
+    n, nt, d, nnz = n.value, nt.value, d.value, nnz.value
+    Y = np.zeros(n * max(nt, 1), dtype=np.float64)
+    rowptr = np.zeros(n + 1, dtype=np.int64)
+    colind = np.zeros(max(nnz, 1), dtype=np.int64)
+    values = np.zeros(max(nnz, 1), dtype=np.float64)
+    rc = lib.sl_libsvm_fill(data, len(data), n, nt, nnz,
+                            Y, rowptr, colind, values)
+    if rc != 0:
+        from libskylark_tpu.base import errors
+
+        raise errors.IOError_(f"native libsvm fill failed (code {rc})")
+    targets = [Y[i * nt:(i + 1) * nt] for i in range(n)]
+    indices = [colind[rowptr[i]:rowptr[i + 1]] for i in range(n)]
+    vals = [values[rowptr[i]:rowptr[i + 1]] for i in range(n)]
+    return targets, indices, vals, int(d), int(nt)
+
+
+def parse_arc_list(source):
+    """Native arc-list parse -> (src, dst, w) numpy arrays, or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    data = _read_bytes(source)
+    ne = ctypes.c_longlong()
+    rc = lib.sl_arclist_count(data, len(data), ctypes.byref(ne))
+    if rc != 0:
+        from libskylark_tpu.base import errors
+
+        raise errors.IOError_(f"native arc-list parse failed (code {rc})")
+    ne = ne.value
+    src = np.zeros(max(ne, 1), dtype=np.int64)
+    dst = np.zeros(max(ne, 1), dtype=np.int64)
+    w = np.zeros(max(ne, 1), dtype=np.float64)
+    rc = lib.sl_arclist_fill(data, len(data), ne, src, dst, w)
+    if rc != 0:
+        from libskylark_tpu.base import errors
+
+        raise errors.IOError_(f"native arc-list fill failed (code {rc})")
+    return src[:ne], dst[:ne], w[:ne]

@@ -1,0 +1,85 @@
+"""Streaming sketch over minibatch iterators.
+
+TPU-native analog of ref: python-skylark/skylark/streaming.py:4-30 — a
+CountSketch (CWT) applied incrementally to an iterator of ``(X, Y)``
+minibatches, producing the sketched dataset ``(S·X, S·Y)`` without ever
+materializing the full data. Unlike the reference's ``numpy.random.seed``
+stream (which depends on arrival order), the bucket/sign streams here come
+from the framework's counter-based CWT, so the result equals the one-shot
+``CWT.apply`` on the concatenated data — the layout-independence invariant
+(ref: base/randgen.hpp:98-115) extended to streaming.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from libskylark_tpu.base.context import Context
+from libskylark_tpu.ml.coding import dummy_coding
+from libskylark_tpu.sketch.hash import CWT
+
+
+class StreamingCWT:
+    """Sketch a stream of row-minibatches down to ``s`` rows.
+
+    ``n`` is the total number of rows across the stream (the sketched
+    dimension — must be known up front, as in the reference where the
+    CWT hash stream is over row indices).
+    """
+
+    def __init__(self, n: int, s: int, context: Context):
+        self._n = int(n)
+        self._s = int(s)
+        self._cwt = CWT(self._n, self._s, context)
+
+    @property
+    def transform(self) -> CWT:
+        return self._cwt
+
+    def sketch(
+        self,
+        batches: Iterable[Tuple[np.ndarray, np.ndarray]],
+        num_classes: int = 0,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Consume ``(X, Y)`` minibatches; return ``(SX, SY)``.
+
+        ``num_classes > 2`` dummy-codes labels to ±1 one-vs-all before
+        sketching (ref: streaming.py:13-17 + ml/utils dummycode).
+        """
+        h_all = np.asarray(self._cwt.bucket_indices())
+        v_all = np.asarray(self._cwt.values(jnp.float32))
+        SX: Optional[jnp.ndarray] = None
+        SY: Optional[jnp.ndarray] = None
+        row0 = 0
+        for X, Y in batches:
+            X = jnp.asarray(X)
+            Y = np.asarray(Y)
+            nb = X.shape[0]
+            if row0 + nb > self._n:
+                raise ValueError(
+                    f"stream longer than declared n={self._n}")
+            if num_classes > 2:
+                Yb, _ = dummy_coding(
+                    Y.reshape(-1), coding=list(range(num_classes)))
+                Yb = jnp.asarray(Yb)
+            else:
+                Yb = jnp.asarray(Y.astype(np.float32))
+                if Yb.ndim == 1:
+                    Yb = Yb[:, None]
+            h = jnp.asarray(h_all[row0:row0 + nb])
+            v = jnp.asarray(v_all[row0:row0 + nb])
+            SXb = jnp.zeros((self._s, X.shape[1]), X.dtype).at[h].add(
+                v[:, None] * X)
+            SYb = jnp.zeros((self._s, Yb.shape[1]), Yb.dtype).at[h].add(
+                v[:, None] * Yb)
+            SX = SXb if SX is None else SX + SXb
+            SY = SYb if SY is None else SY + SYb
+            row0 += nb
+        if SX is None:
+            raise ValueError("empty stream")
+        if SY.shape[1] == 1:
+            SY = SY[:, 0]
+        return SX, SY

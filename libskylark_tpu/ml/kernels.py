@@ -42,14 +42,30 @@ _KERNEL_REGISTRY: dict[str, type["Kernel"]] = {}
 
 
 def _as_dense(X) -> jnp.ndarray:
-    """Accept dense arrays or :class:`SparseMatrix` (Gram matrices are dense
-    regardless, so sparse inputs densify on device; ref: ml/kernels.hpp gram
-    overloads across matrix types)."""
+    """Accept dense arrays or :class:`SparseMatrix` (distance-based Gram
+    matrices are dense regardless, so sparse inputs densify on device;
+    ref: ml/kernels.hpp gram overloads across matrix types)."""
     from libskylark_tpu.base.sparse import SparseMatrix
 
     if isinstance(X, SparseMatrix):
         return X.todense()
     return jnp.asarray(X)
+
+
+def _inner_gram(X, Y=None) -> jnp.ndarray:
+    """X·Yᵀ for the inner-product kernels (linear/polynomial), staying O(nnz)
+    for :class:`SparseMatrix` inputs instead of densifying
+    (ref: base/Gemm.hpp:335-519 sparse×dense kernels)."""
+    from libskylark_tpu.base.sparse import SparseMatrix, spmm, spmm_t
+
+    if isinstance(X, SparseMatrix):
+        Yd = _as_dense(X if Y is None else Y)
+        return spmm(X, Yd.T)             # (n, d)·(d, m)
+    Xd = jnp.asarray(X)
+    if isinstance(Y, SparseMatrix):
+        return spmm(Y, Xd.T).T
+    Yd = Xd if Y is None else jnp.asarray(Y)
+    return Xd @ Yd.T
 
 
 def _register(cls: type["Kernel"]) -> type["Kernel"]:
@@ -129,9 +145,7 @@ class Linear(Kernel):
     kernel_type = "linear"
 
     def gram(self, X, Y=None):
-        X = _as_dense(X)
-        Y = X if Y is None else _as_dense(Y)
-        return X @ Y.T
+        return _inner_gram(X, Y)
 
     def create_rft(self, S, context, tag="regular"):
         from libskylark_tpu import sketch as sk
@@ -194,9 +208,7 @@ class Polynomial(Kernel):
         self._gamma = float(gamma)
 
     def gram(self, X, Y=None):
-        X = _as_dense(X)
-        Y = X if Y is None else _as_dense(Y)
-        return (self._gamma * (X @ Y.T) + self._c) ** self._q
+        return (self._gamma * _inner_gram(X, Y) + self._c) ** self._q
 
     def create_rft(self, S, context, tag="regular"):
         from libskylark_tpu import sketch as sk

@@ -1,0 +1,11 @@
+"""Native (C++) host-side components, built on demand with g++.
+
+The compute path of this framework is JAX/XLA/Pallas; the native layer
+covers the host-side hot loops the reference implements in compiled C++ —
+currently the IO tokenizers (ref: utility/io/libsvm_io.hpp,
+utility/io/arc_list.hpp). See ``io_parsers.cpp`` and ``build.py``.
+"""
+
+from libskylark_tpu.native.build import ensure_built, lib_path
+
+__all__ = ["ensure_built", "lib_path"]

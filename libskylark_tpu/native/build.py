@@ -1,0 +1,47 @@
+"""Build driver for the native library (g++ -O3 -shared -fPIC).
+
+The reference builds its compiled layer with CMake into ``libcskylark.so``
+(ref: python-skylark/setup.py.in:11); here the single-TU parser library is
+cheap enough to compile on first use and cache next to the source. A missing
+or broken toolchain degrades silently to the pure-Python parsers.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "io_parsers.cpp")
+_SO = os.path.join(_HERE, "libskylark_io.so")
+
+
+def lib_path() -> str:
+    return _SO
+
+
+def ensure_built(quiet: bool = False) -> Optional[str]:
+    """Return the path to the built .so, compiling if stale/missing.
+
+    Returns None if the toolchain is unavailable or compilation fails.
+    """
+    try:
+        if (os.path.exists(_SO)
+                and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+            return _SO
+    except OSError:
+        return None
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", _SO, _SRC]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        if not quiet:
+            raise RuntimeError(
+                f"native build failed:\n{proc.stderr}")
+        return None
+    return _SO

@@ -27,6 +27,7 @@ from __future__ import annotations
 import math
 from typing import Any
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -84,14 +85,54 @@ class DenseTransform(SketchTransform):
     def _apply_columnwise_sparse(self, A) -> jnp.ndarray:
         from libskylark_tpu.base.sparse import spmm_t
 
+        blocksize = sketch_params.get_blocksize()
+        if blocksize and self._N > blocksize:
+            # S·A = (Aᵀ·Sᵀ)ᵀ; Aᵀ's columns are A's rows = the sketched dim,
+            # so the panel loop runs over Aᵀ (host CSC transpose, O(nnz)).
+            return self._sparse_panel_loop(A.transpose(), blocksize).T
         S = self.s_panel(0, self._N, A.device_dtype)
         return spmm_t(A, S.T).T          # S·A = (Aᵀ·Sᵀ)ᵀ
 
     def _apply_rowwise_sparse(self, A) -> jnp.ndarray:
         from libskylark_tpu.base.sparse import spmm
 
+        blocksize = sketch_params.get_blocksize()
+        if blocksize and self._N > blocksize:
+            return self._sparse_panel_loop(A, blocksize)
         S = self.s_panel(0, self._N, A.device_dtype)
         return spmm(A, S.T)              # A·Sᵀ
+
+    def _sparse_panel_loop(self, A, blocksize: int) -> jnp.ndarray:
+        """A·Sᵀ for sparse (m, N) A without ever materializing S beyond an
+        (S_dim × blocksize) panel — the sparse analog of the blocked dense
+        apply (honors the reference's blocksize memory bound,
+        ref: sketch/sketch_params.hpp:15-19). Host loop over column panels
+        (CSC column views are O(1)); per-panel nonzeros are zero-padded to
+        one uniform size so XLA compiles at most two program shapes."""
+        import numpy as np
+
+        dt = A.device_dtype
+        bs, n_full, rem = self._panel_schedule(blocksize)
+        bounds = [(p * bs, (p + 1) * bs) for p in range(n_full)]
+        if rem:
+            bounds.append((n_full * bs, self._N))
+        views = [A.column_view(p0, p1) for p0, p1 in bounds]
+        pad = max((v.nnz for v in views), default=1) or 1
+        acc = jnp.zeros((A.height, self._S), dt)
+        for (p0, p1), V in zip(bounds, views):
+            sp = V.to_scipy().tocoo()
+            r = np.zeros(pad, np.int32)
+            c = np.zeros(pad, np.int32)
+            vals = np.zeros(pad, np.float32)
+            r[: V.nnz] = sp.row
+            c[: V.nnz] = sp.col
+            vals[: V.nnz] = sp.data  # padding rows add v=0 at (0, 0)
+            Sp = self.s_panel(p0, p1, dt)        # (S_dim, p1-p0)
+            G = Sp.T[jnp.asarray(c)] * jnp.asarray(vals, dt)[:, None]
+            acc = acc + jax.ops.segment_sum(
+                G, jnp.asarray(r), num_segments=A.height
+            )
+        return acc
 
     # -- blocked (memory-bounded) apply: scan over column panels of S --
 

@@ -15,22 +15,56 @@ DHT/WHT, making scale·F approximately orthonormal.
 from __future__ import annotations
 
 import math
+from functools import partial
 
+import jax
 import jax.numpy as jnp
-import jax.scipy.fft as jfft
 
 
+def _dct2_last(x: jnp.ndarray) -> jnp.ndarray:
+    """Unnormalized DCT-II along the last axis, via Makhoul's single-FFT
+    decomposition: v = [x_even, reverse(x_odd)], y_k = 2·Re(FFT(v)_k·W_k),
+    W_k = exp(−iπk/2N). Written out by hand because this backend supports
+    lax.fft but not jax.scipy.fft.dct's lowering."""
+    n = x.shape[-1]
+    v = jnp.concatenate([x[..., ::2], jnp.flip(x[..., 1::2], -1)], -1)
+    V = jnp.fft.fft(v, axis=-1)
+    k = jnp.arange(n, dtype=jnp.float32)
+    W = jnp.exp((-1j * math.pi / (2.0 * n)) * k).astype(V.dtype)
+    return (2.0 * (V * W).real).astype(x.dtype)
+
+
+def _dct3_last(y: jnp.ndarray) -> jnp.ndarray:
+    """Unnormalized DCT-III (FFTW REDFT01) along the last axis — the exact
+    inverse of :func:`_dct2_last` up to the FFTW 2N factor."""
+    n = y.shape[-1]
+    yr = jnp.concatenate(
+        [jnp.zeros_like(y[..., :1]), jnp.flip(y[..., 1:], -1)], -1)
+    k = jnp.arange(n, dtype=jnp.float32)
+    W = jnp.exp((1j * math.pi / (2.0 * n)) * k)
+    V = 0.5 * (y - 1j * yr).astype(W.dtype) * W
+    v = jnp.fft.ifft(V, axis=-1).real.astype(y.dtype)
+    m = (n + 1) // 2
+    x = jnp.zeros_like(y)
+    x = x.at[..., ::2].set(v[..., :m])
+    x = x.at[..., 1::2].set(jnp.flip(v[..., m:], -1))
+    return 2.0 * n * x
+
+
+@partial(jax.jit, static_argnames="axis")
 def dct(A: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
-    """Unnormalized DCT-II (FFTW REDFT10 analog)."""
-    return jfft.dct(A, type=2, axis=axis)
+    """Unnormalized DCT-II (FFTW REDFT10 analog).
+
+    Jitted unconditionally: the twiddle factors are complex constants, and
+    on the axon TPU backend complex arrays cannot cross host↔device — under
+    jit they are baked into the compiled program instead of transferred."""
+    return jnp.moveaxis(_dct2_last(jnp.moveaxis(A, axis, -1)), -1, axis)
 
 
+@partial(jax.jit, static_argnames="axis")
 def idct(A: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
     """Unnormalized DCT-III = FFTW REDFT01 (inverse of REDFT10 up to 2N)."""
-    # jax idct(type=2) inverts dct including normalization; FFTW's REDFT01 is
-    # unnormalized: REDFT01(REDFT10(x)) = 2N x. Match FFTW.
-    n = A.shape[axis]
-    return jfft.idct(A, type=2, axis=axis) * (2.0 * n)
+    return jnp.moveaxis(_dct3_last(jnp.moveaxis(A, axis, -1)), -1, axis)
 
 
 def dht(A: jnp.ndarray, axis: int = 0) -> jnp.ndarray:

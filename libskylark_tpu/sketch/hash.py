@@ -22,7 +22,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from libskylark_tpu.base import randgen
+from libskylark_tpu.base import errors, randgen
 from libskylark_tpu.sketch.transform import SketchTransform, register
 
 
@@ -84,6 +84,15 @@ class HashTransform(SketchTransform):
         from libskylark_tpu.sketch.transform import COLUMNWISE, Dimension
 
         dimension = dimension or COLUMNWISE
+        if dimension == Dimension.COLUMNWISE:
+            if A.height != self._N:
+                raise errors.SketchError(
+                    f"columnwise apply expects {self._N} rows, got {A.shape}"
+                )
+        elif A.width != self._N:
+            raise errors.SketchError(
+                f"rowwise apply expects {self._N} cols, got {A.shape}"
+            )
         h = np.asarray(self.bucket_indices())
         sp = A.to_scipy().tocoo()
         v = np.asarray(self.values(A.device_dtype))
