@@ -173,14 +173,29 @@ def read_dir_libsvm(
     )
     if not names:
         raise errors.IOError_(f"no files in {dirname}")
-    lines: List[str] = []
-    for name in names:
-        lines.extend(_open_lines(name))
-
+    # Trim each shard at its own first blank/comment line (the per-file
+    # terminate semantics of the reference, which parses files separately),
+    # then concatenate — so a trailing newline in one shard can't swallow
+    # the rest of the dataset.
     import io as _io
 
-    buf = _io.StringIO("\n".join(lines))
+    buf = _io.StringIO(
+        "\n".join(
+            ln for name in names for ln in _trim_shard(_open_lines(name))
+        )
+    )
     return read_libsvm(buf, direction, sparse, min_d, max_n, dtype)
+
+
+def _trim_shard(lines: List[str]) -> List[str]:
+    """Truncate a shard at its first blank/comment line (per-file terminate
+    semantics) so shards can be concatenated safely."""
+    out: List[str] = []
+    for line in lines:
+        if not line.strip() or line.strip().startswith("#"):
+            break
+        out.append(line)
+    return out
 
 
 def write_libsvm(path, X, Y, digits: int = 8) -> None:

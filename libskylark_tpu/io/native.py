@@ -12,7 +12,6 @@ Python layer's lib-missing fallbacks (ref: python sketch.py:752).
 from __future__ import annotations
 
 import ctypes
-import os
 from typing import Optional, Tuple
 
 import numpy as np
@@ -21,23 +20,16 @@ _LIB = None
 _TRIED = False
 
 
-def _lib_path() -> str:
-    return os.path.join(os.path.dirname(__file__), "..", "native",
-                        "libskylark_io.so")
-
-
 def _load():
     global _LIB, _TRIED
     if _TRIED:
         return _LIB
     _TRIED = True
-    path = os.path.abspath(_lib_path())
-    if not os.path.exists(path):
-        from libskylark_tpu.native import build
+    from libskylark_tpu.native import build
 
-        path = build.ensure_built(quiet=True)
-        if path is None:
-            return None
+    path = build.ensure_built(quiet=True)
+    if path is None:
+        return None
     try:
         lib = ctypes.CDLL(path)
     except OSError:

@@ -140,6 +140,9 @@ int sl_libsvm_fill(const char* data, long long len,
                 Y[i * nt + t] = strtod(p, &endp);
                 if (endp == p) return 2;
                 ++t;
+            } else if (t < nt) {
+                return 2;  // fewer labels than the first line declared —
+                           // the Python parser rejects this line too
             } else {
                 long long idx = strtoll(p, &endp, 10);
                 if (endp != colon || idx < 1) return 2;

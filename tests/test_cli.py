@@ -1,0 +1,172 @@
+"""CLI driver tests: each executable run end-to-end on tiny data
+(the reference wires its CLIs into CTest the same way —
+ref: ml/CMakeLists.txt, nla/CMakeLists.txt)."""
+
+import numpy as np
+import pytest
+
+import libskylark_tpu.io as skio
+from libskylark_tpu.cli import (
+    skylark_community,
+    skylark_convert2hdf5,
+    skylark_graph_se,
+    skylark_linear,
+    skylark_ml,
+    skylark_svd,
+)
+
+
+@pytest.fixture()
+def regression_file(tmp_path):
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((200, 10)).astype(np.float32)
+    w = rng.standard_normal(10).astype(np.float32)
+    y = X @ w + 0.01 * rng.standard_normal(200).astype(np.float32)
+    p = tmp_path / "reg.libsvm"
+    skio.write_libsvm(p, X, y)
+    return str(p), X, w
+
+
+@pytest.fixture()
+def classification_file(tmp_path):
+    rng = np.random.default_rng(1)
+    n = 120
+    X = rng.standard_normal((n, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float32)
+    p = tmp_path / "cls.libsvm"
+    skio.write_libsvm(p, X, y)
+    return str(p)
+
+
+@pytest.fixture()
+def graph_file(tmp_path):
+    # two 5-cliques joined by one edge
+    lines = []
+    for block, off in ((0, 0), (1, 5)):
+        for i in range(5):
+            for j in range(i + 1, 5):
+                lines.append(f"{off + i} {off + j}")
+    lines.append("0 5")
+    p = tmp_path / "graph.txt"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+class TestSVDCli:
+    def test_libsvm_input(self, regression_file, tmp_path):
+        path, X, _ = regression_file
+        prefix = str(tmp_path / "svd")
+        rc = skylark_svd.main([path, "-k", "4", "--prefix", prefix])
+        assert rc == 0
+        U = np.loadtxt(prefix + ".U.txt")
+        S = np.loadtxt(prefix + ".S.txt")
+        V = np.loadtxt(prefix + ".V.txt")
+        R = (U * S) @ V.T
+        # rank-4 truncation of a full-rank matrix: check projection quality
+        # against numpy's optimal rank-4 approximation
+        u, s, vt = np.linalg.svd(X, full_matrices=False)
+        opt = (u[:, :4] * s[:4]) @ vt[:4]
+        assert np.linalg.norm(R - X) <= 1.25 * np.linalg.norm(opt - X) + 1e-5
+
+    def test_profile_mode(self, tmp_path):
+        prefix = str(tmp_path / "prof")
+        rc = skylark_svd.main(
+            ["--profile", "64", "32", "-k", "3", "--prefix", prefix])
+        assert rc == 0
+        assert np.loadtxt(prefix + ".S.txt").shape == (3,)
+
+    def test_arclist_symmetric(self, graph_file, tmp_path):
+        prefix = str(tmp_path / "g")
+        rc = skylark_svd.main([graph_file, "--filetype", "ARC_LIST",
+                               "-k", "2", "--prefix", prefix])
+        assert rc == 0
+        assert np.loadtxt(prefix + ".S.txt").shape == (2,)
+
+
+class TestLinearCli:
+    def test_sketch_and_solve(self, regression_file, tmp_path):
+        path, X, w = regression_file
+        prefix = str(tmp_path / "lin")
+        rc = skylark_linear.main([path, "--prefix", prefix])
+        assert rc == 0
+        x = np.loadtxt(prefix + ".x.txt")
+        assert np.linalg.norm(x - w) / np.linalg.norm(w) < 0.2
+
+    def test_highprecision(self, regression_file, tmp_path):
+        path, X, w = regression_file
+        prefix = str(tmp_path / "linhp")
+        rc = skylark_linear.main([path, "-p", "--prefix", prefix])
+        assert rc == 0
+        x = np.loadtxt(prefix + ".x.txt")
+        assert np.linalg.norm(x - w) / np.linalg.norm(w) < 0.05
+
+
+class TestMLCli:
+    def test_train_and_test_classification(self, classification_file,
+                                           tmp_path):
+        model = str(tmp_path / "model.json")
+        rc = skylark_ml.main([
+            classification_file, model, "-l", "2", "-r", "1", "-k", "1",
+            "-g", "1.0", "-c", "0.01", "-f", "64", "-i", "8",
+        ])
+        assert rc == 0
+        rc = skylark_ml.main(["--testfile", classification_file,
+                              "--modelfile", model])
+        assert rc == 0
+
+    def test_train_regression_linear(self, regression_file, tmp_path):
+        path, _, _ = regression_file
+        model = str(tmp_path / "reg_model.json")
+        rc = skylark_ml.main([
+            path, model, "--regression", "-c", "0.001", "-i", "15",
+        ])
+        assert rc == 0
+        rc = skylark_ml.main(["--testfile", path, "--modelfile", model,
+                              "--regression"])
+        assert rc == 0
+
+
+class TestGraphCli:
+    def test_graph_se(self, graph_file, tmp_path):
+        prefix = str(tmp_path / "se")
+        rc = skylark_graph_se.main(
+            [graph_file, "-k", "2", "-n", "--prefix", prefix])
+        assert rc == 0
+        V = np.loadtxt(prefix + ".V.txt")
+        assert V.shape == (10, 2)
+        idx = [int(v) for v in
+               (tmp_path / "se.index.txt").read_text().split()]
+        assert sorted(idx) == list(range(10))
+
+    def test_community_batch(self, graph_file, capsys):
+        rc = skylark_community.main([graph_file, "0", "-n", "-q"])
+        assert rc == 0
+        out = capsys.readouterr().out.split()
+        members = {int(v) for v in out}
+        # seed block (vertices 0-4) should dominate the cluster
+        assert 0 in members
+        assert len(members & {0, 1, 2, 3, 4}) >= 3
+
+    def test_community_missing_seed(self, graph_file):
+        rc = skylark_community.main([graph_file, "99", "-n"])
+        assert rc == 2
+
+
+@pytest.mark.skipif(not skio.have_hdf5(), reason="h5py unavailable")
+class TestConvertCli:
+    def test_roundtrip_dense(self, regression_file, tmp_path):
+        path, X, _ = regression_file
+        h5 = str(tmp_path / "data.h5")
+        rc = skylark_convert2hdf5.main([path, h5])
+        assert rc == 0
+        X2, _ = skio.read_hdf5(h5)
+        np.testing.assert_allclose(X2, X, rtol=1e-6)
+
+    def test_roundtrip_sparse(self, classification_file, tmp_path):
+        h5 = str(tmp_path / "datas.h5")
+        rc = skylark_convert2hdf5.main([classification_file, h5,
+                                        "--mode", "1"])
+        assert rc == 0
+        X2, _ = skio.read_hdf5(h5, sparse=True)
+        X1, _ = skio.read_libsvm(classification_file)
+        np.testing.assert_allclose(np.asarray(X2.todense()), X1, rtol=1e-5)

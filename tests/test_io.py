@@ -210,3 +210,27 @@ class TestStreaming:
             [(X[:15], Y[:15]), (X[15:], Y[15:])], num_classes=c)
         assert SX.shape == (s, d)
         assert SY.shape == (s, c)
+
+
+class TestReviewRegressions:
+    def test_dir_shard_trailing_blank_line(self, tmp_path):
+        """A trailing blank line in one shard must not swallow later shards."""
+        (tmp_path / "part0").write_text("1 1:2\n\n")
+        (tmp_path / "part1").write_text("1 1:3\n")
+        X, Y = skio.read_dir_libsvm(str(tmp_path))
+        assert X.shape == (2, 1)
+        np.testing.assert_allclose(X[:, 0], [2, 3])
+
+    def test_native_rejects_short_label_row(self):
+        """'3 2:1.5' under nt=2 must error in BOTH parsers (native parity)."""
+        from libskylark_tpu.base import errors
+        from libskylark_tpu.io import native
+        from libskylark_tpu.io.libsvm import _parse_lines
+
+        text = "1 2 1:0.5\n3 2:1.5\n"
+        with pytest.raises(errors.IOError_):
+            _parse_lines(text.splitlines(), -1)
+        if native._load() is None:
+            pytest.skip("native library unavailable")
+        with pytest.raises(errors.IOError_):
+            native.parse_libsvm(pyio.StringIO(text))
