@@ -1,0 +1,80 @@
+"""Sparse random matrices and hash maps.
+
+TPU-native analog of ref: python-skylark/skylark/sprand.py:9-80 — sparse
+i.i.d. samples and the sparse matrix form of a random hash map h:[n]→[t]
+(the explicit-matrix view of the CountSketch family). Draws come from
+Context counter streams, so matrices are deterministic given (seed,
+counter) like everything else in the framework.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from libskylark_tpu.base import errors, randgen
+from libskylark_tpu.base.context import Context
+from libskylark_tpu.base.sparse import SparseMatrix
+
+
+def sample(
+    m: int,
+    n: int,
+    density: float,
+    nz_values: Sequence[float],
+    nz_prob_dist: Sequence[float],
+    context: Context,
+) -> SparseMatrix:
+    """(m, n) sparse matrix of the given density whose nonzeros are drawn
+    i.i.d. from ``nz_values`` with probabilities ``nz_prob_dist``
+    (ref: sprand.py sample:9-34)."""
+    if not 0.0 <= density <= 1.0:
+        raise errors.InvalidParametersError(f"bad density {density}")
+    nnz = int(round(density * m * n))
+    # positions: sample nnz distinct flat indices via a uniform stream
+    # (duplicates collapse, matching scipy.sparse.rand's behavior of
+    # approximate density)
+    flat = np.asarray(randgen.stream_slice(
+        context.allocate().key, randgen.UniformInt(0, m * n - 1), 0,
+        max(nnz, 1), dtype=jnp.int32), dtype=np.int64)[:nnz]
+    flat = np.unique(flat)
+    rows, cols = flat // n, flat % n
+    u = np.asarray(randgen.stream_slice(
+        context.allocate().key, randgen.Uniform(), 0, max(len(flat), 1),
+        dtype=jnp.float32), dtype=np.float64)[: len(flat)]
+    cdf = np.cumsum(np.asarray(nz_prob_dist, dtype=np.float64))
+    cdf = cdf / cdf[-1]
+    vals = np.asarray(nz_values, dtype=np.float64)[
+        np.searchsorted(cdf, u, side="right").clip(0, len(nz_values) - 1)]
+    return SparseMatrix.from_coo(rows, cols, vals.astype(np.float32), (m, n))
+
+
+def hashmap(
+    t: int,
+    n: int,
+    context: Context,
+    values: str = "rademacher",
+    dimension: int = 0,
+) -> SparseMatrix:
+    """Sparse matrix of a random hash h:[n]→[t]: S[h(i), i] = v(i)
+    (dimension=0, t×n) or S[i, h(i)] = v(i) (dimension=1, n×t)
+    (ref: sprand.py hashmap:37-80). ``values`` is 'rademacher' (±1,
+    CountSketch) or 'ones'."""
+    h = np.asarray(randgen.stream_slice(
+        context.allocate().key, randgen.UniformInt(0, t - 1), 0, n,
+        dtype=jnp.int32), dtype=np.int64)
+    if values == "rademacher":
+        v = np.asarray(randgen.stream_slice(
+            context.allocate().key, randgen.Rademacher(), 0, n,
+            dtype=jnp.float32))
+    elif values == "ones":
+        v = np.ones(n, dtype=np.float32)
+    else:
+        raise errors.InvalidParametersError(
+            f"values must be 'rademacher' or 'ones', got {values!r}")
+    i = np.arange(n, dtype=np.int64)
+    if dimension == 0:
+        return SparseMatrix.from_coo(h, i, v, (t, n))
+    return SparseMatrix.from_coo(i, h, v, (n, t))

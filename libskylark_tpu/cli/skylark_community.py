@@ -68,8 +68,7 @@ def main(argv=None) -> int:
             u, v = toks[0], toks[1]
             if args.numeric:
                 u, v = int(u), int(v)
-            G.add_edge(u, v)
-            G.add_edge(v, u)
+            G.add_edge(u, v)  # Graph.add_edge inserts both directions
     if not args.quiet:
         print(f"Reading the graph... took {time.time() - t0:.2e} sec")
 

@@ -109,6 +109,11 @@ def _train(args) -> int:
     from libskylark_tpu.cli import read_dataset
     from libskylark_tpu.ml.admm import BlockADMMSolver
 
+    modelfile = args.modelfile or args.modelfile_pos
+    if not modelfile:
+        print("error: modelfile required", file=sys.stderr)
+        return 2
+
     X, Y = read_dataset(args.trainfile, args.fileformat)
     d = X.shape[1]
     context = Context(seed=args.seed)
@@ -138,12 +143,20 @@ def _train(args) -> int:
         Xv, Yv = read_dataset(args.valfile, args.fileformat)
 
     Yn = np.asarray(Y)
+    classes = None
     if not args.regression:
-        # recode labels to 0..k-1 (the reference's coding layer)
+        # recode labels to 0..k-1 (the reference's coding layer); the
+        # coding is stored in the model so predictions decode back
         classes = np.unique(Yn)
         Yn = np.searchsorted(classes, Yn)
         if Yv is not None:
-            Yv = np.searchsorted(classes, np.asarray(Yv))
+            Yv = np.asarray(Yv)
+            unknown = np.setdiff1d(np.unique(Yv), classes)
+            if unknown.size:
+                print(f"error: validation labels {unknown.tolist()} not in "
+                      f"training labels", file=sys.stderr)
+                return 2
+            Yv = np.searchsorted(classes, Yv)
 
     t0 = time.time()
     model = solver.train(
@@ -153,10 +166,8 @@ def _train(args) -> int:
         Yv=Yv, regression=args.regression, verbose=True,
     )
     print(f"Training took {time.time() - t0:.2e} sec")
-    modelfile = args.modelfile or args.modelfile_pos
-    if not modelfile:
-        print("error: modelfile required", file=sys.stderr)
-        return 2
+    if classes is not None:
+        model.label_coding = classes.tolist()
     model.save(modelfile, header="trained by skylark_ml (libskylark_tpu)")
     print(f"Model saved to {modelfile}")
     return 0
@@ -166,6 +177,7 @@ def _test(args) -> int:
     import numpy as np
 
     from libskylark_tpu.cli import read_dataset
+    from libskylark_tpu.ml.metrics import classification_accuracy, rmse
     from libskylark_tpu.ml.model import HilbertModel
 
     modelfile = args.modelfile or args.modelfile_pos
@@ -175,17 +187,17 @@ def _test(args) -> int:
     labels, decisions = model.predict(Xd)
     labels = np.asarray(labels)
     Yn = np.asarray(Y)
+    if (not model.regression and model.label_coding is not None
+            and model.num_outputs > 1):
+        # decode class indices back to the original training label values
+        labels = np.asarray(model.label_coding)[labels.ravel()]
     if args.outputfile:
         out = np.asarray(decisions) if args.decisionvals else labels
         np.savetxt(args.outputfile + ".txt", out, fmt="%.8g")
     if model.regression:
-        err = float(np.sqrt(np.mean((labels.ravel() - Yn.ravel()) ** 2)))
-        print(f"RMSE = {err:.6f}")
+        print(f"RMSE = {rmse(labels, Yn):.6f}")
     else:
-        classes = np.unique(Yn)
-        Yc = np.searchsorted(classes, Yn)
-        acc = float(np.mean(labels.ravel() == Yc.ravel()) * 100)
-        print(f"Accuracy = {acc:.2f} %")
+        print(f"Accuracy = {classification_accuracy(labels, Yn):.2f} %")
     return 0
 
 

@@ -1,8 +1,27 @@
 """ML layer: kernels, KRR/RLSC, ADMM kernel machines, models, graph
 algorithms (SURVEY.md §2.5)."""
 
-from libskylark_tpu.ml import admm, coding, graph, kernels, krr, model, rlsc
+from libskylark_tpu.ml import (
+    admm,
+    coding,
+    graph,
+    kernels,
+    krr,
+    metrics,
+    model,
+    modeling,
+    nonlinear,
+    rlsc,
+)
 from libskylark_tpu.ml.admm import BlockADMMSolver
+from libskylark_tpu.ml.metrics import classification_accuracy, rmse
+from libskylark_tpu.ml.modeling import LinearizedKernelModel
+from libskylark_tpu.ml.nonlinear import (
+    NystromRLS,
+    RLS,
+    SketchPCR,
+    SketchRLS,
+)
 from libskylark_tpu.ml.graph import (
     Graph,
     approximate_ase,
@@ -43,6 +62,16 @@ from libskylark_tpu.ml.rlsc import (
 
 __all__ = [
     "admm",
+    "metrics",
+    "modeling",
+    "nonlinear",
+    "classification_accuracy",
+    "rmse",
+    "LinearizedKernelModel",
+    "RLS",
+    "SketchRLS",
+    "NystromRLS",
+    "SketchPCR",
     "graph",
     "Graph",
     "approximate_ase",

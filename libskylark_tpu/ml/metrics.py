@@ -1,0 +1,22 @@
+"""Prediction metrics (ref: python-skylark/skylark/metrics.py:8-30)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def classification_accuracy(pred, truth) -> float:
+    """Percentage of matching labels (ref: metrics.py:8)."""
+    pred = np.asarray(pred).reshape(-1)
+    truth = np.asarray(truth).reshape(-1)
+    if pred.shape != truth.shape:
+        raise ValueError(f"shape mismatch {pred.shape} vs {truth.shape}")
+    return float(np.mean(pred == truth) * 100.0)
+
+
+def rmse(pred, truth) -> float:
+    """Root-mean-square error (regression analog used by the ML drivers,
+    ref: ml/model.hpp:24 metric reporting)."""
+    pred = np.asarray(pred).reshape(-1)
+    truth = np.asarray(truth).reshape(-1)
+    return float(np.sqrt(np.mean((pred - truth) ** 2)))

@@ -38,7 +38,12 @@ class HilbertModel:
         regression: bool,
         input_size: Optional[int] = None,
         coef: Optional[jnp.ndarray] = None,
+        label_coding: Optional[Sequence] = None,
     ):
+        # classification: original label value of each output column, so
+        # predictions decode back to the training labels (class k of the
+        # coef matrix ↔ label_coding[k]); None = labels were already 0..k−1
+        self.label_coding = list(label_coding) if label_coding else None
         self.maps = list(maps)
         self.scale_maps = bool(scale_maps)
         self.regression = bool(regression)
@@ -110,6 +115,11 @@ class HilbertModel:
                 "maps": [m.to_dict() for m in self.maps],
             },
             "coef_matrix": np.asarray(self.coef).tolist(),
+            **(
+                {"label_coding": self.label_coding}
+                if self.label_coding is not None
+                else {}
+            ),
         }
 
     def save(self, fname: str, header: str = "") -> None:
@@ -131,6 +141,7 @@ class HilbertModel:
             bool(d["regression"]),
             input_size=int(d["input_size"]),
             coef=jnp.asarray(d["coef_matrix"], jnp.float32),
+            label_coding=d.get("label_coding"),
         )
 
     @staticmethod

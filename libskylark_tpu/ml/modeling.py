@@ -1,0 +1,31 @@
+"""Model-file consumers (ref: python-skylark/skylark/ml/modeling.py:5-40).
+
+``LinearizedKernelModel`` loads a model file written by skylark_ml /
+:class:`~libskylark_tpu.ml.model.HilbertModel` and serves predictions —
+the reference's thin Python wrapper over the JSON model format.
+"""
+
+from __future__ import annotations
+
+from libskylark_tpu.ml.model import HilbertModel
+
+
+class LinearizedKernelModel:
+    """ref: modeling.py LinearizedKernelModel:5 — wraps a saved model."""
+
+    def __init__(self, fname: str):
+        self._model = HilbertModel.load(fname)
+
+    @property
+    def hilbert_model(self) -> HilbertModel:
+        return self._model
+
+    def get_input_dimension(self) -> int:
+        return self._model.input_size
+
+    def predict(self, X):
+        labels, _ = self._model.predict(X)
+        return labels
+
+    def decision_values(self, X):
+        return self._model.decision_values(X)

@@ -1,0 +1,197 @@
+"""Kernel regression/classification toolkit: RLS, sketched RLS, Nyström RLS,
+sketched PCR.
+
+TPU-native analog of ref: python-skylark/skylark/ml/nonlinear.py:8-440.
+Each model follows the reference's train/predict protocol; labels for
+multiclass problems are integer classes, dummy-coded to ±1 one-vs-all
+internally (ref: utils.dummycoding + 2Y−1). All dense algebra runs on
+device; sampling and streams come from the framework Context.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+import numpy as np
+
+from libskylark_tpu.base import errors, randgen
+from libskylark_tpu.base.context import Context
+from libskylark_tpu.ml.coding import dummy_coding, dummy_decode
+
+
+def _code_labels(Y, multiclass: bool):
+    if not multiclass:
+        Yc = jnp.asarray(np.asarray(Y, dtype=np.float32))
+        return (Yc[:, None] if Yc.ndim == 1 else Yc), None
+    Ym, coding = dummy_coding(Y)
+    return Ym, coding
+
+
+def _decode(pred, coding):
+    if coding is None:
+        return pred[:, 0] if pred.shape[1] == 1 else pred
+    return dummy_decode(pred, coding)
+
+
+class RLS:
+    """Exact kernel regularized least squares (ref: nonlinear.py rls:8-107):
+    α = (K + λI)⁻¹·Y, predict via cross-gram with the training data."""
+
+    def __init__(self, kernel):
+        self._kernel = kernel
+        self.model = None
+
+    def train(self, X, Y, regularization: float = 1.0,
+              multiclass: bool = True):
+        X = jnp.asarray(X) if not hasattr(X, "todense") else X.todense()
+        m = X.shape[0]
+        K = self._kernel.gram(X)
+        Ym, coding = _code_labels(Y, multiclass)
+        A = K + regularization * jnp.eye(m, dtype=K.dtype)
+        alpha = jsl.cho_solve(jsl.cho_factor(A), Ym.astype(K.dtype))
+        self.model = {"alpha": alpha, "data": X, "coding": coding,
+                      "regularization": float(regularization)}
+        return self
+
+    def predict(self, Xt):
+        if self.model is None:
+            raise errors.MLError("predict before train")
+        Xt = jnp.asarray(Xt) if not hasattr(Xt, "todense") else Xt.todense()
+        K = self._kernel.gram(Xt, self.model["data"])
+        pred = K @ self.model["alpha"]
+        return _decode(pred, self.model["coding"])
+
+
+class SketchRLS:
+    """Random-features RLS (ref: nonlinear.py sketchrls:109-219):
+    Z = rft(X), w = (ZᵀZ + λI)⁻¹ Zᵀ Y."""
+
+    def __init__(self, kernel):
+        self._kernel = kernel
+        self.model = None
+        self._rft = None
+
+    def train(self, X, Y, context: Context, random_features: int = 100,
+              regularization: float = 1.0, multiclass: bool = True,
+              tag: str = "regular"):
+        from libskylark_tpu.sketch import ROWWISE
+
+        self._rft = self._kernel.create_rft(random_features, context, tag)
+        Z = self._rft.apply(X, ROWWISE)
+        Ym, coding = _code_labels(Y, multiclass)
+        s = Z.shape[1]
+        A = Z.T @ Z + regularization * jnp.eye(s, dtype=Z.dtype)
+        w = jsl.cho_solve(jsl.cho_factor(A), Z.T @ Ym.astype(Z.dtype))
+        self.model = {"weights": w, "coding": coding,
+                      "regularization": float(regularization)}
+        return self
+
+    def predict(self, Xt):
+        from libskylark_tpu.sketch import ROWWISE
+
+        if self.model is None:
+            raise errors.MLError("predict before train")
+        Zt = self._rft.apply(Xt, ROWWISE)
+        pred = Zt @ self.model["weights"]
+        return _decode(pred, self.model["coding"])
+
+
+class NystromRLS:
+    """Nyström-feature RLS (ref: nonlinear.py nystromrls:221-291): sample
+    landmark rows (uniform or by ridge leverage scores), whiten the landmark
+    gram by its inverse square root, regress on Z = K(X, landmarks)·U."""
+
+    def __init__(self, kernel):
+        self._kernel = kernel
+        self.model = None
+
+    def train(self, X, Y, context: Context, random_features: int = 100,
+              regularization: float = 1.0, probdist: str = "uniform",
+              multiclass: bool = True):
+        X = jnp.asarray(X) if not hasattr(X, "todense") else X.todense()
+        m = X.shape[0]
+        s = int(random_features)
+        if probdist == "uniform":
+            p = np.full(m, 1.0 / m)
+        elif probdist == "leverages":
+            K = self._kernel.gram(X)
+            M = K + regularization * jnp.eye(m, dtype=K.dtype)
+            lev = jnp.diagonal(
+                K @ jnp.linalg.inv(M)
+            )
+            p = np.maximum(np.asarray(lev, dtype=np.float64), 0)
+            p = p / p.sum()
+        else:
+            raise errors.InvalidParametersError(
+                f"probdist must be 'uniform' or 'leverages', got {probdist!r}")
+        # deterministic non-uniform sample via inverse-CDF on context stream
+        u = np.asarray(randgen.stream_slice(
+            context.allocate().key, randgen.Uniform(), 0, s,
+            dtype=jnp.float32), dtype=np.float64)
+        cdf = np.cumsum(p)
+        cdf[-1] = 1.0
+        idx = np.searchsorted(cdf, u, side="right")
+        SX = X[jnp.asarray(idx.astype(np.int32))]
+
+        K_II = self._kernel.gram(SX)
+        eps = 1e-8
+        evals, evecs = jnp.linalg.eigh(
+            K_II + eps * jnp.eye(s, dtype=K_II.dtype))
+        evals = jnp.maximum(evals, eps)
+        U = evecs / jnp.sqrt(evals)[None, :]
+        Z = self._kernel.gram(X, SX) @ U
+        Ym, coding = _code_labels(Y, multiclass)
+        A = Z.T @ Z + regularization * jnp.eye(s, dtype=Z.dtype)
+        w = jsl.cho_solve(jsl.cho_factor(A), Z.T @ Ym.astype(Z.dtype))
+        self.model = {"weights": w, "SX": SX, "U": U, "coding": coding}
+        return self
+
+    def predict(self, Xt):
+        if self.model is None:
+            raise errors.MLError("predict before train")
+        Xt = jnp.asarray(Xt) if not hasattr(Xt, "todense") else Xt.todense()
+        Zt = self._kernel.gram(Xt, self.model["SX"]) @ self.model["U"]
+        pred = Zt @ self.model["weights"]
+        return _decode(pred, self.model["coding"])
+
+
+class SketchPCR:
+    """Sketched principal component regression
+    (ref: nonlinear.py sketchpcr:293-440): project random features onto the
+    approximate k-dominant subspace (nla.lowrank), regress there."""
+
+    def __init__(self, kernel):
+        self._kernel = kernel
+        self.model = None
+        self._rft = None
+
+    def train(self, X, Y, context: Context, rank: int,
+              s: Optional[int] = None, t: Optional[int] = None,
+              multiclass: bool = True, tag: str = "regular"):
+        from libskylark_tpu.nla.lowrank import (
+            approximate_dominant_subspace_basis,
+        )
+
+        s = 2 * rank if s is None else int(s)
+        t = 2 * s if t is None else int(t)
+        Z, S, R, V = approximate_dominant_subspace_basis(
+            X, rank, s, t, context, kernel=self._kernel, tag=tag)
+        Ym, coding = _code_labels(Y, multiclass)
+        # Z orthonormal: least squares is just the projection
+        w0 = Z.T @ Ym.astype(Z.dtype)
+        weights = jsl.solve_triangular(R, V @ w0, lower=False)
+        self._rft = S
+        self.model = {"weights": weights, "coding": coding,
+                      "rank": int(rank), "s": s, "t": t}
+        return self
+
+    def predict(self, Xt):
+        from libskylark_tpu.sketch import ROWWISE
+
+        if self.model is None:
+            raise errors.MLError("predict before train")
+        Zt = self._rft.apply(Xt, ROWWISE)
+        pred = Zt @ self.model["weights"]
+        return _decode(pred, self.model["coding"])

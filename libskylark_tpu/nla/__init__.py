@@ -1,8 +1,28 @@
 """NLA layer: randomized SVD, least squares, condition estimation, spectral
 helpers (SURVEY.md §2.4)."""
 
-from libskylark_tpu.nla import condest, least_squares, spectral, svd
+from libskylark_tpu.nla import (
+    condest,
+    krank,
+    least_squares,
+    lowrank,
+    randlobpcg,
+    spectral,
+    svd,
+)
 from libskylark_tpu.nla.condest import condest as estimate_condition
+from libskylark_tpu.nla.krank import (
+    RandomizedRangeFinder,
+    RangeAssistedEVD,
+    RangeAssistedSVD,
+    randomized_svd,
+    srft_matrix,
+)
+from libskylark_tpu.nla.lowrank import approximate_dominant_subspace_basis
+from libskylark_tpu.nla.randlobpcg import (
+    lobpcg_rand_evd,
+    power_iterations_rand_evd,
+)
 from libskylark_tpu.nla.least_squares import (
     approximate_least_squares,
     fast_least_squares,
@@ -17,6 +37,17 @@ from libskylark_tpu.nla.svd import (
 
 __all__ = [
     "condest",
+    "krank",
+    "lowrank",
+    "randlobpcg",
+    "RandomizedRangeFinder",
+    "RangeAssistedSVD",
+    "RangeAssistedEVD",
+    "randomized_svd",
+    "srft_matrix",
+    "approximate_dominant_subspace_basis",
+    "lobpcg_rand_evd",
+    "power_iterations_rand_evd",
     "least_squares",
     "spectral",
     "svd",

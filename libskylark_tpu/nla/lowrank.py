@@ -1,0 +1,47 @@
+"""Dominant-subspace approximation via two-level sketching.
+
+TPU-native analog of ref: python-skylark/skylark/nla/lowrank.py:7-48
+(``approximate_domsubspace_basis``) — the sketch-based construction of a
+basis Z whose span (1+ε)-approximates the k-dominant subspace of A (or of
+φ(A) for a kernel feature map): sketch twice (sizes s and t), QR the first
+sketch, SVD the cross product, truncate.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from libskylark_tpu.base.context import Context
+
+
+def approximate_dominant_subspace_basis(
+    A,
+    k: int,
+    s: int,
+    t: int,
+    context: Context,
+    kernel=None,
+    tag: str = "regular",
+) -> Tuple[jnp.ndarray, object, jnp.ndarray, jnp.ndarray]:
+    """Returns (Z, S, R, V) with Z = QR(S(A)).Q @ V; S is the (kept) feature
+    transform so test points map through the same sketch
+    (ref: lowrank.py:7-48). ``s = Ω(k/ε)``, ``t = Ω(k/ε²)`` give the
+    (1+ε)‖A_k − A‖_F guarantee."""
+    from libskylark_tpu import sketch as sk
+    from libskylark_tpu.ml.kernels import Linear
+
+    A = jnp.asarray(A) if not hasattr(A, "todense") else A
+    d = A.shape[1]
+    if kernel is None:
+        kernel = Linear(d)
+    S = kernel.create_rft(s, context, tag)
+    X = S.apply(A, sk.ROWWISE)
+    T = kernel.create_rft(t, context, tag)
+    Y = T.apply(A, sk.ROWWISE)
+    U, R = jnp.linalg.qr(X)
+    M, _, _ = jnp.linalg.svd(U.T @ Y, full_matrices=False)
+    V = M[:, :k]
+    Z = U @ V
+    return Z, S, R, V

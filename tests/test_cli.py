@@ -170,3 +170,41 @@ class TestConvertCli:
         X2, _ = skio.read_hdf5(h5, sparse=True)
         X1, _ = skio.read_libsvm(classification_file)
         np.testing.assert_allclose(np.asarray(X2.todense()), X1, rtol=1e-5)
+
+
+class TestLabelCoding:
+    def test_noncontiguous_labels_roundtrip(self, tmp_path):
+        """Labels {3,7,9}: accuracy must be computed against the original
+        label values via the stored coding (review regression)."""
+        rng = np.random.default_rng(5)
+        n = 90
+        X = rng.standard_normal((n, 4)).astype(np.float32)
+        raw = np.where(X[:, 0] > 0.5, 9, np.where(X[:, 0] > -0.5, 7, 3))
+        p = tmp_path / "odd.libsvm"
+        skio.write_libsvm(p, X, raw.astype(np.float32))
+        model = str(tmp_path / "odd.json")
+        rc = skylark_ml.main([str(p), model, "-c", "0.001", "-i", "30"])
+        assert rc == 0
+        # model stores the coding
+        from libskylark_tpu.ml.model import HilbertModel
+
+        m = HilbertModel.load(model)
+        assert m.label_coding == [3, 7, 9]
+        # subset-of-labels test file must still score against raw values
+        mask = raw != 3
+        p2 = tmp_path / "subset.libsvm"
+        skio.write_libsvm(p2, X[mask], raw[mask].astype(np.float32))
+        out = str(tmp_path / "pred")
+        rc = skylark_ml.main(["--testfile", str(p2), "--modelfile", model,
+                              "--outputfile", out])
+        assert rc == 0
+        preds = np.loadtxt(out + ".txt")
+        assert set(np.unique(preds)) <= {3.0, 7.0, 9.0}
+
+    def test_modelfile_checked_before_training(self, tmp_path):
+        rng = np.random.default_rng(6)
+        X = rng.standard_normal((20, 3)).astype(np.float32)
+        p = tmp_path / "t.libsvm"
+        skio.write_libsvm(p, X, (X[:, 0] > 0).astype(np.float32))
+        rc = skylark_ml.main([str(p)])
+        assert rc == 2

@@ -1,0 +1,287 @@
+"""Tests for the randomized low-rank toolkit, LOBPCG EVD, dominant-subspace
+basis, nonlinear ML models, sprand, metrics (the python-skylark layer
+equivalents; ref: python-skylark/skylark/nla/krank.py, randlobpcg.py,
+lowrank.py, ml/nonlinear.py, sprand.py, metrics.py)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from libskylark_tpu.base.context import Context
+
+
+def _lowrank_matrix(m=120, n=60, k=5, noise=1e-4, seed=0):
+    rng = np.random.default_rng(seed)
+    U = np.linalg.qr(rng.standard_normal((m, k)))[0]
+    V = np.linalg.qr(rng.standard_normal((n, k)))[0]
+    s = np.linspace(10, 1, k)
+    A = (U * s) @ V.T + noise * rng.standard_normal((m, n))
+    return A.astype(np.float32)
+
+
+class TestRangeFinder:
+    @pytest.mark.parametrize("method,params", [
+        ("generic", {"s": 12}),
+        ("power_iteration", {"s": 12, "q": 2}),
+        ("subspace_iteration", {"s": 12, "q": 1}),
+        ("fast_generic", {"s": 20}),
+    ])
+    def test_captures_range(self, method, params):
+        from libskylark_tpu.nla.krank import RandomizedRangeFinder
+
+        A = _lowrank_matrix()
+        Q = RandomizedRangeFinder(A, method, params, Context(seed=3)).compute()
+        Q = np.asarray(Q)
+        resid = np.linalg.norm(A - Q @ (Q.T @ A)) / np.linalg.norm(A)
+        assert resid < 1e-2, (method, resid)
+
+    def test_adaptive(self):
+        from libskylark_tpu.nla.krank import RandomizedRangeFinder
+
+        A = _lowrank_matrix(noise=0)
+        Q = RandomizedRangeFinder(
+            A, "adaptive", {"epsilon": 1e-3, "r": 8}, Context(seed=3)
+        ).compute()
+        Q = np.asarray(Q)
+        resid = np.linalg.norm(A - Q @ (Q.T @ A)) / np.linalg.norm(A)
+        assert resid < 1e-2
+
+    def test_missing_params_raise(self):
+        from libskylark_tpu.base import errors
+        from libskylark_tpu.nla.krank import RandomizedRangeFinder
+
+        with pytest.raises(errors.InvalidParametersError):
+            RandomizedRangeFinder(np.eye(4), "generic", {}, Context(seed=0))
+
+    def test_deterministic(self):
+        from libskylark_tpu.nla.krank import RandomizedRangeFinder
+
+        A = _lowrank_matrix()
+        Q1 = RandomizedRangeFinder(A, "generic", {"s": 10},
+                                   Context(seed=7)).compute()
+        Q2 = RandomizedRangeFinder(A, "generic", {"s": 10},
+                                   Context(seed=7)).compute()
+        np.testing.assert_allclose(np.asarray(Q1), np.asarray(Q2))
+
+
+class TestRangeAssisted:
+    def test_svd_direct(self):
+        from libskylark_tpu.nla.krank import (
+            RandomizedRangeFinder,
+            RangeAssistedSVD,
+        )
+
+        A = _lowrank_matrix()
+        Q = RandomizedRangeFinder(A, "power_iteration", {"s": 10, "q": 2},
+                                  Context(seed=1)).compute()
+        U, s, Vt = RangeAssistedSVD(A, Q).compute()
+        R = (np.asarray(U) * np.asarray(s)) @ np.asarray(Vt)
+        assert np.linalg.norm(R - A) / np.linalg.norm(A) < 1e-2
+        sv = np.linalg.svd(A, compute_uv=False)
+        np.testing.assert_allclose(np.asarray(s)[:5], sv[:5], rtol=1e-2)
+
+    def test_svd_row_extraction(self):
+        from libskylark_tpu.nla.krank import (
+            RandomizedRangeFinder,
+            RangeAssistedSVD,
+        )
+
+        A = _lowrank_matrix(noise=0)
+        Q = RandomizedRangeFinder(A, "subspace_iteration", {"s": 8, "q": 1},
+                                  Context(seed=1)).compute()
+        U, s, Vt = RangeAssistedSVD(A, Q, method="row_extraction").compute()
+        sv = np.linalg.svd(A, compute_uv=False)
+        np.testing.assert_allclose(np.sort(np.asarray(s))[::-1][:5], sv[:5],
+                                   rtol=5e-2)
+
+    def test_evd_direct_and_nystrom(self):
+        from libskylark_tpu.nla.krank import (
+            RandomizedRangeFinder,
+            RangeAssistedEVD,
+        )
+
+        B = _lowrank_matrix(80, 80, 4, noise=0)
+        A = (B @ B.T).astype(np.float32)  # PSD
+        # subspace iteration re-orthogonalizes each step, so the smallest
+        # retained eigendirection survives f32 roundoff (plain power
+        # iteration loses it at contrast (1/100)^5)
+        Q = RandomizedRangeFinder(A, "subspace_iteration", {"s": 8, "q": 1},
+                                  Context(seed=2)).compute()
+        ew = np.linalg.eigvalsh(A)[::-1]
+        for method in ("direct", "nystrom"):
+            w, U = RangeAssistedEVD(A, Q, method=method).compute()
+            w = np.sort(np.asarray(w))[::-1]
+            np.testing.assert_allclose(w[:4], ew[:4], rtol=1e-2)
+
+    def test_evd_one_pass(self):
+        from libskylark_tpu.nla.krank import (
+            RandomizedRangeFinder,
+            RangeAssistedEVD,
+        )
+
+        B = _lowrank_matrix(80, 80, 4, noise=0)
+        A = (B @ B.T).astype(np.float32)
+        ctx = Context(seed=2)
+        Q = RandomizedRangeFinder(A, "subspace_iteration", {"s": 8, "q": 1},
+                                  ctx).compute()
+        w, U = RangeAssistedEVD(A, Q, method="one_pass", params={"s": 16},
+                                context=ctx).compute()
+        ew = np.linalg.eigvalsh(A)[::-1]
+        w = np.sort(np.asarray(w))[::-1]
+        # one-pass is the crudest variant; check the well-separated top-3
+        np.testing.assert_allclose(w[:3], ew[:3], rtol=0.15)
+
+    def test_randomized_svd_convenience(self):
+        from libskylark_tpu.nla.krank import randomized_svd
+
+        A = _lowrank_matrix()
+        U, s, Vt = randomized_svd(A, 5, Context(seed=4), q=2)
+        assert U.shape == (120, 5) and s.shape == (5,) and Vt.shape == (5, 60)
+        R = (np.asarray(U) * np.asarray(s)) @ np.asarray(Vt)
+        assert np.linalg.norm(R - A) / np.linalg.norm(A) < 1e-2
+
+
+class TestRandEVD:
+    def test_power_iterations(self):
+        from libskylark_tpu.nla.randlobpcg import power_iterations_rand_evd
+
+        A = _lowrank_matrix(200, 30, 5, noise=1e-3)
+        lam, Vt = power_iterations_rand_evd(A, 5, Context(seed=5),
+                                            power_iters=3)
+        ew = np.linalg.eigvalsh(A.T @ A)[::-1]
+        np.testing.assert_allclose(np.asarray(lam)[:3], ew[:3], rtol=1e-2)
+
+    def test_lobpcg(self):
+        from libskylark_tpu.nla.randlobpcg import lobpcg_rand_evd
+
+        A = _lowrank_matrix(300, 24, 4, noise=1e-3)
+        lam, Vt = lobpcg_rand_evd(A, 4, Context(seed=6))
+        ew = np.linalg.eigvalsh(A.T @ A)[::-1]
+        np.testing.assert_allclose(lam[:2], ew[:2], rtol=5e-2)
+
+
+class TestLowrank:
+    def test_dominant_subspace(self):
+        from libskylark_tpu.nla.lowrank import (
+            approximate_dominant_subspace_basis,
+        )
+
+        A = _lowrank_matrix(150, 40, 4, noise=1e-3)
+        Z, S, R, V = approximate_dominant_subspace_basis(
+            A, 4, 16, 40, Context(seed=8))
+        Z = np.asarray(Z)
+        resid = np.linalg.norm(A - Z @ (Z.T @ A), "fro")
+        sv = np.linalg.svd(A, compute_uv=False)
+        opt = np.sqrt((sv[4:] ** 2).sum())
+        assert resid <= 3.0 * opt + 1e-3
+
+
+def _classification_data(n=300, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (np.sin(X[:, 0]) + X[:, 1] > 0).astype(np.int64)
+    return X, y
+
+
+class TestNonlinear:
+    def test_rls(self):
+        from libskylark_tpu.ml.kernels import Gaussian
+        from libskylark_tpu.ml.metrics import classification_accuracy
+        from libskylark_tpu.ml.nonlinear import RLS
+
+        X, y = _classification_data()
+        model = RLS(Gaussian(8, sigma=2.0)).train(X[:200], y[:200],
+                                                  regularization=0.01)
+        pred = model.predict(X[200:])
+        assert classification_accuracy(pred, y[200:]) > 80
+
+    def test_sketchrls(self):
+        from libskylark_tpu.ml.kernels import Gaussian
+        from libskylark_tpu.ml.metrics import classification_accuracy
+        from libskylark_tpu.ml.nonlinear import SketchRLS
+
+        X, y = _classification_data()
+        model = SketchRLS(Gaussian(8, sigma=2.0)).train(
+            X[:200], y[:200], Context(seed=9), random_features=128,
+            regularization=0.01)
+        pred = model.predict(X[200:])
+        assert classification_accuracy(pred, y[200:]) > 75
+
+    @pytest.mark.parametrize("probdist", ["uniform", "leverages"])
+    def test_nystromrls(self, probdist):
+        from libskylark_tpu.ml.kernels import Gaussian
+        from libskylark_tpu.ml.metrics import classification_accuracy
+        from libskylark_tpu.ml.nonlinear import NystromRLS
+
+        X, y = _classification_data()
+        model = NystromRLS(Gaussian(8, sigma=2.0)).train(
+            X[:200], y[:200], Context(seed=10), random_features=64,
+            regularization=0.01, probdist=probdist)
+        pred = model.predict(X[200:])
+        assert classification_accuracy(pred, y[200:]) > 75
+
+    def test_sketchpcr(self):
+        from libskylark_tpu.ml.kernels import Gaussian
+        from libskylark_tpu.ml.metrics import classification_accuracy
+        from libskylark_tpu.ml.nonlinear import SketchPCR
+
+        X, y = _classification_data()
+        model = SketchPCR(Gaussian(8, sigma=2.0)).train(
+            X[:200], y[:200], Context(seed=11), rank=40)
+        pred = model.predict(X[200:])
+        assert classification_accuracy(pred, y[200:]) > 70
+
+    def test_rls_regression(self):
+        from libskylark_tpu.ml.kernels import Gaussian
+        from libskylark_tpu.ml.metrics import rmse
+        from libskylark_tpu.ml.nonlinear import RLS
+
+        rng = np.random.default_rng(3)
+        X = rng.standard_normal((150, 4)).astype(np.float32)
+        y = np.sin(X[:, 0]).astype(np.float32)
+        model = RLS(Gaussian(4, sigma=1.0)).train(
+            X[:100], y[:100], regularization=1e-3, multiclass=False)
+        pred = model.predict(X[100:])
+        assert rmse(pred, y[100:]) < 0.2
+
+
+class TestSprand:
+    def test_sample_density_and_values(self):
+        from libskylark_tpu.base.sprand import sample
+
+        S = sample(60, 50, 0.1, [-1, 1], [0.5, 0.5], Context(seed=12))
+        assert S.shape == (60, 50)
+        assert 0 < S.nnz <= 300
+        assert set(np.unique(S.data)) <= {-1.0, 1.0}
+
+    def test_hashmap_shapes(self):
+        from libskylark_tpu.base.sprand import hashmap
+
+        S0 = hashmap(8, 40, Context(seed=13))
+        assert S0.shape == (8, 40) and S0.nnz == 40
+        S1 = hashmap(8, 40, Context(seed=13), dimension=1)
+        assert S1.shape == (40, 8) and S1.nnz == 40
+        # every item hashed exactly once
+        D = np.asarray(S0.todense())
+        np.testing.assert_array_equal((D != 0).sum(axis=0), np.ones(40))
+
+
+class TestModeling:
+    def test_linearized_kernel_model(self, tmp_path):
+        from libskylark_tpu.algorithms.prox import (
+            L2Regularizer,
+            SquaredLoss,
+        )
+        from libskylark_tpu.ml.admm import BlockADMMSolver
+        from libskylark_tpu.ml.modeling import LinearizedKernelModel
+
+        X, y = _classification_data(120, 5, seed=4)
+        solver = BlockADMMSolver(SquaredLoss(), L2Regularizer(), 0.01, 5)
+        solver.maxiter = 5
+        model = solver.train(X, y)
+        path = str(tmp_path / "m.json")
+        model.save(path)
+        lkm = LinearizedKernelModel(path)
+        assert lkm.get_input_dimension() == 5
+        pred = lkm.predict(X)
+        assert np.asarray(pred).shape[0] == 120
