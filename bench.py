@@ -1,12 +1,19 @@
 """Headline benchmark: dense JLT sketch-apply throughput (GB/s/chip).
 
 BASELINE.json config 1 scaled to saturate one chip: rowwise JLT apply
-A·Sᵀ on a dense matrix (ref: sketch/JLT.hpp +
-sketch/dense_transform_Elemental_local.hpp). The sketch operator is
-generated on the fly from (seed, counter) and fused into the matmul, so
-effective bytes = read(A) + write(SA); the reference has no published
-numbers (BASELINE.md), so ``vs_baseline`` is the ratio against the
-previous round's recorded value when a BENCH_r*.json exists, else 1.0.
+A·Sᵀ on a dense 8192×8192 matrix with sketch size 1024 (ref:
+sketch/JLT.hpp + sketch/dense_transform_Elemental_local.hpp). The sketch
+operator is generated on the fly from (seed, counter); on TPU the apply
+runs through the fused Pallas generation+matmul kernel
+(sketch/pallas_dense.py). Effective bytes = read(A) + write(SA); the
+reference has no published numbers (BASELINE.md), so ``vs_baseline`` is
+the ratio against the previous round's recorded value when a
+BENCH_r*.json exists, else 1.0.
+
+Each timed iteration consumes the FULL sketch output (the loop carries
+sum(abs(SA)) back into the next input), so XLA cannot dead-code-eliminate
+any part of the contraction; per-iteration time is the slope between a
+2-iteration and a 12-iteration loop, cancelling dispatch/tunnel latency.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
@@ -30,24 +37,30 @@ def run(m: int = 8192, n: int = 8192, s: int = 1024, repeats: int = 5):
 
     from libskylark_tpu.base.context import Context
     from libskylark_tpu.sketch import JLT, ROWWISE
+    from libskylark_tpu.sketch import pallas_dense as pd
 
     ctx = Context(seed=0)
     jlt = JLT(n, s, ctx)
+    key = jlt._alloc.key
+    use_pallas = pd.available()
 
     rng = np.random.default_rng(1)
     A = jax.device_put(jnp.asarray(
         rng.standard_normal((m, n), dtype=np.float32)))
 
-    # K on-device apply iterations chained by a data dependence (so XLA
-    # cannot CSE them), synced by a scalar host readback. Per-iteration
-    # time = slope between two K values — cancels dispatch/tunnel
-    # round-trip latency, which on this platform `block_until_ready`
-    # does not capture.
+    def one_apply(X):
+        if use_pallas:
+            out = pd.rowwise_apply(key, jlt.dist, X, s, jlt.scale)
+            if out is not None:
+                return out
+        return jlt.apply(X, ROWWISE)
+
     def iterate(X, K):
         def body(_, acc):
-            SA = jlt.apply(X + acc * 1e-30, ROWWISE)
-            return jnp.float32(SA[0, 0])
-
+            SA = one_apply(X + acc)
+            # consume every element of SA; scale keeps the carry ~0 so the
+            # input matrix is numerically unchanged between iterations
+            return jnp.sum(jnp.abs(SA)).astype(jnp.float32) * 1e-37
         return lax.fori_loop(0, K, body, jnp.float32(0.0))
 
     k1, k2 = 2, 12

@@ -58,6 +58,13 @@ class Distribution:
     def sample(self, key: jax.Array, shape, dtype=jnp.float32) -> jax.Array:
         raise NotImplementedError
 
+    def from_bits(self, bits: jax.Array) -> jax.Array:
+        """Map uint32 bits -> f32 samples (the dense-block fast path; see
+        :func:`dense_block`, which detects support structurally — a
+        distribution without an override keeps the legacy sample() block
+        definition and this method is never called)."""
+        raise NotImplementedError(f"{self.name} has no bit transform")
+
     def to_dict(self) -> dict[str, Any]:
         d = dataclasses.asdict(self)  # type: ignore[call-overload]
         d["distribution"] = self.name
@@ -79,6 +86,11 @@ class Normal(Distribution):
     def sample(self, key, shape, dtype=jnp.float32):
         return self.mean + self.std * jr.normal(key, shape, dtype)
 
+    def from_bits(self, bits):
+        from libskylark_tpu.base import threefry as tf
+
+        return self.mean + self.std * tf.bits_to_normal(bits)
+
 
 @dataclasses.dataclass(frozen=True)
 class Uniform(Distribution):
@@ -88,6 +100,11 @@ class Uniform(Distribution):
 
     def sample(self, key, shape, dtype=jnp.float32):
         return jr.uniform(key, shape, dtype, minval=self.low, maxval=self.high)
+
+    def from_bits(self, bits):
+        from libskylark_tpu.base import threefry as tf
+
+        return tf.bits_to_uniform(bits, self.low, self.high)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -112,6 +129,11 @@ class Cauchy(Distribution):
     def sample(self, key, shape, dtype=jnp.float32):
         return self.loc + self.scale * jr.cauchy(key, shape, dtype)
 
+    def from_bits(self, bits):
+        from libskylark_tpu.base import threefry as tf
+
+        return self.loc + self.scale * tf.bits_to_cauchy(bits)
+
 
 @dataclasses.dataclass(frozen=True)
 class Rademacher(Distribution):
@@ -119,6 +141,11 @@ class Rademacher(Distribution):
 
     def sample(self, key, shape, dtype=jnp.float32):
         return jr.rademacher(key, shape).astype(dtype)
+
+    def from_bits(self, bits):
+        from libskylark_tpu.base import threefry as tf
+
+        return tf.bits_to_rademacher(bits)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -229,13 +256,37 @@ def dense_block(
 ) -> jax.Array:
     """Column block ``block_id`` of a virtual i.i.d. (rows x n) matrix.
 
-    The matrix is defined in column blocks of width ``block_cols``: block ``b``
-    is ``sampler(chunk_key(key, b), (rows, block_cols))``. Any shard can
-    materialize any column panel without generating the rest — the TPU-native
-    form of the reference's ``realize_matrix_view`` lazy-panel trick
-    (ref: sketch/dense_transform_data.hpp:79-152). ``block_id`` may be traced.
+    Any shard can materialize any column panel without generating the rest —
+    the TPU-native form of the reference's ``realize_matrix_view`` lazy-panel
+    trick (ref: sketch/dense_transform_data.hpp:79-152). ``block_id`` may be
+    traced.
+
+    Block format (when the distribution has a bit transform): with
+    (k0, k1) = key_data(chunk_key(key, b)), ``half = block_cols // 2`` and
+    counter c[r, j] = r·half + j, Threefry-2x32-20 of (c, c + rows·half)
+    yields two uint32 lanes; the block is
+    ``[from_bits(lane0) | from_bits(lane1)]`` columns. Written in explicit
+    integer ops (base/threefry.py) so the Pallas fused-apply kernel
+    (sketch/pallas_dense.py) can reproduce the exact bits in-kernel.
+    Distributions without a bit transform keep the legacy
+    ``dist.sample(chunk_key(key, b), ...)`` definition.
     """
-    return dist.sample(chunk_key(key, block_id), (rows, block_cols), dtype)
+    bkey = chunk_key(key, block_id)
+    has_bit_transform = type(dist).from_bits is not Distribution.from_bits
+    if not has_bit_transform or block_cols % 2:
+        return dist.sample(bkey, (rows, block_cols), dtype)
+
+    from libskylark_tpu.base import threefry as tf
+
+    kd = jr.key_data(bkey).astype(jnp.uint32)
+    half = block_cols // 2
+    c = (
+        jnp.arange(rows, dtype=jnp.uint32)[:, None] * jnp.uint32(half)
+        + jnp.arange(half, dtype=jnp.uint32)[None, :]
+    )
+    b0, b1 = tf.threefry2x32(kd[0], kd[1], c, c + jnp.uint32(rows * half))
+    block = jnp.concatenate([dist.from_bits(b0), dist.from_bits(b1)], axis=1)
+    return block.astype(dtype)
 
 
 def dense_panel(

@@ -33,13 +33,26 @@ def sample(
     if not 0.0 <= density <= 1.0:
         raise errors.InvalidParametersError(f"bad density {density}")
     nnz = int(round(density * m * n))
-    # positions: sample nnz distinct flat indices via a uniform stream
-    # (duplicates collapse, matching scipy.sparse.rand's behavior of
-    # approximate density)
-    flat = np.asarray(randgen.stream_slice(
-        context.allocate().key, randgen.UniformInt(0, m * n - 1), 0,
-        max(nnz, 1), dtype=jnp.int32), dtype=np.int64)[:nnz]
-    flat = np.unique(flat)
+    # positions: draw from the stream until nnz DISTINCT flat indices are
+    # collected (scipy.sparse.rand semantics: exact nnz), consuming the
+    # uniform-int stream in growing slices
+    key = context.allocate().key
+    chosen: list = []
+    seen: set = set()
+    lo = 0
+    draw = max(2 * nnz, 16)
+    while len(chosen) < nnz and lo < 64 * max(nnz, 1):
+        batch = np.asarray(randgen.stream_slice(
+            key, randgen.UniformInt(0, m * n - 1), lo, lo + draw,
+            dtype=jnp.int32), dtype=np.int64)
+        lo += draw
+        for v in batch:  # insertion order — no positional bias
+            if v not in seen:
+                seen.add(int(v))
+                chosen.append(int(v))
+                if len(chosen) == nnz:
+                    break
+    flat = np.asarray(chosen, dtype=np.int64)
     rows, cols = flat // n, flat % n
     u = np.asarray(randgen.stream_slice(
         context.allocate().key, randgen.Uniform(), 0, max(len(flat), 1),

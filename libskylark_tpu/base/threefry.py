@@ -1,0 +1,102 @@
+"""Threefry-2x32-20 counter PRNG, written in plain jnp integer ops.
+
+This is the bit-level definition of the framework's *dense block* stream
+format (ref: base/randgen.hpp Random123 Threefry usage:98-115). It exists as
+explicit ops — rather than calling ``jax.random`` — so the exact same
+sequence of 32-bit adds/xors/rotations can run in three places with
+identical bits:
+
+1. the XLA path (:func:`randgen.dense_block`),
+2. the Pallas TPU kernel that generates sketch panels inside a fused
+   matmul (sketch/pallas_dense.py),
+3. any host-side replay (integer ops are bitwise identical on every
+   backend).
+
+The algorithm is the public Threefry-2x32 with 20 rounds (5 groups of 4)
+from Salmon et al., "Parallel random numbers: as easy as 1, 2, 3" (SC'11) —
+the same cipher the reference's Random123 dependency implements.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+# rotation schedule for Threefry-2x32 (Salmon et al. Table 2)
+_ROTATIONS = (13, 15, 26, 6, 17, 29, 16, 24)
+_PARITY = 0x1BD11BDA
+
+# NOTE: every numeric constant below is a weak-typed Python scalar on
+# purpose — jnp.uint32(...)/jnp.float32(...) create array constants, which
+# a Pallas kernel cannot capture. Weak scalars promote to the operand's
+# dtype and trace cleanly both in XLA and inside kernels.
+
+
+def _rotl(x: jnp.ndarray, r: int) -> jnp.ndarray:
+    return (x << r) | (x >> (32 - r))
+
+
+def threefry2x32(k0, k1, c0: jnp.ndarray, c1: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Encrypt counter words (c0, c1) under key (k0, k1).
+
+    ``c0``/``c1`` are uint32 arrays; ``k0``/``k1`` are uint32 scalars
+    (python ints, numpy scalars, or traced values — e.g. SMEM reads inside
+    a Pallas kernel). Returns two uint32 arrays of c0's shape — 64 random
+    bits per counter.
+    """
+    ks2 = k0 ^ k1 ^ _PARITY
+    x0 = c0.astype(jnp.uint32) + k0
+    x1 = c1.astype(jnp.uint32) + k1
+    keys = (k0, k1, ks2)
+    for group in range(5):
+        r0, r1, r2, r3 = _ROTATIONS[:4] if group % 2 == 0 else _ROTATIONS[4:]
+        for r in (r0, r1, r2, r3):
+            x0 = x0 + x1
+            x1 = _rotl(x1, r) ^ x0
+        # key injection after each 4-round group
+        x0 = x0 + keys[(group + 1) % 3]
+        x1 = x1 + keys[(group + 2) % 3] + (group + 1)
+    return x0, x1
+
+
+def bits_to_unit(bits: jnp.ndarray) -> jnp.ndarray:
+    """uint32 bits → f32 uniform in [0, 1) with 24-bit resolution.
+
+    The top 24 bits are bitcast to int32 before the float cast — the value
+    fits, and Mosaic (Pallas TPU) has no uint32→f32 cast."""
+    import jax
+
+    top = jax.lax.bitcast_convert_type(bits >> 8, jnp.int32)
+    return top.astype(jnp.float32) * (2.0**-24)
+
+
+def bits_to_normal(bits: jnp.ndarray) -> jnp.ndarray:
+    """uint32 bits → f32 standard normal via inverse-CDF.
+
+    z = √2·erfinv(2u−1) with u clamped away from {0,1}. The integer→(−1,1)
+    mapping is bit-exact everywhere; erfinv itself is backend-dependent at
+    the ~1e-5 level (the framework's accepted cross-backend drift — the
+    reference's oracle tolerance is 1e-4)."""
+    import jax
+
+    u = bits_to_unit(bits)
+    v = jnp.clip(2.0 * u - 1.0, -1.0 + 2.0**-23, 1.0 - 2.0**-23)
+    return 1.4142135623730951 * jax.lax.erf_inv(v)
+
+
+def bits_to_cauchy(bits: jnp.ndarray) -> jnp.ndarray:
+    """uint32 bits → f32 standard Cauchy: tan(π(u−1/2)), u clamped."""
+    u = bits_to_unit(bits)
+    v = jnp.clip(u, 2.0**-24, 1.0 - 2.0**-24)
+    return jnp.tan(3.141592653589793 * (v - 0.5))
+
+
+def bits_to_rademacher(bits: jnp.ndarray) -> jnp.ndarray:
+    """uint32 bits → ±1 from the top bit."""
+    return jnp.where((bits >> 31) == 0, 1.0, -1.0).astype(jnp.float32)
+
+
+def bits_to_uniform(bits: jnp.ndarray, low: float, high: float) -> jnp.ndarray:
+    return low + bits_to_unit(bits) * (high - low)

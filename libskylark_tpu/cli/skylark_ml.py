@@ -187,10 +187,14 @@ def _test(args) -> int:
     labels, decisions = model.predict(Xd)
     labels = np.asarray(labels)
     Yn = np.asarray(Y)
-    if (not model.regression and model.label_coding is not None
-            and model.num_outputs > 1):
-        # decode class indices back to the original training label values
-        labels = np.asarray(model.label_coding)[labels.ravel()]
+    if not model.regression and model.num_outputs > 1:
+        if model.label_coding is not None:
+            # decode class indices back to the original training labels
+            labels = np.asarray(model.label_coding)[labels.ravel()]
+        else:
+            # legacy model file without a stored coding: recode the test
+            # labels to 0..k-1 the way training did
+            Yn = np.searchsorted(np.unique(Yn), Yn)
     if args.outputfile:
         out = np.asarray(decisions) if args.decisionvals else labels
         np.savetxt(args.outputfile + ".txt", out, fmt="%.8g")

@@ -39,6 +39,7 @@ from libskylark_tpu.base.precision import with_solver_precision
 from libskylark_tpu.ml.kernels import Kernel
 from libskylark_tpu.ml.model import HilbertModel
 from libskylark_tpu.sketch import ROWWISE, SketchTransform
+from libskylark_tpu.utility.timer import get_timer, timers_enabled
 
 
 def _partition(num_features: int, num_partitions: int) -> list[int]:
@@ -185,16 +186,24 @@ class BlockADMMSolver:
             regression, input_size=d,
         )
 
+        # Per-phase profile (ref: BlockADMM.hpp:357-365 SKYLARK_TIMER
+        # phases); enabled by SKYLARK_TPU_PROFILE=1 / utility.set_enabled.
+        # Reset so each train() reports its own run, not cumulative totals.
+        timer = get_timer("admm")
+        timer.reset()
+
         # Cached per-block factorizations (ZⱼᵀZⱼ + I)⁻¹ (ref: :435-441 at
         # iter 1; hoisted here since Zⱼ is deterministic given the maps).
         caches = []
         Zs = []
         for j in range(P):
-            Z = self._block_features(X, j)
+            with timer.phase("TRANSFORM"):
+                Z = self._block_features(X, j)
             sj = self.block_sizes[j]
-            caches.append(
-                jsl.cho_factor(Z.T @ Z + jnp.eye(sj, dtype=dt))
-            )
+            with timer.phase("FACTORIZATION"):
+                caches.append(
+                    jsl.cho_factor(Z.T @ Z + jnp.eye(sj, dtype=dt))
+                )
             if self.cache_transforms:
                 Zs.append(Z)
 
@@ -207,8 +216,9 @@ class BlockADMMSolver:
 
             mu_ij = mu_ij - Wbar                     # ref: :378-380
             Obar = Obar - nu
-            O = loss.prox(Obar, 1.0 / rho, Y)        # ref: :385
-            W = reg.prox(Wbar, lam / rho, mu)        # ref: :389
+            with jax.named_scope("PROXLOSS"):        # trace-visible phases
+                O = loss.prox(Obar, 1.0 / rho, Y)    # ref: :385
+                W = reg.prox(Wbar, lam / rho, mu)    # ref: :389
 
             sum_o = jnp.zeros((k, n), dt)
             wbar_output = jnp.zeros((k, n), dt)
@@ -218,6 +228,7 @@ class BlockADMMSolver:
 
             dsum = (del_o / (P + 1.0) + nu).T        # (n, k); ref: :464-469
 
+            # ZMULT phase of the reference — the per-block solves + gemms
             for j in range(P):
                 start, sj = starts[j], sizes[j]
                 sl = slice(start, start + sj)
@@ -265,12 +276,17 @@ class BlockADMMSolver:
         )
 
         for it in range(1, self.maxiter + 1):
-            carry, (objective, reldel) = step_jit(carry)
+            with timer.phase("ITERATIONS"):
+                carry, (objective, reldel) = step_jit(carry)
+                if timers_enabled():
+                    jax.block_until_ready(carry)  # attribute device time here
             model.coef = carry[0]
             if verbose:
                 msg = f"iteration {it} objective {float(objective):.6g}"
                 if Xv is not None:
-                    msg += f" accuracy {self._validate(model, Xv, Yv, regression):.4g}"
+                    with timer.phase("PREDICTION"):
+                        acc = self._validate(model, Xv, Yv, regression)
+                    msg += f" accuracy {acc:.4g}"
                 print(msg)
             # Convergence on relative change of the consensus iterate. (The
             # reference carries TOL but never reads it in the train loop —
@@ -279,6 +295,10 @@ class BlockADMMSolver:
                 break
 
         model.coef = carry[0]
+        if timers_enabled():
+            import sys
+
+            timer.report(stream=sys.stdout)
         return model
 
     @staticmethod

@@ -25,6 +25,14 @@ class LinearizedKernelModel:
 
     def predict(self, X):
         labels, _ = self._model.predict(X)
+        m = self._model
+        if (not m.regression and m.label_coding is not None
+                and m.num_outputs > 1):
+            import numpy as np
+
+            # decode class indices to the original training label values,
+            # same as the skylark_ml test path
+            return np.asarray(m.label_coding)[np.asarray(labels).ravel()]
         return labels
 
     def decision_values(self, X):

@@ -45,7 +45,11 @@ def lobpcg_rand_evd(
     if s >= m:
         raise errors.InvalidParametersError(f"sketch size {s} >= rows {m}")
 
-    T = {"cwt": sk.CWT, "jlt": sk.JLT, "fjlt": sk.FJLT}[sketch](m, s, context)
+    sketches = {"cwt": sk.CWT, "jlt": sk.JLT, "fjlt": sk.FJLT}
+    if sketch not in sketches:
+        raise errors.InvalidParametersError(
+            f"sketch must be one of {sorted(sketches)}, got {sketch!r}")
+    T = sketches[sketch](m, s, context)
     B = np.asarray(T.apply(A, sk.COLUMNWISE))
     _, Sigma, Vt = np.linalg.svd(B, full_matrices=False)
     _, R = np.linalg.qr(B)

@@ -74,11 +74,36 @@ class DenseTransform(SketchTransform):
         return S @ A
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        out = self._try_pallas_rowwise(A)
+        if out is not None:
+            return out
         blocksize = sketch_params.get_blocksize()
         if blocksize and self._N > blocksize:
             return self._apply_rowwise_blocked(A, blocksize)
         S = self.s_panel(0, self._N, A.dtype)
         return A @ S.T
+
+    def _try_pallas_rowwise(self, A):
+        """Fused generation+matmul TPU kernel (sketch/pallas_dense.py);
+        None when the backend/input don't qualify — concrete, single-device
+        f32 arrays only (sharded applies keep the XLA path, whose
+        partitioning XLA handles)."""
+        if not sketch_params.get_use_pallas():
+            return None
+        import jax
+
+        if isinstance(A, jax.core.Tracer) or not isinstance(A, jax.Array):
+            return None
+        try:
+            if len(A.sharding.device_set) != 1:
+                return None
+        except Exception:
+            return None
+        from libskylark_tpu.sketch import pallas_dense
+
+        return pallas_dense.rowwise_apply(
+            self._alloc.key, self.dist, A, self._S, self.scale
+        )
 
     # -- sparse input (ref: sketch/dense_transform_Mixed.hpp:19) --
 

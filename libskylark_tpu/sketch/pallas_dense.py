@@ -1,0 +1,170 @@
+"""Pallas TPU kernel: fused on-the-fly sketch generation + matmul.
+
+The hot primitive of the framework (ref: SURVEY.md §3.1 — the reference's
+blocked panel algorithm in sketch/dense_transform_Elemental_mc_mr.hpp with
+``realize_matrix_view`` generating S panels on demand). The XLA path pays
+for panel generation (Threefry + inverse-CDF on the VPU) serialized against
+the matmul; this kernel generates each (S_dim × BLOCK_COLS) panel of S in
+VMEM — exact same bits as :func:`randgen.dense_block`, via the shared
+integer-op Threefry in base/threefry.py — while the MXU contracts the
+previous panels, so generation rides under the matmul.
+
+Rowwise apply only (out = A·Sᵀ, the regime of BASELINE config 1); other
+layouts fall back to the XLA path in sketch/dense.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from libskylark_tpu.base import randgen, threefry as tf
+
+try:  # import guarded so non-TPU environments can import the module
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+BLOCK_COLS = 256  # must equal sketch.dense.BLOCK_COLS (stream format)
+_HALF = BLOCK_COLS // 2
+
+
+def available() -> bool:
+    """True when the default backend can run the Mosaic kernel."""
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _kernel(dist_kind, s_dim, m_tile, keys_ref, a_ref, out_ref):
+    k = pl.program_id(1)
+
+    # -- generate S block (s_dim, BLOCK_COLS): bit-identical to
+    #    randgen.dense_block's threefry-pair layout --
+    k0 = keys_ref[k, 0]
+    k1 = keys_ref[k, 1]
+    c = (
+        jax.lax.broadcasted_iota(jnp.uint32, (s_dim, _HALF), 0) * _HALF
+        + jax.lax.broadcasted_iota(jnp.uint32, (s_dim, _HALF), 1)
+    )
+    b0, b1 = tf.threefry2x32(k0, k1, c, c + s_dim * _HALF)
+    if dist_kind == "normal":
+        s0, s1 = tf.bits_to_normal(b0), tf.bits_to_normal(b1)
+    elif dist_kind == "cauchy":
+        s0, s1 = tf.bits_to_cauchy(b0), tf.bits_to_cauchy(b1)
+    elif dist_kind == "rademacher":
+        s0, s1 = tf.bits_to_rademacher(b0), tf.bits_to_rademacher(b1)
+    else:
+        raise NotImplementedError(dist_kind)
+    S_blk = jnp.concatenate([s0, s1], axis=1)  # (s_dim, BLOCK_COLS)
+
+    # -- accumulate A_tile @ S_blkᵀ into the output tile. bf16 inputs +
+    # f32 accumulation: the MXU-native regime, matching XLA's DEFAULT
+    # matmul precision on TPU (the S entries themselves stay bit-exact;
+    # only the contraction rounds at hardware precision) --
+    acc = jax.lax.dot_general(
+        a_ref[:].astype(jnp.bfloat16),
+        S_blk.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[:] = acc
+
+    @pl.when(k != 0)
+    def _acc():
+        out_ref[:] += acc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("s_dim", "dist_kind", "m_tile")
+)
+def _fused_call(A, keys, *, s_dim, dist_kind, m_tile):
+    m, n = A.shape
+    n_blocks = n // BLOCK_COLS
+    grid = (m // m_tile, n_blocks)
+    kern = functools.partial(_kernel, dist_kind, s_dim, m_tile)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            # whole key table in SMEM every step (tiny); indexed by k
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (m_tile, BLOCK_COLS), lambda i, k: (i, k),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (m_tile, s_dim), lambda i, k: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((m, s_dim), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(keys, A)
+
+
+_DIST_KINDS = {
+    randgen.Normal: "normal",
+    randgen.Cauchy: "cauchy",
+    randgen.Rademacher: "rademacher",
+}
+
+
+def supported(dist, dtype) -> bool:
+    kind = _DIST_KINDS.get(type(dist))
+    if kind is None:
+        return False
+    # only the standard forms share the plain bit transforms
+    if kind == "normal" and (dist.mean != 0.0 or dist.std != 1.0):
+        return False
+    if kind == "cauchy" and (dist.loc != 0.0 or dist.scale != 1.0):
+        return False
+    return jnp.dtype(dtype) == jnp.float32
+
+
+def rowwise_apply(
+    key: jax.Array,
+    dist,
+    A: jnp.ndarray,
+    s_dim: int,
+    scale: float,
+    m_tile: int = 256,
+) -> Optional[jnp.ndarray]:
+    """out = scale · A @ Sᵀ with S the virtual (s_dim × N) matrix of
+    :func:`randgen.dense_block`. Returns None when not applicable (caller
+    falls back to the XLA path)."""
+    if not (_HAVE_PALLAS and available() and supported(dist, A.dtype)):
+        return None
+    m, n = A.shape
+    if n % BLOCK_COLS or m < 8:
+        return None
+    m_tile = min(m_tile, m)
+    while m % m_tile:
+        m_tile //= 2
+    if m_tile < 8:
+        return None
+
+    n_blocks = n // BLOCK_COLS
+    bkeys = jax.vmap(lambda b: jr_key_data(randgen.chunk_key(key, b)))(
+        jnp.arange(n_blocks, dtype=jnp.int32)
+    ).astype(jnp.uint32)
+    out = _fused_call(A, bkeys, s_dim=s_dim, dist_kind=_DIST_KINDS[type(dist)],
+                      m_tile=m_tile)
+    return scale * out
+
+
+def jr_key_data(k):
+    import jax.random as jr
+
+    return jr.key_data(k)

@@ -29,3 +29,20 @@ def get_factor() -> int:
 def set_factor(f: int) -> None:
     global _factor
     _factor = int(f)
+
+
+# ``use_pallas`` — route dense-transform applies through the fused Pallas
+# TPU kernel (sketch/pallas_dense.py) when the input/backend qualify. On
+# TPU the contraction then runs at MXU-native precision (bf16 inputs, f32
+# accumulate — identical to XLA's DEFAULT matmul precision); the sketch
+# operator entries are bit-exact either way.
+_use_pallas = True
+
+
+def get_use_pallas() -> bool:
+    return _use_pallas
+
+
+def set_use_pallas(on: bool) -> None:
+    global _use_pallas
+    _use_pallas = bool(on)

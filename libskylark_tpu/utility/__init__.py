@@ -1,0 +1,11 @@
+"""Utility layer: profiling/timers and observability helpers
+(SURVEY.md §2.6/§5; ref: utility/timer.hpp, utility/external/print.hpp)."""
+
+from libskylark_tpu.utility.timer import (
+    PhaseTimer,
+    get_timer,
+    set_enabled,
+    timers_enabled,
+)
+
+__all__ = ["PhaseTimer", "get_timer", "set_enabled", "timers_enabled"]

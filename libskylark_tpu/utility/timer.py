@@ -1,0 +1,108 @@
+"""Phase timers: accumulate per-phase wall time across an algorithm run.
+
+TPU-native analog of ref: utility/timer.hpp:6-64 — the
+SKYLARK_TIMER_{INITIALIZE,RESTART,ACCUMULATE,PRINT} macro family that
+BlockADMM uses to profile its phases (ref: ml/BlockADMM.hpp:357-365,573+).
+Where the reference reduces min/max/avg over MPI ranks at print time, the
+TPU runtime is single-controller: per-phase host wall time is the profile,
+and each phase also enters a ``jax.profiler.TraceAnnotation`` so the same
+phase names appear on the device timeline when tracing with
+``jax.profiler.trace`` (the deeper equivalent of the reference's profiler
+integration).
+
+Enablement mirrors the reference's compile-time SKYLARK_HAVE_PROFILER gate
+(ref: config.h.in:107-108) as a runtime switch: the SKYLARK_TPU_PROFILE=1
+environment variable or :func:`set_enabled`. Disabled timers cost one dict
+lookup and one branch per phase.
+
+Timing note: phases measure *host* wall time. JAX dispatch is async — a
+phase that only enqueues device work appears near-free while the next
+synchronizing phase absorbs its cost. Phases that must attribute device
+time accurately should end with a ``block_until_ready`` on their outputs
+(the ADMM instrumentation does this for the iteration phase only, to avoid
+serializing the pipeline the rest of the time).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+_ENABLED: Optional[bool] = None
+
+
+def timers_enabled() -> bool:
+    global _ENABLED
+    if _ENABLED is None:
+        _ENABLED = os.environ.get("SKYLARK_TPU_PROFILE", "") not in ("", "0")
+    return _ENABLED
+
+
+def set_enabled(on: bool) -> None:
+    """Programmatic switch (overrides the environment gate)."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+class PhaseTimer:
+    """Named accumulators: ``with timer.phase("TRANSFORM"): ...``
+    (ref: SKYLARK_TIMER_RESTART/ACCUMULATE, utility/timer.hpp:23-42)."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    @contextmanager
+    def phase(self, label: str):
+        if not timers_enabled():
+            yield
+            return
+        import jax.profiler
+
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation(label):
+            yield
+        dt = time.perf_counter() - t0
+        self.totals[label] = self.totals.get(label, 0.0) + dt
+        self.counts[label] = self.counts.get(label, 0) + 1
+
+    def accumulate(self, label: str, seconds: float) -> None:
+        """Manual accumulation for phases timed externally."""
+        if not timers_enabled():
+            return
+        self.totals[label] = self.totals.get(label, 0.0) + float(seconds)
+        self.counts[label] = self.counts.get(label, 0) + 1
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()
+
+    def report(self, stream=None) -> str:
+        """Format (and optionally print) the phase table
+        (ref: SKYLARK_TIMER_PRINT, utility/timer.hpp:44-53)."""
+        lines = [f"== phase timings{' [' + self.name + ']' if self.name else ''} =="]
+        width = max((len(k) for k in self.totals), default=5)
+        for label in sorted(self.totals, key=self.totals.get, reverse=True):
+            t, c = self.totals[label], self.counts[label]
+            lines.append(
+                f"{label.ljust(width)}  total {t:10.4f}s  "
+                f"calls {c:6d}  avg {t / c:10.6f}s"
+            )
+        text = "\n".join(lines)
+        if stream is not None:
+            print(text, file=stream)
+        return text
+
+
+_REGISTRY: Dict[str, PhaseTimer] = {}
+
+
+def get_timer(name: str = "default") -> PhaseTimer:
+    """Process-wide named timer registry (the reference's file-scope timer
+    variables declared by SKYLARK_TIMER_INITIALIZE)."""
+    if name not in _REGISTRY:
+        _REGISTRY[name] = PhaseTimer(name)
+    return _REGISTRY[name]

@@ -285,3 +285,40 @@ class TestModeling:
         assert lkm.get_input_dimension() == 5
         pred = lkm.predict(X)
         assert np.asarray(pred).shape[0] == 120
+
+
+class TestReviewRegressions:
+    def test_sample_exact_density(self):
+        from libskylark_tpu.base.sprand import sample
+
+        S = sample(100, 100, 0.5, [-1, 1], [0.5, 0.5], Context(seed=20))
+        assert S.nnz == 5000  # exact, scipy.sparse.rand semantics
+
+    def test_lobpcg_bad_sketch_name(self):
+        from libskylark_tpu.base import errors
+        from libskylark_tpu.nla.randlobpcg import lobpcg_rand_evd
+
+        A = _lowrank_matrix(100, 20, 3)
+        with pytest.raises(errors.InvalidParametersError):
+            lobpcg_rand_evd(A, 3, Context(seed=21), sketch="gaussian")
+
+    def test_linearized_model_decodes_labels(self, tmp_path):
+        from libskylark_tpu.algorithms.prox import (
+            L2Regularizer,
+            SquaredLoss,
+        )
+        from libskylark_tpu.ml.admm import BlockADMMSolver
+        from libskylark_tpu.ml.modeling import LinearizedKernelModel
+
+        rng = np.random.default_rng(7)
+        X = rng.standard_normal((80, 4)).astype(np.float32)
+        raw = np.where(X[:, 0] > 0, 9, 3)
+        solver = BlockADMMSolver(SquaredLoss(), L2Regularizer(), 0.001, 4)
+        solver.maxiter = 20
+        classes = np.unique(raw)
+        model = solver.train(X, np.searchsorted(classes, raw))
+        model.label_coding = classes.tolist()
+        p = str(tmp_path / "m.json")
+        model.save(p)
+        pred = LinearizedKernelModel(p).predict(X)
+        assert set(np.unique(pred)) <= {3, 9}

@@ -1,0 +1,79 @@
+"""Phase-timer tests (ref: utility/timer.hpp macro semantics)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from libskylark_tpu.utility import timer as tmod
+from libskylark_tpu.utility.timer import PhaseTimer, get_timer
+
+
+@pytest.fixture(autouse=True)
+def _restore_enabled():
+    prev = tmod._ENABLED
+    yield
+    tmod._ENABLED = prev
+
+
+class TestPhaseTimer:
+    def test_disabled_is_noop(self):
+        tmod.set_enabled(False)
+        t = PhaseTimer()
+        with t.phase("A"):
+            pass
+        assert t.totals == {}
+
+    def test_accumulates(self):
+        tmod.set_enabled(True)
+        t = PhaseTimer("x")
+        for _ in range(3):
+            with t.phase("A"):
+                sum(range(1000))
+        with t.phase("B"):
+            pass
+        assert t.counts["A"] == 3 and t.counts["B"] == 1
+        assert t.totals["A"] > 0
+        report = t.report()
+        assert "A" in report and "calls" in report
+        t.reset()
+        assert t.totals == {}
+
+    def test_manual_accumulate(self):
+        tmod.set_enabled(True)
+        t = PhaseTimer()
+        t.accumulate("X", 1.5)
+        t.accumulate("X", 0.5)
+        assert t.totals["X"] == 2.0 and t.counts["X"] == 2
+
+    def test_registry(self):
+        assert get_timer("foo") is get_timer("foo")
+        assert get_timer("foo") is not get_timer("bar")
+
+    def test_env_gate(self, monkeypatch):
+        tmod._ENABLED = None
+        monkeypatch.setenv("SKYLARK_TPU_PROFILE", "1")
+        assert tmod.timers_enabled()
+        tmod._ENABLED = None
+        monkeypatch.setenv("SKYLARK_TPU_PROFILE", "0")
+        assert not tmod.timers_enabled()
+
+
+class TestADMMInstrumentation:
+    def test_phases_recorded(self, capsys):
+        from libskylark_tpu.algorithms.prox import L2Regularizer, SquaredLoss
+        from libskylark_tpu.ml.admm import BlockADMMSolver
+
+        tmod.set_enabled(True)
+        get_timer("admm").reset()
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((60, 5)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int64)
+        solver = BlockADMMSolver(SquaredLoss(), L2Regularizer(), 0.01, 5)
+        solver.maxiter = 3
+        solver.train(X, y)
+        t = get_timer("admm")
+        assert "ITERATIONS" in t.totals
+        assert "TRANSFORM" in t.totals or "FACTORIZATION" in t.totals
+        out = capsys.readouterr().out
+        assert "phase timings" in out
