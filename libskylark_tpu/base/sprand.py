@@ -37,8 +37,7 @@ def sample(
     # collected (scipy.sparse.rand semantics: exact nnz), consuming the
     # uniform-int stream in growing slices
     key = context.allocate().key
-    chosen: list = []
-    seen: set = set()
+    chosen = np.zeros(0, dtype=np.int64)
     lo = 0
     draw = max(2 * nnz, 16)
     while len(chosen) < nnz and lo < 64 * max(nnz, 1):
@@ -46,13 +45,13 @@ def sample(
             key, randgen.UniformInt(0, m * n - 1), lo, lo + draw,
             dtype=jnp.int32), dtype=np.int64)
         lo += draw
-        for v in batch:  # insertion order — no positional bias
-            if v not in seen:
-                seen.add(int(v))
-                chosen.append(int(v))
-                if len(chosen) == nnz:
-                    break
-    flat = np.asarray(chosen, dtype=np.int64)
+        # vectorized first-occurrence dedup, preserving draw order (no
+        # positional bias from np.unique's sorting)
+        u, first = np.unique(batch, return_index=True)
+        u = u[np.argsort(first)]
+        u = u[~np.isin(u, chosen, assume_unique=True)]
+        chosen = np.concatenate([chosen, u])
+    flat = chosen[:nnz]
     rows, cols = flat // n, flat % n
     u = np.asarray(randgen.stream_slice(
         context.allocate().key, randgen.Uniform(), 0, max(len(flat), 1),

@@ -192,8 +192,11 @@ def _test(args) -> int:
             # decode class indices back to the original training labels
             labels = np.asarray(model.label_coding)[labels.ravel()]
         else:
-            # legacy model file without a stored coding: recode the test
-            # labels to 0..k-1 the way training did
+            # legacy model file without a stored coding: best effort —
+            # recode the test labels to 0..k-1; only correct when the test
+            # file contains exactly the training label set
+            print("warning: model has no label coding; assuming the test "
+                  "file's label set equals the training set", file=sys.stderr)
             Yn = np.searchsorted(np.unique(Yn), Yn)
     if args.outputfile:
         out = np.asarray(decisions) if args.decisionvals else labels

@@ -85,19 +85,24 @@ class DenseTransform(SketchTransform):
 
     def _try_pallas_rowwise(self, A):
         """Fused generation+matmul TPU kernel (sketch/pallas_dense.py);
-        None when the backend/input don't qualify — concrete, single-device
-        f32 arrays only (sharded applies keep the XLA path, whose
-        partitioning XLA handles)."""
+        None when the backend/input don't qualify. Sharded applies keep the
+        XLA path (its partitioning XLA handles); on a tracer the sharding
+        is unreadable, so traced applies use the kernel only when the
+        backend has a single device and sharding is impossible."""
         if not sketch_params.get_use_pallas():
             return None
         import jax
 
-        if isinstance(A, jax.core.Tracer) or not isinstance(A, jax.Array):
-            return None
-        try:
-            if len(A.sharding.device_set) != 1:
+        if isinstance(A, jax.core.Tracer):
+            if len(jax.devices()) != 1:
                 return None
-        except Exception:
+        elif isinstance(A, jax.Array):
+            try:
+                if len(A.sharding.device_set) != 1:
+                    return None
+            except Exception:
+                return None
+        else:
             return None
         from libskylark_tpu.sketch import pallas_dense
 

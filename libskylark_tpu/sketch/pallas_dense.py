@@ -31,7 +31,10 @@ try:  # import guarded so non-TPU environments can import the module
 except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
-BLOCK_COLS = 256  # must equal sketch.dense.BLOCK_COLS (stream format)
+from libskylark_tpu.sketch.dense import BLOCK_COLS  # the stream format's
+# panel width — single source of truth (dense.py imports this module only
+# lazily, so no cycle)
+
 _HALF = BLOCK_COLS // 2
 
 

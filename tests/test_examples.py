@@ -1,0 +1,23 @@
+"""Smoke-run every example script (the reference treats examples/ as
+executable documentation wired into the build — ref: examples/CMakeLists.txt)."""
+
+import importlib
+import sys
+
+import pytest
+
+sys.path.insert(0, "examples")
+
+
+@pytest.mark.parametrize("name", [
+    "sketching",
+    "least_squares",
+    "random_features",
+    "kernel_regression",
+    "condest_asynch",
+])
+def test_example_runs(name, capsys):
+    mod = importlib.import_module(name)
+    mod.main()
+    out = capsys.readouterr().out
+    assert out.strip(), f"{name} printed nothing"
