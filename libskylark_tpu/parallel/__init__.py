@@ -1,5 +1,6 @@
 """Parallelism layer: meshes, shardings, collective helpers (SURVEY.md §2.9)."""
 
+from libskylark_tpu.parallel import multihost, shard_apply
 from libskylark_tpu.parallel.mesh import (
     COLS,
     ROWS,
@@ -16,6 +17,8 @@ from libskylark_tpu.parallel.mesh import (
 )
 
 __all__ = [
+    "multihost",
+    "shard_apply",
     "COLS",
     "ROWS",
     "col_sharded",

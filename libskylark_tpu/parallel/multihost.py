@@ -1,0 +1,51 @@
+"""Multi-host bootstrap and host-level collectives.
+
+The reference's process model is MPI: ``MPI_Init`` in every CLI main
+(ref: ml/skylark_ml.cpp:17-20), Boost.MPI communicators threaded through
+every layer (ref: utility/get_communicator.hpp). The TPU-native process
+model is single-controller-per-host JAX: one call to
+``jax.distributed.initialize`` turns N hosts into one logical device pool;
+meshes built from ``jax.devices()`` then span hosts, and the same sharded
+code paths run unchanged with XLA routing collectives over ICI within a
+slice and DCN across slices (SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host pool (MPI_Init analog; idempotent).
+
+    With no arguments, uses the cluster-environment auto-detection
+    (TPU pods set the coordinator through the metadata environment).
+    Call before any jax computation, once per host process.
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address, num_processes, process_id)
+    except RuntimeError as e:  # already initialized — MPI_Init semantics
+        if "already" not in str(e).lower():
+            raise
+
+
+def process_count() -> int:
+    """Number of host processes (MPI size analog)."""
+    return jax.process_count()
+
+
+def process_index() -> int:
+    """This host's id (MPI rank analog); 0 is the reference's 'root'."""
+    return jax.process_index()
+
+
+def is_root() -> bool:
+    """ref: the ubiquitous ``rank == 0`` guard (e.g. ml/io.hpp readers)."""
+    return jax.process_index() == 0

@@ -1,0 +1,99 @@
+"""Sequence-parallel sketch application: explicit shard_map panel pipeline.
+
+The reference's structural analog of long-context parallelism is scaling
+the "long" dimension of a matrix past one node's memory: panel-blocked
+apply with a lazily materialized operator
+(ref: sketch/dense_transform_Elemental_mc_mr.hpp:87-207 panel loop,
+sketch/dense_transform_data.hpp:79-152 realize_matrix_view; SURVEY.md §5
+"long-context"). This module is that design made TPU-native and
+*manually scheduled*: the long axis N is sharded across a mesh axis, each
+device walks only its own column blocks of the virtual operator S —
+generated on-device from (seed, counter), never at full size — and one
+``psum`` combines the partial contractions. Memory per device:
+A-shard + one (S_dim × BLOCK_COLS) panel.
+
+This is the shard_map counterpart of the automatic path (plain
+``T.apply`` on a sharded array, where XLA chooses the schedule); use it
+when the panel pipeline must be explicit — ultra-long N where even the
+XLA-fused apply would materialize an (S_dim × N/p) operator shard.
+
+Works for any DenseTransform-backed sketch (JLT, CT, and the dense core
+of the feature maps). The returned computation is not pre-jitted — wrap
+in ``jax.jit`` at the call site like any other apply.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from libskylark_tpu.base import errors
+from libskylark_tpu.parallel.mesh import ROWS
+from libskylark_tpu.sketch.dense import BLOCK_COLS, DenseTransform
+
+
+def _pipeline(T, A, mesh: Mesh, axis: str, seq_axis: int) -> jnp.ndarray:
+    """Shared schedule: per-device fori_loop over the device's operator
+    column blocks, contracting against the matching slice of the local
+    A-shard along ``seq_axis``, then one psum (the reference's local-gemm
+    + all_reduce pattern, ref: base/Gemm.hpp:84-103)."""
+    if not isinstance(T, DenseTransform):
+        raise errors.UnsupportedError(
+            "sequence-parallel apply needs a DenseTransform-backed sketch; "
+            f"got {type(T).__name__}"
+        )
+    A = jnp.asarray(A)
+    N = T.input_dim
+    if A.shape[seq_axis] != N:
+        raise errors.SketchError(
+            f"sequence axis has {A.shape[seq_axis]} entries, transform "
+            f"expects {N} (A is {A.shape})"
+        )
+    p = mesh.shape[axis]
+    if N % (p * BLOCK_COLS):
+        raise errors.InvalidParametersError(
+            f"N={N} must be divisible by devices×BLOCK_COLS "
+            f"({p}×{BLOCK_COLS})"
+        )
+    blocks_per_shard = N // p // BLOCK_COLS
+    s_dim = T.sketch_dim
+    columnwise = seq_axis == 0
+
+    def local(A_loc):
+        d = lax.axis_index(axis)
+        first = d * blocks_per_shard
+
+        def body(b, acc):
+            Sb = T.s_block(first + b, A_loc.dtype)       # (s_dim, BC)
+            seg = lax.dynamic_slice_in_dim(
+                A_loc, b * BLOCK_COLS, BLOCK_COLS, axis=seq_axis)
+            return acc + (Sb @ seg if columnwise else seg @ Sb.T)
+
+        out_shape = ((s_dim, A_loc.shape[1]) if columnwise
+                     else (A_loc.shape[0], s_dim))
+        # the carry must be marked device-varying to match the body output
+        zero = jnp.zeros(out_shape, A_loc.dtype)
+        if hasattr(lax, "pcast"):
+            acc0 = lax.pcast(zero, axis, to="varying")
+        else:  # older jax
+            acc0 = lax.pvary(zero, axis)
+        return lax.psum(lax.fori_loop(0, blocks_per_shard, body, acc0),
+                        axis)
+
+    in_spec = P(axis, None) if columnwise else P(None, axis)
+    fn = shard_map(local, mesh=mesh, in_specs=in_spec,
+                   out_specs=P(None, None))
+    return fn(A)
+
+
+def columnwise(T, A, mesh: Mesh, axis: str = ROWS) -> jnp.ndarray:
+    """S·A for A (N, m) sharded on its first (sequence) axis; returns the
+    (S_dim, m) result replicated."""
+    return _pipeline(T, A, mesh, axis, seq_axis=0)
+
+
+def rowwise(T, A, mesh: Mesh, axis: str = ROWS) -> jnp.ndarray:
+    """A·Sᵀ for A (m, N) sharded on its second (sequence) axis; returns
+    the (m, S_dim) result replicated."""
+    return _pipeline(T, A, mesh, axis, seq_axis=1)

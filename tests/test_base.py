@@ -183,3 +183,64 @@ class TestMesh:
         b_s = par.distribute(b, par.replicated(mesh2d))
         out = jax.jit(jnp.matmul)(a_s, b_s)
         np.testing.assert_allclose(par.to_host(out), a @ b, rtol=1e-5)
+
+
+class TestSequenceParallelApply:
+    """Explicit shard_map panel pipeline == local apply (the long-context
+    analog; SURVEY.md §5)."""
+
+    def test_columnwise_matches_local(self, mesh1d):
+        import jax.numpy as jnp
+        from libskylark_tpu import sketch as sk
+        from libskylark_tpu.base.context import Context
+        from libskylark_tpu.parallel import shard_apply
+
+        N, S, m = 2048, 64, 16
+        rng = np.random.default_rng(5)
+        A = jnp.asarray(rng.standard_normal((N, m)).astype(np.float32))
+        T = sk.JLT(N, S, Context(seed=17))
+        local = np.asarray(T.apply(A, sk.COLUMNWISE))
+        seq = np.asarray(shard_apply.columnwise(T, A, mesh1d))
+        np.testing.assert_allclose(seq, local, atol=1e-4, rtol=1e-4)
+
+    def test_rowwise_matches_local(self, mesh1d):
+        import jax.numpy as jnp
+        from libskylark_tpu import sketch as sk
+        from libskylark_tpu.base.context import Context
+        from libskylark_tpu.parallel import shard_apply
+
+        N, S, m = 2048, 64, 16
+        rng = np.random.default_rng(6)
+        A = jnp.asarray(rng.standard_normal((m, N)).astype(np.float32))
+        T = sk.CT(N, S, Context(seed=18), C=1.0)
+        local = np.asarray(T.apply(A, sk.ROWWISE))
+        seq = np.asarray(shard_apply.rowwise(T, A, mesh1d))
+        np.testing.assert_allclose(seq, local, atol=1e-3, rtol=1e-3)
+
+    def test_rejects_bad_shapes(self, mesh1d):
+        from libskylark_tpu import sketch as sk
+        from libskylark_tpu.base import errors
+        from libskylark_tpu.base.context import Context
+        from libskylark_tpu.parallel import shard_apply
+
+        T = sk.JLT(1000, 16, Context(seed=1))  # 1000 not divisible
+        with pytest.raises(errors.InvalidParametersError):
+            shard_apply.columnwise(T, np.zeros((1000, 4), np.float32),
+                                   mesh1d)
+        cwt = sk.CWT(2048, 16, Context(seed=1))
+        with pytest.raises(errors.UnsupportedError):
+            shard_apply.columnwise(cwt, np.zeros((2048, 4), np.float32),
+                                   mesh1d)
+
+    def test_rejects_wrong_sequence_length(self, mesh1d):
+        from libskylark_tpu import sketch as sk
+        from libskylark_tpu.base import errors
+        from libskylark_tpu.base.context import Context
+        from libskylark_tpu.parallel import shard_apply
+
+        T = sk.JLT(4096, 16, Context(seed=2))
+        with pytest.raises(errors.SketchError):
+            shard_apply.columnwise(T, np.zeros((2048, 4), np.float32),
+                                   mesh1d)
+        with pytest.raises(errors.SketchError):
+            shard_apply.rowwise(T, np.zeros((4, 2048), np.float32), mesh1d)
