@@ -1,0 +1,161 @@
+"""All five BASELINE.md measurement configs, one JSON line each.
+
+``bench.py`` at the repo root is the driver-facing headline (config 1 at
+full scale); this script measures every config so rounds can be compared
+across the whole surface:
+
+1. JLT dense sketch apply (GB/s, fused generation+matmul)
+2. CWT sparse hash sketch on sparse input (M nnz/s)
+3. FJLT + FastGaussianRFT feature maps (M rows/s)
+4. Sketched least squares + randomized SVD (wall-clock)
+5. KRR + Block-ADMM RLSC training (wall-clock)
+
+Usage: python benchmarks/run_all.py [--scale small|full]
+(small is CPU-friendly; full sizes target one TPU chip).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# honor JAX_PLATFORMS=cpu even where a sitecustomize pre-imports jax with a
+# pinned platform (post-import config update, same as tests/conftest.py)
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _time_scalar(fn, *args, reps: int = 3) -> float:
+    """Best wall time of fn(*args) forced through a scalar readback."""
+    out = fn(*args)
+    float(out)  # warm + compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_jlt(scale: str):
+    import bench
+
+    if scale == "full":
+        gbps, secs = bench.run()
+    else:
+        gbps, secs = bench.run(m=1024, n=1024, s=128, repeats=2)
+    return {"metric": "jlt_sketch_apply_GBps", "value": round(gbps, 3),
+            "unit": "GB/s"}
+
+
+def bench_cwt_sparse(scale: str):
+    import scipy.sparse as sp
+
+    from libskylark_tpu.base.context import Context
+    from libskylark_tpu.base.sparse import SparseMatrix
+    from libskylark_tpu.sketch import CWT, COLUMNWISE
+
+    n, m, dens, s = ((1 << 20, 256, 1e-3, 4096) if scale == "full"
+                     else (1 << 14, 64, 1e-2, 256))
+    A = SparseMatrix.from_scipy(
+        sp.random(n, m, density=dens, random_state=0, dtype=np.float64))
+    T = CWT(n, s, Context(seed=1))
+    f = jax.jit(lambda r, c, v: jnp.sum(jnp.abs(
+        jnp.zeros((s, m), v.dtype).at[T.bucket_indices()[r], c].add(
+            T.values(v.dtype)[r] * v))))
+    r, c, v = A.coo()
+    best = _time_scalar(f, r, c, v)
+    return {"metric": "cwt_sparse_apply_Mnnz_per_s",
+            "value": round(A.nnz / best / 1e6, 3), "unit": "Mnnz/s"}
+
+
+def bench_feature_maps(scale: str):
+    from libskylark_tpu.base.context import Context
+    from libskylark_tpu.ml.kernels import Gaussian
+    from libskylark_tpu.sketch import ROWWISE
+
+    n, d, s = (65536, 256, 4096) if scale == "full" else (4096, 64, 512)
+    X = jnp.asarray(np.random.default_rng(0).standard_normal((n, d)),
+                    jnp.float32)
+    out = {}
+    for tag in ("regular", "fast"):
+        T = Gaussian(d, sigma=2.0).create_rft(s, Context(seed=2), tag)
+        f = jax.jit(lambda X: jnp.sum(jnp.abs(T.apply(X, ROWWISE))))
+        best = _time_scalar(f, X)
+        out[tag] = round(n / best / 1e6, 3)
+    return {"metric": "rft_feature_map_Mrows_per_s", "value": out["regular"],
+            "unit": "Mrows/s", "fast": out["fast"]}
+
+
+def bench_nla(scale: str):
+    from libskylark_tpu.base.context import Context
+    from libskylark_tpu.nla.least_squares import fast_least_squares
+    from libskylark_tpu.nla.svd import approximate_svd
+
+    m, n, k = (262144, 512, 10) if scale == "full" else (8192, 128, 6)
+    rng = np.random.default_rng(3)
+    A = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+    b = A @ jnp.asarray(rng.standard_normal(n), jnp.float32)
+
+    t0 = time.perf_counter()
+    x = fast_least_squares(A, b, Context(seed=4))
+    x = x[0] if isinstance(x, tuple) else x
+    float(jnp.sum(jnp.abs(x)))
+    t_ls = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    U, S, V = approximate_svd(A, k, Context(seed=5))
+    float(jnp.sum(S))
+    t_svd = time.perf_counter() - t0
+    return {"metric": "nla_wallclock_s",
+            "value": round(t_ls + t_svd, 3), "unit": "s",
+            "least_squares_s": round(t_ls, 3), "svd_s": round(t_svd, 3)}
+
+
+def bench_admm(scale: str):
+    from libskylark_tpu.algorithms.prox import HingeLoss, L2Regularizer
+    from libskylark_tpu.base.context import Context
+    from libskylark_tpu.ml.admm import BlockADMMSolver
+    from libskylark_tpu.ml.kernels import Gaussian
+
+    n, d, s, iters = ((16384, 128, 2048, 10) if scale == "full"
+                      else (1024, 32, 256, 5))
+    rng = np.random.default_rng(6)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    solver = BlockADMMSolver.from_kernel(
+        Context(seed=7), HingeLoss(), L2Regularizer(), 0.01, s,
+        Gaussian(d, sigma=3.0), num_partitions=4)
+    solver.maxiter = iters
+    solver.tol = 0.0
+    t0 = time.perf_counter()
+    solver.train(X, y)
+    wall = time.perf_counter() - t0
+    return {"metric": "admm_train_wallclock_s", "value": round(wall, 3),
+            "unit": "s", "iters": iters}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", choices=["small", "full"], default="full")
+    args = ap.parse_args()
+    for fn in (bench_jlt, bench_cwt_sparse, bench_feature_maps, bench_nla,
+               bench_admm):
+        rec = fn(args.scale)
+        rec["backend"] = jax.default_backend()
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

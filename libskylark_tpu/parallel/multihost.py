@@ -32,7 +32,8 @@ def initialize_distributed(
         jax.distributed.initialize(
             coordinator_address, num_processes, process_id)
     except RuntimeError as e:  # already initialized — MPI_Init semantics
-        if "already" not in str(e).lower():
+        msg = str(e).lower()
+        if "already" not in msg and "only be called once" not in msg:
             raise
 
 

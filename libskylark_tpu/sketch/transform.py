@@ -163,11 +163,18 @@ class SketchTransform:
         """Transform-specific hyper-params to serialize."""
         return {}
 
+    # Stream-format generation: bumped whenever the bit-level definition of
+    # the virtual random streams changes (chunk size, dense-block threefry
+    # pair layout — see base/randgen.py). Deserialization rejects a
+    # mismatch rather than silently producing a different operator.
+    STREAM_FORMAT = 2
+
     def to_dict(self) -> dict[str, Any]:
         d = {
             "skylark_object_type": "sketch",
             "sketch_type": self.sketch_type,
             "skylark_version": __version__,
+            "stream_format": self.STREAM_FORMAT,
             "N": self._N,
             "S": self._S,
             "creation_context": self._alloc.to_dict(),
@@ -196,5 +203,15 @@ def deserialize_sketch(obj: Union[str, dict[str, Any]]) -> SketchTransform:
     cls = _REGISTRY.get(stype)
     if cls is None:
         raise errors.SketchError(f"unknown sketch type {stype!r}")
+    # A missing field means a pre-versioning serialization — those were
+    # written under the original (format-1) stream layout, so they must be
+    # rejected too, not defaulted to the current format.
+    fmt = int(d.get("stream_format", 1))
+    if fmt != SketchTransform.STREAM_FORMAT:
+        raise errors.SketchError(
+            f"sketch was serialized with stream format {fmt}; this build "
+            f"implements format {SketchTransform.STREAM_FORMAT} — the "
+            "operator would not reproduce"
+        )
     alloc = Allocation.from_dict(d["creation_context"])
     return cls._from_parts(int(d["N"]), int(d["S"]), alloc, d)

@@ -253,3 +253,25 @@ class TestSerialization:
         a1 = np.asarray(T1.apply(A, sk.COLUMNWISE))
         a2 = np.asarray(T2.apply(A, sk.COLUMNWISE))
         assert not np.allclose(a1, a2)
+
+
+class TestStreamFormatGate:
+    def test_missing_format_field_rejected(self):
+        """Pre-versioning serializations carry the legacy stream layout and
+        must be rejected (review regression)."""
+        import json as _json
+
+        T = sk.JLT(64, 8, Context(seed=1))
+        d = _json.loads(T.to_json())
+        del d["stream_format"]
+        with pytest.raises(Exception, match="stream format"):
+            sk.deserialize_sketch(d)
+
+    def test_stale_format_rejected(self):
+        import json as _json
+
+        T = sk.JLT(64, 8, Context(seed=1))
+        d = _json.loads(T.to_json())
+        d["stream_format"] = 1
+        with pytest.raises(Exception, match="stream format"):
+            sk.deserialize_sketch(d)
