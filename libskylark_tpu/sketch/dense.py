@@ -67,6 +67,9 @@ class DenseTransform(SketchTransform):
     # -- apply --
 
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        out = self._try_pallas(A, "columnwise_apply")
+        if out is not None:
+            return out
         blocksize = sketch_params.get_blocksize()
         if blocksize and self._N > blocksize:
             return self._apply_columnwise_blocked(A, blocksize)
@@ -74,7 +77,7 @@ class DenseTransform(SketchTransform):
         return S @ A
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        out = self._try_pallas_rowwise(A)
+        out = self._try_pallas(A, "rowwise_apply")
         if out is not None:
             return out
         blocksize = sketch_params.get_blocksize()
@@ -83,7 +86,7 @@ class DenseTransform(SketchTransform):
         S = self.s_panel(0, self._N, A.dtype)
         return A @ S.T
 
-    def _try_pallas_rowwise(self, A):
+    def _try_pallas(self, A, which: str):
         """Fused generation+matmul TPU kernel (sketch/pallas_dense.py);
         None when the backend/input don't qualify. Sharded applies keep the
         XLA path (its partitioning XLA handles); on a tracer the sharding
@@ -106,7 +109,7 @@ class DenseTransform(SketchTransform):
             return None
         from libskylark_tpu.sketch import pallas_dense
 
-        return pallas_dense.rowwise_apply(
+        return getattr(pallas_dense, which)(
             self._alloc.key, self.dist, A, self._S, self.scale
         )
 

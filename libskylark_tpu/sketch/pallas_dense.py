@@ -46,11 +46,9 @@ def available() -> bool:
         return False
 
 
-def _kernel(dist_kind, s_dim, m_tile, keys_ref, a_ref, out_ref):
-    k = pl.program_id(1)
-
-    # -- generate S block (s_dim, BLOCK_COLS): bit-identical to
-    #    randgen.dense_block's threefry-pair layout --
+def _gen_block(dist_kind, s_dim, keys_ref, k):
+    """Generate operator column block k (s_dim, BLOCK_COLS) in VMEM —
+    bit-identical to randgen.dense_block's threefry-pair layout."""
     k0 = keys_ref[k, 0]
     k1 = keys_ref[k, 1]
     c = (
@@ -66,19 +64,10 @@ def _kernel(dist_kind, s_dim, m_tile, keys_ref, a_ref, out_ref):
         s0, s1 = tf.bits_to_rademacher(b0), tf.bits_to_rademacher(b1)
     else:
         raise NotImplementedError(dist_kind)
-    S_blk = jnp.concatenate([s0, s1], axis=1)  # (s_dim, BLOCK_COLS)
+    return jnp.concatenate([s0, s1], axis=1)  # (s_dim, BLOCK_COLS)
 
-    # -- accumulate A_tile @ S_blkᵀ into the output tile. bf16 inputs +
-    # f32 accumulation: the MXU-native regime, matching XLA's DEFAULT
-    # matmul precision on TPU (the S entries themselves stay bit-exact;
-    # only the contraction rounds at hardware precision) --
-    acc = jax.lax.dot_general(
-        a_ref[:].astype(jnp.bfloat16),
-        S_blk.astype(jnp.bfloat16),
-        (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
 
+def _accumulate(out_ref, acc, k):
     @pl.when(k == 0)
     def _init():
         out_ref[:] = acc
@@ -86,6 +75,35 @@ def _kernel(dist_kind, s_dim, m_tile, keys_ref, a_ref, out_ref):
     @pl.when(k != 0)
     def _acc():
         out_ref[:] += acc
+
+
+def _kernel(dist_kind, s_dim, m_tile, keys_ref, a_ref, out_ref):
+    """Rowwise: out_tile += A_tile @ S_blkᵀ. bf16 inputs + f32
+    accumulation: the MXU-native regime, matching XLA's DEFAULT matmul
+    precision on TPU (the S entries themselves stay bit-exact; only the
+    contraction rounds at hardware precision)."""
+    k = pl.program_id(1)
+    S_blk = _gen_block(dist_kind, s_dim, keys_ref, k)
+    acc = jax.lax.dot_general(
+        a_ref[:].astype(jnp.bfloat16),
+        S_blk.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    _accumulate(out_ref, acc, k)
+
+
+def _kernel_cw(dist_kind, s_dim, m_tile, keys_ref, a_ref, out_ref):
+    """Columnwise: out_tile += S_blk @ A_blk (same precision regime)."""
+    k = pl.program_id(1)
+    S_blk = _gen_block(dist_kind, s_dim, keys_ref, k)
+    acc = jax.lax.dot_general(
+        S_blk.astype(jnp.bfloat16),
+        a_ref[:].astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    _accumulate(out_ref, acc, k)
 
 
 @functools.partial(
@@ -117,6 +135,34 @@ def _fused_call(A, keys, *, s_dim, dist_kind, m_tile):
     )(keys, A)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("s_dim", "dist_kind", "m_tile")
+)
+def _fused_call_cw(A, keys, *, s_dim, dist_kind, m_tile):
+    n, m = A.shape
+    n_blocks = n // BLOCK_COLS
+    grid = (m // m_tile, n_blocks)
+    kern = functools.partial(_kernel_cw, dist_kind, s_dim, m_tile)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (BLOCK_COLS, m_tile), lambda j, k: (k, j),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (s_dim, m_tile), lambda j, k: (0, j), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((s_dim, m), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(keys, A)
+
+
 _DIST_KINDS = {
     randgen.Normal: "normal",
     randgen.Cauchy: "cauchy",
@@ -136,6 +182,30 @@ def supported(dist, dtype) -> bool:
     return jnp.dtype(dtype) == jnp.float32
 
 
+def _qualify(dist, A, seq_axis: int, m_tile: int):
+    """Common qualification: backend, distribution, shape divisibility.
+    Returns (m_tile, block keys) or None."""
+    if not (_HAVE_PALLAS and available() and supported(dist, A.dtype)):
+        return None
+    n = A.shape[seq_axis]
+    m = A.shape[1 - seq_axis]
+    if n % BLOCK_COLS or m < 8:
+        return None
+    m_tile = min(m_tile, m)
+    while m % m_tile:
+        m_tile //= 2
+    if m_tile < 8:
+        return None
+    return m_tile
+
+
+def _block_keys(key, n: int) -> jnp.ndarray:
+    n_blocks = n // BLOCK_COLS
+    return jax.vmap(lambda b: jr_key_data(randgen.chunk_key(key, b)))(
+        jnp.arange(n_blocks, dtype=jnp.int32)
+    ).astype(jnp.uint32)
+
+
 def rowwise_apply(
     key: jax.Array,
     dist,
@@ -147,23 +217,29 @@ def rowwise_apply(
     """out = scale · A @ Sᵀ with S the virtual (s_dim × N) matrix of
     :func:`randgen.dense_block`. Returns None when not applicable (caller
     falls back to the XLA path)."""
-    if not (_HAVE_PALLAS and available() and supported(dist, A.dtype)):
+    mt = _qualify(dist, A, seq_axis=1, m_tile=m_tile)
+    if mt is None:
         return None
-    m, n = A.shape
-    if n % BLOCK_COLS or m < 8:
-        return None
-    m_tile = min(m_tile, m)
-    while m % m_tile:
-        m_tile //= 2
-    if m_tile < 8:
-        return None
+    out = _fused_call(A, _block_keys(key, A.shape[1]), s_dim=s_dim,
+                      dist_kind=_DIST_KINDS[type(dist)], m_tile=mt)
+    return scale * out
 
-    n_blocks = n // BLOCK_COLS
-    bkeys = jax.vmap(lambda b: jr_key_data(randgen.chunk_key(key, b)))(
-        jnp.arange(n_blocks, dtype=jnp.int32)
-    ).astype(jnp.uint32)
-    out = _fused_call(A, bkeys, s_dim=s_dim, dist_kind=_DIST_KINDS[type(dist)],
-                      m_tile=m_tile)
+
+def columnwise_apply(
+    key: jax.Array,
+    dist,
+    A: jnp.ndarray,
+    s_dim: int,
+    scale: float,
+    m_tile: int = 256,
+) -> Optional[jnp.ndarray]:
+    """out = scale · S @ A for A (N, m); same fused generation, transposed
+    contraction."""
+    mt = _qualify(dist, A, seq_axis=0, m_tile=m_tile)
+    if mt is None:
+        return None
+    out = _fused_call_cw(A, _block_keys(key, A.shape[0]), s_dim=s_dim,
+                         dist_kind=_DIST_KINDS[type(dist)], m_tile=mt)
     return scale * out
 
 
