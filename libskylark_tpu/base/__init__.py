@@ -6,9 +6,11 @@ TPU-native analog of the reference's ``base/`` layer (SURVEY.md §2.1).
 from libskylark_tpu.base.context import Allocation, Context
 from libskylark_tpu.base.params import Params
 from libskylark_tpu.base.sparse import SparseMatrix, gemm, spmm, spmm_t
+from libskylark_tpu.base.dist_sparse import DistSparseMatrix, distribute_sparse
 from libskylark_tpu.base import errors, randgen, quasirand, sprand
 
 __all__ = [
     "Allocation", "Context", "Params", "SparseMatrix",
+    "DistSparseMatrix", "distribute_sparse",
     "gemm", "spmm", "spmm_t", "errors", "randgen", "quasirand", "sprand",
 ]

@@ -51,6 +51,12 @@ def sample(
         u = u[np.argsort(first)]
         u = u[~np.isin(u, chosen, assume_unique=True)]
         chosen = np.concatenate([chosen, u])
+    if len(chosen) < nnz:
+        raise errors.SkylarkError(
+            f"drew {lo} candidates but found only {len(chosen)} distinct "
+            f"positions (< nnz={nnz}); density {density} too high for "
+            f"rejection sampling"
+        )
     flat = chosen[:nnz]
     rows, cols = flat // n, flat % n
     u = np.asarray(randgen.stream_slice(

@@ -132,7 +132,17 @@ class HilbertModel:
     @staticmethod
     def from_dict(d: dict[str, Any]) -> "HilbertModel":
         fm = d["feature_mapping"]
-        maps = [deserialize_sketch(m) for m in fm["maps"]]
+        try:
+            maps = [deserialize_sketch(m) for m in fm["maps"]]
+        except errors.SketchError as e:
+            # Model files embed sketch serializations; a stream-format
+            # mismatch means the model predates the current stream format
+            # and must be retrained / re-serialized (see README "Stream
+            # format versioning").
+            raise errors.SketchError(
+                "model file embeds a feature map from an incompatible "
+                f"stream format — retrain or re-serialize the model ({e})"
+            ) from e
         return HilbertModel(
             maps,
             bool(fm["scale_maps"]),

@@ -156,7 +156,7 @@ class DenseTransform(SketchTransform):
             sp = V.to_scipy().tocoo()
             r = np.zeros(pad, np.int32)
             c = np.zeros(pad, np.int32)
-            vals = np.zeros(pad, np.float32)
+            vals = np.zeros(pad, np.dtype(dt))
             r[: V.nnz] = sp.row
             c[: V.nnz] = sp.col
             vals[: V.nnz] = sp.data  # padding rows add v=0 at (0, 0)
@@ -166,6 +166,19 @@ class DenseTransform(SketchTransform):
                 G, jnp.asarray(r), num_segments=A.height
             )
         return acc
+
+    # -- distributed sparse input (P4/P5): per-cell virtual panels + psum
+    # (ref: sketch/dense_transform_Mixed.hpp:19) --
+
+    def _apply_columnwise_dist_sparse(self, A) -> jnp.ndarray:
+        from libskylark_tpu.sketch import dist_sparse_apply as dsa
+
+        return dsa.dense_columnwise(self, A)
+
+    def _apply_rowwise_dist_sparse(self, A) -> jnp.ndarray:
+        from libskylark_tpu.sketch import dist_sparse_apply as dsa
+
+        return dsa.dense_rowwise(self, A)
 
     # -- blocked (memory-bounded) apply: scan over column panels of S --
 

@@ -72,6 +72,19 @@ class HashTransform(SketchTransform):
         out = jnp.zeros((A.height, self._S), v.dtype)
         return out.at[r, h[c]].add(vs[c] * v)
 
+    # -- distributed sparse input (P4/P5): local scatter + psum (ref:
+    # sketch/hash_transform_CombBLAS.hpp:16-632) --
+
+    def _apply_columnwise_dist_sparse(self, A) -> jnp.ndarray:
+        from libskylark_tpu.sketch import dist_sparse_apply as dsa
+
+        return dsa.hash_columnwise(self, A)
+
+    def _apply_rowwise_dist_sparse(self, A) -> jnp.ndarray:
+        from libskylark_tpu.sketch import dist_sparse_apply as dsa
+
+        return dsa.hash_rowwise(self, A)
+
     def apply_sparse(self, A, dimension=None):
         """Sparse→sparse apply: returns a :class:`SparseMatrix` with
         duplicate-summed CSC structure (ref:

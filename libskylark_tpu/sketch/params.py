@@ -32,10 +32,9 @@ def set_factor(f: int) -> None:
 
 
 # ``use_pallas`` — route dense-transform applies through the fused Pallas
-# TPU kernel (sketch/pallas_dense.py) when the input/backend qualify. On
-# TPU the contraction then runs at MXU-native precision (bf16 inputs, f32
-# accumulate — identical to XLA's DEFAULT matmul precision); the sketch
-# operator entries are bit-exact either way.
+# TPU kernel (sketch/pallas_dense.py) when the input/backend qualify. The
+# sketch operator entries are bit-exact either way; only the contraction
+# precision differs (see ``pallas_precision``).
 _use_pallas = True
 
 
@@ -46,3 +45,23 @@ def get_use_pallas() -> bool:
 def set_use_pallas(on: bool) -> None:
     global _use_pallas
     _use_pallas = bool(on)
+
+
+# ``pallas_precision`` — contraction regime inside the fused kernel.
+# "f32" (default): full-f32 MXU passes (Precision.HIGHEST); the fused
+# apply stays within the framework's 1e-4 determinism oracle vs the XLA
+# path. "bf16": single-pass bf16 inputs + f32 accumulation — fastest, but
+# rounds the contraction at ~2⁻⁸ relative (outside the oracle for large
+# N); throughput-only work opts in explicitly.
+_pallas_precision = "f32"
+
+
+def get_pallas_precision() -> str:
+    return _pallas_precision
+
+
+def set_pallas_precision(p: str) -> None:
+    if p not in ("f32", "bf16"):
+        raise ValueError(f"pallas_precision must be 'f32' or 'bf16', got {p!r}")
+    global _pallas_precision
+    _pallas_precision = p

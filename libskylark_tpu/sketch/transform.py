@@ -107,8 +107,14 @@ class SketchTransform:
         per-(input,output)-type specializations, e.g.
         sketch/hash_transform_local_sparse.hpp) and produces a dense result.
         """
+        from libskylark_tpu.base.dist_sparse import DistSparseMatrix
         from libskylark_tpu.base.sparse import SparseMatrix
 
+        if isinstance(A, DistSparseMatrix):
+            # dimension validation lives in dist_sparse_apply._check_dim
+            if dimension == Dimension.COLUMNWISE:
+                return self._apply_columnwise_dist_sparse(A)
+            return self._apply_rowwise_dist_sparse(A)
         if isinstance(A, SparseMatrix):
             if dimension == Dimension.COLUMNWISE:
                 if A.height != self._N:
@@ -155,6 +161,18 @@ class SketchTransform:
     def _apply_rowwise_sparse(self, A) -> jnp.ndarray:
         raise errors.NotImplementedYetError(
             f"{self.sketch_type}: rowwise sparse apply not implemented"
+        )
+
+    def _apply_columnwise_dist_sparse(self, A) -> jnp.ndarray:
+        raise errors.NotImplementedYetError(
+            f"{self.sketch_type}: columnwise distributed-sparse apply "
+            "not implemented"
+        )
+
+    def _apply_rowwise_dist_sparse(self, A) -> jnp.ndarray:
+        raise errors.NotImplementedYetError(
+            f"{self.sketch_type}: rowwise distributed-sparse apply "
+            "not implemented"
         )
 
     # -- serialization (ref: sketch_transform_data.hpp:64-71 add_common) --

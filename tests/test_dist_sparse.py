@@ -1,0 +1,191 @@
+"""Oracle tests for the mesh-distributed sparse layer (P4/P5).
+
+The reference runs its distributed-sparse tests at np∈{1,4,5,7} to force
+ragged (non-dividing) layouts (ref: tests/unit/CMakeLists.txt:31-33,
+DistSparseTest.cpp, SparseSketchApplyCombBLASTest.cpp). Here the analog:
+the same matrix distributed on a 1D 8-device mesh, a 2D (2,4) grid, and a
+ragged 5-device submesh must produce products and sketch applies that
+match the local computation elementwise (≤1e-4, the determinism oracle —
+ref: tests/unit/test_utils.hpp:48)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from libskylark_tpu import parallel as par
+from libskylark_tpu.base.context import Context
+from libskylark_tpu.base.dist_sparse import distribute_sparse
+from libskylark_tpu.base.sparse import SparseMatrix, spmm, spmm_t
+from libskylark_tpu.sketch import CWT, MMT, WZT, JLT, CT, ROWWISE, COLUMNWISE
+
+ATOL = 1e-4
+
+
+def _rand_sparse(h, w, density=0.08, seed=0) -> SparseMatrix:
+    rng = np.random.default_rng(seed)
+    A = sp.random(h, w, density=density, random_state=rng, format="csc",
+                  dtype=np.float32)
+    return SparseMatrix.from_scipy(A)
+
+
+@pytest.fixture()
+def mesh5(devices):
+    """Ragged 5-device submesh — the np=5 discipline."""
+    return par.make_mesh(devices=devices[:5])
+
+
+def _grids(mesh1d, mesh2d, mesh5):
+    return [
+        (mesh1d, dict(row_axis="rows")),
+        (mesh1d, dict(col_axis="rows")),
+        (mesh2d, dict(row_axis="rows", col_axis="cols")),
+        (mesh5, dict(row_axis="rows")),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# container + products
+# ---------------------------------------------------------------------------
+
+
+def test_roundtrip_to_local(mesh1d, mesh2d, devices):
+    A = _rand_sparse(53, 37, seed=1)
+    for mesh, axes in [(mesh1d, dict(row_axis="rows")),
+                       (mesh2d, dict(row_axis="rows", col_axis="cols"))]:
+        D = distribute_sparse(A, mesh, **axes)
+        B = D.to_local()
+        np.testing.assert_allclose(
+            B.to_scipy().toarray(), A.to_scipy().toarray(), atol=0
+        )
+
+
+def test_todense_matches(mesh2d):
+    A = _rand_sparse(45, 30, seed=2)
+    D = distribute_sparse(A, mesh2d, row_axis="rows", col_axis="cols")
+    np.testing.assert_allclose(
+        np.asarray(D.todense()), A.to_scipy().toarray(), atol=0
+    )
+
+
+@pytest.mark.parametrize("hw", [(64, 48), (53, 41)])
+def test_spmm_oracle(hw, mesh1d, mesh2d, devices):
+    h, w = hw
+    A = _rand_sparse(h, w, seed=3)
+    B = jnp.asarray(
+        np.random.default_rng(4).standard_normal((w, 7)), jnp.float32
+    )
+    want = np.asarray(spmm(A, B))
+    mesh5 = par.make_mesh(devices=devices[:5])
+    for mesh, axes in _grids(mesh1d, mesh2d, mesh5):
+        D = distribute_sparse(A, mesh, **axes)
+        got = np.asarray(D.spmm(B))
+        np.testing.assert_allclose(got, want, atol=ATOL, err_msg=str(axes))
+
+
+@pytest.mark.parametrize("hw", [(64, 48), (53, 41)])
+def test_spmm_t_oracle(hw, mesh1d, mesh2d, devices):
+    h, w = hw
+    A = _rand_sparse(h, w, seed=5)
+    B = jnp.asarray(
+        np.random.default_rng(6).standard_normal((h, 5)), jnp.float32
+    )
+    want = np.asarray(spmm_t(A, B))
+    mesh5 = par.make_mesh(devices=devices[:5])
+    for mesh, axes in _grids(mesh1d, mesh2d, mesh5):
+        D = distribute_sparse(A, mesh, **axes)
+        got = np.asarray(D.spmm_t(B))
+        np.testing.assert_allclose(got, want, atol=ATOL, err_msg=str(axes))
+
+
+def test_spmm_vector(mesh2d):
+    A = _rand_sparse(40, 33, seed=7)
+    x = jnp.asarray(
+        np.random.default_rng(8).standard_normal(33), jnp.float32
+    )
+    D = distribute_sparse(A, mesh2d, row_axis="rows", col_axis="cols")
+    np.testing.assert_allclose(
+        np.asarray(D.spmm(x)), np.asarray(spmm(A, x)), atol=ATOL
+    )
+
+
+# ---------------------------------------------------------------------------
+# sketch applies: sharded-sparse vs local oracle (BASELINE config 2 shape)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("Tcls", [CWT, MMT, WZT], ids=lambda c: c.__name__)
+def test_hash_columnwise_dist_oracle(Tcls, mesh1d, mesh2d, devices):
+    n, w, s = 100, 37, 24
+    A = _rand_sparse(n, w, seed=9)
+    mesh5 = par.make_mesh(devices=devices[:5])
+    for mesh, axes in _grids(mesh1d, mesh2d, mesh5):
+        T = Tcls(n, s, Context(seed=17))
+        want = np.asarray(T.apply(A, COLUMNWISE))
+        D = distribute_sparse(A, mesh, **axes)
+        got = np.asarray(T.apply(D, COLUMNWISE))
+        assert got.shape == want.shape
+        tol = ATOL * max(1.0, np.abs(want).max())
+        np.testing.assert_allclose(got, want, atol=tol, err_msg=str(axes))
+
+
+@pytest.mark.parametrize("Tcls", [CWT, MMT], ids=lambda c: c.__name__)
+def test_hash_rowwise_dist_oracle(Tcls, mesh1d, mesh2d, devices):
+    m, n, s = 37, 100, 24
+    A = _rand_sparse(m, n, seed=10)
+    mesh5 = par.make_mesh(devices=devices[:5])
+    for mesh, axes in _grids(mesh1d, mesh2d, mesh5):
+        T = Tcls(n, s, Context(seed=18))
+        want = np.asarray(T.apply(A, ROWWISE))
+        D = distribute_sparse(A, mesh, **axes)
+        got = np.asarray(T.apply(D, ROWWISE))
+        assert got.shape == want.shape
+        tol = ATOL * max(1.0, np.abs(want).max())
+        np.testing.assert_allclose(got, want, atol=tol, err_msg=str(axes))
+
+
+@pytest.mark.parametrize("Tcls", [JLT, CT], ids=lambda c: c.__name__)
+def test_dense_rowwise_dist_oracle(Tcls, mesh1d, mesh2d, devices):
+    m, n, s = 29, 300, 16
+    A = _rand_sparse(m, n, seed=11)
+    mesh5 = par.make_mesh(devices=devices[:5])
+    for mesh, axes in _grids(mesh1d, mesh2d, mesh5):
+        T = Tcls(n, s, Context(seed=19))
+        want = np.asarray(T.apply(A, ROWWISE))
+        D = distribute_sparse(A, mesh, **axes)
+        got = np.asarray(T.apply(D, ROWWISE))
+        assert got.shape == want.shape
+        tol = ATOL * max(1.0, np.abs(want).max())
+        np.testing.assert_allclose(got, want, atol=tol, err_msg=str(axes))
+
+
+@pytest.mark.parametrize("Tcls", [JLT], ids=lambda c: c.__name__)
+def test_dense_columnwise_dist_oracle(Tcls, mesh1d, mesh2d, devices):
+    n, w, s = 300, 29, 16
+    A = _rand_sparse(n, w, seed=12)
+    mesh5 = par.make_mesh(devices=devices[:5])
+    for mesh, axes in _grids(mesh1d, mesh2d, mesh5):
+        T = Tcls(n, s, Context(seed=20))
+        want = np.asarray(T.apply(A, COLUMNWISE))
+        D = distribute_sparse(A, mesh, **axes)
+        got = np.asarray(T.apply(D, COLUMNWISE))
+        assert got.shape == want.shape
+        tol = ATOL * max(1.0, np.abs(want).max())
+        np.testing.assert_allclose(got, want, atol=tol, err_msg=str(axes))
+
+
+def test_empty_cells_ok(mesh2d):
+    """A matrix whose nonzeros all land in one grid cell — the other cells
+    are pure padding."""
+    rows = np.array([0, 1, 2])
+    cols = np.array([0, 1, 2])
+    vals = np.array([1.0, 2.0, 3.0], np.float32)
+    A = SparseMatrix.from_coo(rows, cols, vals, (40, 40))
+    D = distribute_sparse(A, mesh2d, row_axis="rows", col_axis="cols")
+    B = jnp.asarray(
+        np.random.default_rng(13).standard_normal((40, 3)), jnp.float32
+    )
+    np.testing.assert_allclose(
+        np.asarray(D.spmm(B)), np.asarray(spmm(A, B)), atol=ATOL
+    )

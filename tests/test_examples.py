@@ -2,11 +2,14 @@
 executable documentation wired into the build — ref: examples/CMakeLists.txt)."""
 
 import importlib
+import pathlib
 import sys
 
 import pytest
 
-sys.path.insert(0, "examples")
+sys.path.insert(
+    0, str(pathlib.Path(__file__).resolve().parent.parent / "examples")
+)
 
 
 @pytest.mark.parametrize("name", [

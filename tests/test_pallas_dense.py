@@ -1,0 +1,239 @@
+"""Numerical verification of the fused Pallas generation+matmul kernel.
+
+The kernel (sketch/pallas_dense.py) is the flagship perf component; these
+tests pin its numerics WITHOUT TPU hardware via ``interpret=True`` (the
+Pallas interpreter executes the same program on CPU):
+
+1. the in-kernel operator generation (``_gen_block``) is bit-identical to
+   the XLA-path stream definition (:func:`randgen.dense_block`) — the
+   invariant the whole determinism oracle rests on,
+2. the fused rowwise/columnwise applies match the XLA path within the
+   framework's 1e-4 oracle (ref: tests/unit/test_utils.hpp:48) at the
+   default "f32" precision regime,
+3. the "bf16" regime's contraction gap is quantified: it is bounded by
+   the bf16 rounding model but exceeds the 1e-4 oracle — which is WHY
+   "f32" is the default (sketch/params.py),
+4. ragged (non-BLOCK_COLS-multiple N, odd m) inputs zero-pad exactly.
+
+An on-chip variant runs when the default backend is a real TPU
+(@pytest.mark.tpu — skipped on the CPU CI mesh).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libskylark_tpu.base import randgen
+from libskylark_tpu.base.context import Context
+from libskylark_tpu.sketch import JLT, CT, ROWWISE, COLUMNWISE
+from libskylark_tpu.sketch import params as sketch_params
+from libskylark_tpu.sketch import pallas_dense as pd
+from libskylark_tpu.sketch.dense import BLOCK_COLS
+
+pl = pytest.importorskip("jax.experimental.pallas")
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+
+ON_TPU = pd.available()
+
+
+@pytest.fixture(autouse=True)
+def _xla_path_for_oracle():
+    """Oracle side must take the XLA path regardless of backend."""
+    sketch_params.set_use_pallas(False)
+    yield
+    sketch_params.set_use_pallas(True)
+
+
+def _gen_via_kernel(dist, s_dim, n_blocks, key, interpret=True):
+    """Materialize S via the in-kernel generator, one block per grid step."""
+    kind = pd._DIST_KINDS[type(dist)]
+    kern = functools.partial(
+        lambda dk, sd, keys_ref, out_ref: out_ref.__setitem__(
+            slice(None), pd._gen_block(dk, sd, keys_ref, pl.program_id(0))
+        ),
+        kind,
+        s_dim,
+    )
+    return pl.pallas_call(
+        kern,
+        grid=(n_blocks,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec((s_dim, BLOCK_COLS), lambda k: (0, k)),
+        out_shape=jax.ShapeDtypeStruct(
+            (s_dim, n_blocks * BLOCK_COLS), jnp.float32
+        ),
+        interpret=interpret,
+    )(pd._block_keys(key, n_blocks * BLOCK_COLS))
+
+
+@pytest.mark.parametrize(
+    "dist",
+    [randgen.Normal(), randgen.Cauchy(), randgen.Rademacher()],
+    ids=["normal", "cauchy", "rademacher"],
+)
+def test_gen_block_bit_identical(dist):
+    """In-kernel Threefry replay == randgen.dense_block, bit for bit."""
+    s_dim, n_blocks = 16, 3
+    key = Context(seed=11).allocate().key
+    got = np.asarray(_gen_via_kernel(dist, s_dim, n_blocks, key))
+    want = np.concatenate(
+        [
+            np.asarray(
+                randgen.dense_block(key, dist, s_dim, b, BLOCK_COLS)
+            )
+            for b in range(n_blocks)
+        ],
+        axis=1,
+    )
+    assert np.array_equal(got, want), (
+        f"max abs diff {np.abs(got - want).max()}"
+    )
+
+
+@pytest.mark.parametrize("shape", [(64, 512), (64, 768)])
+def test_fused_rowwise_matches_xla(shape):
+    """Fused A·Sᵀ (interpret, f32 regime) vs the XLA apply, ≤1e-4 oracle."""
+    m, n = shape
+    s = 96
+    ctx = Context(seed=5)
+    jlt = JLT(n, s, ctx)
+    A = jnp.asarray(
+        np.random.default_rng(0).standard_normal((m, n)), jnp.float32
+    )
+    want = np.asarray(jlt.apply(A, ROWWISE))
+    got = pd.rowwise_apply(
+        jlt._alloc.key, jlt.dist, A, s, jlt.scale,
+        precision="f32", interpret=True,
+    )
+    assert got is not None
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4, rtol=1e-4)
+
+
+def test_fused_columnwise_matches_xla():
+    n, m, s = 512, 48, 96
+    ctx = Context(seed=6)
+    jlt = JLT(n, s, ctx)
+    A = jnp.asarray(
+        np.random.default_rng(1).standard_normal((n, m)), jnp.float32
+    )
+    want = np.asarray(jlt.apply(A, COLUMNWISE))
+    got = pd.columnwise_apply(
+        jlt._alloc.key, jlt.dist, A, s, jlt.scale,
+        precision="f32", interpret=True,
+    )
+    assert got is not None
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4, rtol=1e-4)
+
+
+def test_fused_ct_cauchy_matches_xla():
+    """Cauchy entries are heavy-tailed; relative comparison."""
+    m, n, s = 32, 512, 64
+    ctx = Context(seed=7)
+    ct = CT(n, s, ctx)
+    A = jnp.asarray(
+        np.random.default_rng(2).standard_normal((m, n)), jnp.float32
+    )
+    want = np.asarray(ct.apply(A, ROWWISE))
+    got = pd.rowwise_apply(
+        ct._alloc.key, ct.dist, A, s, ct.scale,
+        precision="f32", interpret=True,
+    )
+    assert got is not None
+    np.testing.assert_allclose(
+        np.asarray(got), want, rtol=1e-4,
+        atol=1e-4 * float(np.abs(want).max()),
+    )
+
+
+@pytest.mark.parametrize("shape", [(7, 300), (13, 257), (50, 1000)])
+def test_fused_ragged_shapes_exact_padding(shape):
+    """Non-dividing m and N: zero-padding must be exact, not approximate
+    (the reference's np=5/7 ragged-layout discipline,
+    ref: tests/unit/CMakeLists.txt:31-33)."""
+    m, n = shape
+    s = 32
+    ctx = Context(seed=8)
+    jlt = JLT(n, s, ctx)
+    A = jnp.asarray(
+        np.random.default_rng(3).standard_normal((m, n)), jnp.float32
+    )
+    want = np.asarray(jlt.apply(A, ROWWISE))
+    got = pd.rowwise_apply(
+        jlt._alloc.key, jlt.dist, A, s, jlt.scale,
+        precision="f32", interpret=True,
+    )
+    assert got is not None
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4, rtol=1e-4)
+
+
+def test_bf16_regime_gap_quantified():
+    """The bf16 regime is accurate to the bf16 rounding model (~2⁻⁸
+    relative on the contraction) but NOT to the 1e-4 oracle — the measured
+    gap is the justification for the f32 default (sketch/params.py)."""
+    m, n, s = 32, 2048, 64
+    ctx = Context(seed=9)
+    jlt = JLT(n, s, ctx)
+    A = jnp.asarray(
+        np.random.default_rng(4).standard_normal((m, n)), jnp.float32
+    )
+    want = np.asarray(jlt.apply(A, ROWWISE))
+    got = np.asarray(
+        pd.rowwise_apply(
+            jlt._alloc.key, jlt.dist, A, s, jlt.scale,
+            precision="bf16", interpret=True,
+        )
+    )
+    scale = np.abs(want).max()
+    rel = np.abs(got - want).max() / scale
+    # bounded by the bf16 model…
+    assert rel < 2.0 ** -6, f"bf16 contraction error {rel} implausibly large"
+    # …but not oracle-grade (if this ever starts passing at 1e-4 the
+    # interpreter stopped emulating bf16 and the regime split is moot).
+    assert rel > 1e-6, "bf16 regime unexpectedly bit-matched the f32 path"
+
+
+def test_try_pallas_interpret_consistency_via_transform():
+    """End to end: T.apply (XLA) == pallas interpret apply on the same
+    transform object, both dimensions."""
+    n, s = 512, 64
+    ctx = Context(seed=10)
+    jlt = JLT(n, s, ctx)
+    rng = np.random.default_rng(5)
+    A_r = jnp.asarray(rng.standard_normal((24, n)), jnp.float32)
+    A_c = jnp.asarray(rng.standard_normal((n, 24)), jnp.float32)
+    got_r = pd.rowwise_apply(
+        jlt._alloc.key, jlt.dist, A_r, s, jlt.scale, interpret=True
+    )
+    got_c = pd.columnwise_apply(
+        jlt._alloc.key, jlt.dist, A_c, s, jlt.scale, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_r), np.asarray(jlt.apply(A_r, ROWWISE)),
+        atol=1e-4, rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_c), np.asarray(jlt.apply(A_c, COLUMNWISE)),
+        atol=1e-4, rtol=1e-4,
+    )
+
+
+@pytest.mark.tpu
+@pytest.mark.skipif(not ON_TPU, reason="needs a real TPU backend")
+def test_fused_on_chip_matches_xla():
+    """On-chip (Mosaic-compiled, not interpreted) vs the XLA path."""
+    m, n, s = 256, 1024, 128
+    ctx = Context(seed=12)
+    jlt = JLT(n, s, ctx)
+    A = jnp.asarray(
+        np.random.default_rng(6).standard_normal((m, n)), jnp.float32
+    )
+    want = np.asarray(jlt.apply(A, ROWWISE))
+    got = pd.rowwise_apply(
+        jlt._alloc.key, jlt.dist, A, s, jlt.scale, precision="f32"
+    )
+    assert got is not None
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4, rtol=1e-4)
