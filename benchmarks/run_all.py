@@ -1,4 +1,5 @@
-"""All five BASELINE.md measurement configs, one JSON line each.
+"""All BASELINE.md measurement configs, one JSON line each, with
+per-round persistence and a regression gate.
 
 ``bench.py`` at the repo root is the driver-facing headline (config 1 at
 full scale); this script measures every config so rounds can be compared
@@ -6,19 +7,27 @@ across the whole surface:
 
 1. JLT dense sketch apply (GB/s, fused generation+matmul)
 2. CWT sparse hash sketch on sparse input (M nnz/s)
+2b. CWT on a MESH-DISTRIBUTED sparse input (P4/P5 path, M nnz/s)
 3. FJLT + FastGaussianRFT feature maps (M rows/s)
 4. Sketched least squares + randomized SVD (wall-clock)
 5. KRR + Block-ADMM RLSC training (wall-clock)
 
 Usage: python benchmarks/run_all.py [--scale small|full]
-(small is CPU-friendly; full sizes target one TPU chip).
+                                    [--save N] [--gate]
+``--save N`` writes benchmarks/results_rN.json; with prior
+results_r*.json present, every metric is printed with its delta vs the
+best prior round, and ``--gate`` exits nonzero when any metric regresses
+by more than 10% (the perf ratchet for later rounds — the phase-timer
+discipline of ref: ml/BlockADMM.hpp:357-365 made enforceable).
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
+import re
 import sys
 import time
 
@@ -34,6 +43,19 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# metric -> direction: +1 = higher is better (throughput),
+#                      -1 = lower is better (wall-clock)
+DIRECTIONS = {
+    "jlt_sketch_apply_GBps": +1,
+    "cwt_sparse_apply_Mnnz_per_s": +1,
+    "cwt_dist_sparse_apply_Mnnz_per_s": +1,
+    "rft_feature_map_Mrows_per_s": +1,
+    "nla_wallclock_s": -1,
+    "admm_train_wallclock_s": -1,
+}
 
 
 def _time_scalar(fn, *args, reps: int = 3) -> float:
@@ -59,17 +81,23 @@ def bench_jlt(scale: str):
             "unit": "GB/s"}
 
 
-def bench_cwt_sparse(scale: str):
+def _sparse_input(scale: str):
     import scipy.sparse as sp
 
-    from libskylark_tpu.base.context import Context
     from libskylark_tpu.base.sparse import SparseMatrix
-    from libskylark_tpu.sketch import CWT, COLUMNWISE
 
     n, m, dens, s = ((1 << 20, 256, 1e-3, 4096) if scale == "full"
                      else (1 << 14, 64, 1e-2, 256))
     A = SparseMatrix.from_scipy(
         sp.random(n, m, density=dens, random_state=0, dtype=np.float64))
+    return A, n, m, s
+
+
+def bench_cwt_sparse(scale: str):
+    from libskylark_tpu.base.context import Context
+    from libskylark_tpu.sketch import CWT
+
+    A, n, m, s = _sparse_input(scale)
     T = CWT(n, s, Context(seed=1))
     f = jax.jit(lambda r, c, v: jnp.sum(jnp.abs(
         jnp.zeros((s, m), v.dtype).at[T.bucket_indices()[r], c].add(
@@ -78,6 +106,30 @@ def bench_cwt_sparse(scale: str):
     best = _time_scalar(f, r, c, v)
     return {"metric": "cwt_sparse_apply_Mnnz_per_s",
             "value": round(A.nnz / best / 1e6, 3), "unit": "Mnnz/s"}
+
+
+def bench_cwt_dist_sparse(scale: str):
+    """BASELINE config 2 on a MESH-DISTRIBUTED sparse input: the P4/P5
+    path (shard_map local scatter + psum; ref:
+    sketch/hash_transform_CombBLAS.hpp)."""
+    from libskylark_tpu import parallel as par
+    from libskylark_tpu.base.context import Context
+    from libskylark_tpu.base.dist_sparse import distribute_sparse
+    from libskylark_tpu.sketch import COLUMNWISE, CWT
+
+    A, n, m, s = _sparse_input(scale)
+    n_dev = len(jax.devices())
+    mesh = (par.square_mesh() if n_dev >= 4 else par.make_mesh())
+    axes = (dict(row_axis="rows", col_axis="cols")
+            if len(mesh.axis_names) > 1 and mesh.shape.get("cols", 1) > 1
+            else dict(row_axis=mesh.axis_names[0]))
+    D = distribute_sparse(A, mesh, **axes)
+    T = CWT(n, s, Context(seed=1))
+    f = jax.jit(lambda: jnp.sum(jnp.abs(T.apply(D, COLUMNWISE))))
+    best = _time_scalar(f)
+    return {"metric": "cwt_dist_sparse_apply_Mnnz_per_s",
+            "value": round(A.nnz / best / 1e6, 3), "unit": "Mnnz/s",
+            "devices": n_dev}
 
 
 def bench_feature_maps(scale: str):
@@ -146,15 +198,81 @@ def bench_admm(scale: str):
             "unit": "s", "iters": iters}
 
 
+def _prior_best(scale: str, backend: str) -> dict[str, float]:
+    """Best prior value per metric across results_r*.json (best respects
+    the metric's direction). Only rounds recorded at the SAME scale and
+    backend are comparable — a full-scale TPU round must not gate a
+    small-scale CPU run."""
+    best: dict[str, float] = {}
+    for p in glob.glob(os.path.join(HERE, "results_r*.json")):
+        try:
+            with open(p) as fh:
+                recs = json.load(fh)
+        except Exception:
+            continue
+        if recs.get("scale") != scale or recs.get("backend") != backend:
+            continue
+        for rec in recs.get("results", []):
+            m, v = rec.get("metric"), rec.get("value")
+            if m not in DIRECTIONS or not isinstance(v, (int, float)):
+                continue
+            d = DIRECTIONS[m]
+            if m not in best or (v - best[m]) * d > 0:
+                best[m] = v
+    return best
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", choices=["small", "full"], default="full")
+    ap.add_argument("--save", type=int, metavar="ROUND", default=None,
+                    help="persist results as results_rROUND.json")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 if any metric regresses >10%% vs the "
+                         "best prior round")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated metric substrings to run")
     args = ap.parse_args()
-    for fn in (bench_jlt, bench_cwt_sparse, bench_feature_maps, bench_nla,
-               bench_admm):
-        rec = fn(args.scale)
+
+    prior = _prior_best(args.scale, jax.default_backend())
+    results = []
+    regressed = []
+    benches = (bench_jlt, bench_cwt_sparse, bench_cwt_dist_sparse,
+               bench_feature_maps, bench_nla, bench_admm)
+    for fn in benches:
+        if args.only and not any(
+            s in fn.__name__ for s in args.only.split(",")
+        ):
+            continue
+        try:
+            rec = fn(args.scale)
+        except Exception as e:  # record the failure, keep measuring
+            rec = {"metric": fn.__name__, "value": None,
+                   "error": f"{type(e).__name__}: {e}"}
         rec["backend"] = jax.default_backend()
+        m, v = rec.get("metric"), rec.get("value")
+        if m in DIRECTIONS and m in prior and isinstance(v, (int, float)):
+            d = DIRECTIONS[m]
+            ratio = (v / prior[m]) if d > 0 else (prior[m] / v)
+            rec["vs_best_prior"] = round(ratio, 4)
+            if ratio < 0.9:
+                regressed.append((m, ratio))
+        results.append(rec)
         print(json.dumps(rec), flush=True)
+
+    if args.save is not None:
+        out = {"round": args.save, "scale": args.scale,
+               "backend": jax.default_backend(), "results": results}
+        path = os.path.join(HERE, f"results_r{args.save:02d}.json")
+        with open(path, "w") as fh:
+            json.dump(out, fh, indent=1)
+        print(f"# saved {path}", file=sys.stderr)
+
+    if args.gate and regressed:
+        for m, r in regressed:
+            print(f"# REGRESSION {m}: {r:.3f}x of best prior",
+                  file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

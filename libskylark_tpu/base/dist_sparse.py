@@ -262,7 +262,9 @@ def distribute_sparse(
     sp = A.to_scipy().tocoo()
     rows = np.asarray(sp.row, dtype=np.int64)
     cols = np.asarray(sp.col, dtype=np.int64)
-    vals = np.asarray(sp.data)
+    # device values follow the framework's precision policy (f64 host
+    # buffers land as f32 — same as SparseMatrix.coo / the local oracle)
+    vals = np.asarray(sp.data, dtype=np.dtype(A.device_dtype))
     rb, cb = rows // bs_r, cols // bs_c
     cell = rb * pc + cb
     order = np.argsort(cell, kind="stable")
@@ -272,8 +274,7 @@ def distribute_sparse(
 
     lr = np.zeros((pr, pc, pad), np.int32)
     lc = np.zeros((pr, pc, pad), np.int32)
-    v = np.zeros((pr, pc, pad), np.float32 if vals.dtype == np.float64
-                 else vals.dtype)
+    v = np.zeros((pr, pc, pad), vals.dtype)
     starts = np.concatenate([[0], np.cumsum(counts)])
     for cidx in range(pr * pc):
         s, e = starts[cidx], starts[cidx + 1]

@@ -22,6 +22,13 @@ from libskylark_tpu.io.hdf5 import (
     write_hdf5,
 )
 from libskylark_tpu.io.streaming import StreamingCWT
+from libskylark_tpu.io.chunked import (
+    iter_libsvm_batches,
+    iter_hdf5_batches,
+    read_libsvm_sharded,
+    scan_libsvm_dims,
+    stream_sketch_libsvm,
+)
 
 __all__ = [
     "read_libsvm",
@@ -33,4 +40,9 @@ __all__ = [
     "read_hdf5",
     "write_hdf5",
     "StreamingCWT",
+    "iter_libsvm_batches",
+    "iter_hdf5_batches",
+    "read_libsvm_sharded",
+    "scan_libsvm_dims",
+    "stream_sketch_libsvm",
 ]

@@ -359,6 +359,43 @@ def _default_precision() -> str:
     return sketch_params.get_pallas_precision()
 
 
+def fused_partial(
+    keys: jax.Array,
+    dist,
+    A_loc: jnp.ndarray,
+    s_dim: int,
+    seq_axis: int,
+    m_tile: int = 256,
+    precision: str | None = None,
+    interpret: bool = False,
+) -> Optional[jnp.ndarray]:
+    """UNSCALED contraction of a local shard against the operator blocks
+    keyed by ``keys`` (n_blocks_local, 2) — the building block that lets
+    the ``shard_map`` panel pipeline (parallel/shard_apply.py) run the
+    fused kernel per device: each device passes its own slice of the
+    global key table, contracts its shard, and the caller psums.
+
+    ``seq_axis`` is the contracted axis of ``A_loc`` (1 → A·Sᵀ partial,
+    0 → S·A partial). The shard's sequence extent must equal
+    ``keys.shape[0] * BLOCK_COLS`` (callers pre-pad to block multiples).
+    Returns None when the kernel isn't applicable (caller falls back;
+    backend/distribution qualification is _qualify's)."""
+    if A_loc.shape[seq_axis] != keys.shape[0] * BLOCK_COLS:
+        return None
+    mt = _qualify(dist, A_loc, seq_axis=seq_axis, m_tile=m_tile,
+                  interpret=interpret)
+    if mt is None:
+        return None
+    m = A_loc.shape[1 - seq_axis]
+    Ap = _padded(A_loc, seq_axis=seq_axis, mt=mt)
+    kw = dict(s_dim=s_dim, dist_kind=_DIST_KINDS[type(dist)], m_tile=mt,
+              precision=precision or _default_precision(),
+              interpret=interpret)
+    if seq_axis == 1:
+        return _fused_call(Ap, keys, **kw)[:m]
+    return _fused_call_cw(Ap, keys, **kw)[:, :m]
+
+
 def jr_key_data(k):
     import jax.random as jr
 

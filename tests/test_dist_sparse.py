@@ -150,7 +150,11 @@ def test_dense_rowwise_dist_oracle(Tcls, mesh1d, mesh2d, devices):
     m, n, s = 29, 300, 16
     A = _rand_sparse(m, n, seed=11)
     mesh5 = par.make_mesh(devices=devices[:5])
-    for mesh, axes in _grids(mesh1d, mesh2d, mesh5):
+    # 2D grid + ragged 5-device mesh only: the per-cell virtual-panel
+    # compile dominates runtime, and these two cover both code paths
+    # (psum over cols / ragged 1D)
+    for mesh, axes in [(mesh2d, dict(row_axis="rows", col_axis="cols")),
+                       (mesh5, dict(row_axis="rows"))]:
         T = Tcls(n, s, Context(seed=19))
         want = np.asarray(T.apply(A, ROWWISE))
         D = distribute_sparse(A, mesh, **axes)
@@ -165,7 +169,8 @@ def test_dense_columnwise_dist_oracle(Tcls, mesh1d, mesh2d, devices):
     n, w, s = 300, 29, 16
     A = _rand_sparse(n, w, seed=12)
     mesh5 = par.make_mesh(devices=devices[:5])
-    for mesh, axes in _grids(mesh1d, mesh2d, mesh5):
+    for mesh, axes in [(mesh2d, dict(row_axis="rows", col_axis="cols")),
+                       (mesh5, dict(row_axis="rows"))]:
         T = Tcls(n, s, Context(seed=20))
         want = np.asarray(T.apply(A, COLUMNWISE))
         D = distribute_sparse(A, mesh, **axes)

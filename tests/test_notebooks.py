@@ -1,0 +1,24 @@
+"""Execute every example notebook's code cells — notebooks are executable
+documentation, as in the reference (ref:
+python-skylark/skylark/notebooks/*.ipynb, wired as docs)."""
+
+import pathlib
+
+import nbformat
+import pytest
+
+NB_DIR = pathlib.Path(__file__).resolve().parent.parent / "examples" / "notebooks"
+NOTEBOOKS = sorted(NB_DIR.glob("*.ipynb"))
+
+
+@pytest.mark.parametrize("path", NOTEBOOKS, ids=lambda p: p.stem)
+def test_notebook_executes(path):
+    nb = nbformat.read(path, as_version=4)
+    ns: dict = {}
+    for cell in nb.cells:
+        if cell.cell_type == "code":
+            exec(compile(cell.source, f"{path.name}", "exec"), ns)
+
+
+def test_notebooks_present():
+    assert len(NOTEBOOKS) >= 4
