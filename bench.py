@@ -113,16 +113,17 @@ def _child() -> None:
         "value": round(gbps, 3),
         "secs_per_apply": secs,
     }
-    # Print the headline immediately — the informational bf16 extra below
+    # Print the headline immediately — the informational extras below
     # must not be able to void an already-successful measurement if the
     # child is killed at CHILD_TIMEOUT mid-extra.
     print("CHILD_RESULT " + json.dumps(rec), flush=True)
-    try:  # the throughput-only regime, as an informational extra
-        gbps_bf16, _ = run(precision="bf16", repeats=3)
-        print("CHILD_EXTRA " + json.dumps(
-            {"bf16_GBps": round(gbps_bf16, 3)}), flush=True)
-    except Exception:
-        pass
+    for regime in ("bf16x3", "bf16"):  # informational extras
+        try:
+            gbps_x, _ = run(precision=regime, repeats=3)
+            print("CHILD_EXTRA " + json.dumps(
+                {f"{regime}_GBps": round(gbps_x, 3)}), flush=True)
+        except Exception:
+            pass
 
 
 def _probe() -> None:
@@ -202,9 +203,8 @@ def main() -> None:
             if mm:
                 rec = json.loads(mm.group(1))
                 value = rec.pop("value")
-                me = re.search(r"CHILD_EXTRA (\{.*\})", out)
-                if me:
-                    rec.update(json.loads(me.group(1)))
+                for me in re.findall(r"CHILD_EXTRA (\{.*\})", out):
+                    rec.update(json.loads(me))
                 if errors:
                     rec["retries"] = len(errors)
                 _emit(value, rec)

@@ -237,26 +237,37 @@ def main():
     prior = _prior_best(args.scale, jax.default_backend())
     results = []
     regressed = []
-    benches = (bench_jlt, bench_cwt_sparse, bench_cwt_dist_sparse,
-               bench_feature_maps, bench_nla, bench_admm)
-    for fn in benches:
+    benches = (
+        (bench_jlt, "jlt_sketch_apply_GBps"),
+        (bench_cwt_sparse, "cwt_sparse_apply_Mnnz_per_s"),
+        (bench_cwt_dist_sparse, "cwt_dist_sparse_apply_Mnnz_per_s"),
+        (bench_feature_maps, "rft_feature_map_Mrows_per_s"),
+        (bench_nla, "nla_wallclock_s"),
+        (bench_admm, "admm_train_wallclock_s"),
+    )
+    for fn, metric in benches:
         if args.only and not any(
             s in fn.__name__ for s in args.only.split(",")
         ):
             continue
         try:
             rec = fn(args.scale)
-        except Exception as e:  # record the failure, keep measuring
-            rec = {"metric": fn.__name__, "value": None,
+        except Exception as e:  # record the failure under its REAL metric
+            rec = {"metric": metric, "value": None,
                    "error": f"{type(e).__name__}: {e}"}
         rec["backend"] = jax.default_backend()
         m, v = rec.get("metric"), rec.get("value")
-        if m in DIRECTIONS and m in prior and isinstance(v, (int, float)):
-            d = DIRECTIONS[m]
-            ratio = (v / prior[m]) if d > 0 else (prior[m] / v)
-            rec["vs_best_prior"] = round(ratio, 4)
-            if ratio < 0.9:
-                regressed.append((m, ratio))
+        if m in DIRECTIONS and m in prior:
+            if isinstance(v, (int, float)):
+                d = DIRECTIONS[m]
+                ratio = (v / prior[m]) if d > 0 else (prior[m] / v)
+                rec["vs_best_prior"] = round(ratio, 4)
+                if ratio < 0.9:
+                    regressed.append((m, ratio))
+            else:
+                # a previously-measured config that now crashes is the
+                # worst regression, not a free pass
+                regressed.append((m, 0.0))
         results.append(rec)
         print(json.dumps(rec), flush=True)
 

@@ -184,11 +184,12 @@ def read_libsvm_sharded(
 
     The distributed analog of the reference's chunked scatter reader
     (ref: ml/io.hpp:529-668: rank 0 reads chunks, sends each to its
-    owner): batches land on their owning device as they are parsed and
-    are concatenated in HBM — peak HOST memory is one batch plus one
-    device shard, independent of n. Ragged n (not divisible by the mesh
-    axis) zero-pads the last shard; the returned array is sliced back to
-    n rows.
+    owner): each shard is device_put to EVERY device the sharding assigns
+    it to (on a multi-axis mesh, P(axis, None) replicates a shard across
+    the other axes) as soon as its rows are parsed — peak HOST memory is
+    one batch plus one shard, independent of n. Ragged n (not divisible
+    by the mesh axis) zero-pads the last shard; the returned array is
+    sliced back to n rows.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -201,13 +202,25 @@ def read_libsvm_sharded(
         )
     p = mesh.shape[axis]
     bs = -(-n // p)                     # shard rows (ceil — ragged ok)
-    devices = list(mesh.devices.reshape(-1))
+    y_cols = max(nt, 1)
+    spec = NamedSharding(mesh, P(axis, None))
+
+    # owner devices of each row-shard, from the sharding itself — NOT
+    # mesh-order guesswork (a 2D mesh replicates each shard across the
+    # non-sharded axes)
+    owners: list[list] = [[] for _ in range(p)]
+    for dev, idx in spec.devices_indices_map((p * bs, d)).items():
+        start = idx[0].start or 0
+        owners[start // bs].append(dev)
+
+    def place(parts, shard_np, si):
+        for dev in owners[si]:
+            parts.append(jax.device_put(shard_np, dev))
 
     xs, ys = [], []
     x_parts, y_parts = [], []
     filled = 0
-    di = 0
-    y_cols = max(nt, 1)
+    si = 0
     for Xb, Yb in iter_libsvm_batches(
         source, batch_rows, d=d, max_n=max_n, dtype=dtype
     ):
@@ -219,36 +232,33 @@ def read_libsvm_sharded(
             Xb, Yb = Xb[take:], Yb[take:]
             filled += take
             if filled == bs:
-                x_parts.append(jax.device_put(
-                    np.concatenate(xs), devices[di]))
-                y_parts.append(jax.device_put(
-                    np.concatenate(ys), devices[di]))
+                place(x_parts, np.concatenate(xs), si)
+                place(y_parts, np.concatenate(ys), si)
                 xs, ys = [], []
                 filled = 0
-                di += 1
-    if filled or di < len(devices):
-        # ragged tail: zero-pad the final shard, replicate zeros after
+                si += 1
+    if filled or si < p:
+        # ragged tail: zero-pad the final shard; later shards are zeros
         tail_x = np.concatenate(xs) if xs else np.zeros((0, d), dtype)
         tail_y = (np.concatenate(ys) if ys
                   else np.zeros((0, y_cols), dtype))
         pad = bs - len(tail_x)
         tail_x = np.pad(tail_x, ((0, pad), (0, 0)))
         tail_y = np.pad(tail_y, ((0, pad), (0, 0)))
-        x_parts.append(jax.device_put(tail_x, devices[di]))
-        y_parts.append(jax.device_put(tail_y, devices[di]))
-        di += 1
+        place(x_parts, tail_x, si)
+        place(y_parts, tail_y, si)
+        si += 1
         zx = np.zeros((bs, d), dtype)
         zy = np.zeros((bs, y_cols), dtype)
-        while di < len(devices):
-            x_parts.append(jax.device_put(zx, devices[di]))
-            y_parts.append(jax.device_put(zy, devices[di]))
-            di += 1
+        while si < p:
+            place(x_parts, zx, si)
+            place(y_parts, zy, si)
+            si += 1
 
-    spec_x = NamedSharding(mesh, P(axis, None))
     X = jax.make_array_from_single_device_arrays(
-        (p * bs, d), spec_x, x_parts)[:n]
+        (p * bs, d), spec, x_parts)[:n]
     Y = jax.make_array_from_single_device_arrays(
-        (p * bs, y_cols), spec_x, y_parts)[:n]
+        (p * bs, y_cols), spec, y_parts)[:n]
     if nt <= 1:
         Y = Y[:, 0]
     return X, Y
@@ -264,9 +274,21 @@ def stream_sketch_libsvm(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Sketch a libsvm source down to ``s`` rows in bounded memory:
     chunked parse → :class:`StreamingCWT`. Equals the one-shot
-    ``CWT.apply`` on the full file (counter-stream order independence)."""
+    ``CWT.apply`` on the full file (counter-stream order independence).
+
+    Needs a re-readable path (one pass to size the streams, one to
+    sketch); for a one-shot stream, run :func:`scan_libsvm_dims` on a
+    replica yourself and feed :func:`iter_libsvm_batches` to
+    :class:`StreamingCWT` directly."""
     from libskylark_tpu.io.streaming import StreamingCWT
 
+    if not (isinstance(source, (str, bytes))
+            or hasattr(source, "__fspath__")):
+        raise errors.InvalidParametersError(
+            "stream_sketch_libsvm needs a re-readable path (streams: "
+            "scan_libsvm_dims on a replica + iter_libsvm_batches + "
+            "StreamingCWT)"
+        )
     n, d, _ = scan_libsvm_dims(source, max_n)
     sk = StreamingCWT(n, s, context)
     batches = iter_libsvm_batches(source, batch_rows, d=d, max_n=max_n)

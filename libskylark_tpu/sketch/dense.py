@@ -39,6 +39,37 @@ from libskylark_tpu.sketch.transform import SketchTransform, register
 BLOCK_COLS = 256
 
 
+def try_pallas_apply(key, dist, A, s_dim: int, scale: float, which: str):
+    """Fused generation+matmul TPU kernel (sketch/pallas_dense.py) for any
+    virtual operator in the dense-block stream format — the dense
+    transforms and the RFT frequency matrices share this dispatch.
+
+    Returns None when the backend/input don't qualify. Sharded applies
+    keep the XLA path (its partitioning XLA handles); on a tracer the
+    sharding is unreadable, so traced applies use the kernel only when
+    the backend has a single device and sharding is impossible (the
+    multi-device kernel route is the explicit shard_map pipeline,
+    parallel/shard_apply.py)."""
+    if not sketch_params.get_use_pallas():
+        return None
+    import jax
+
+    if isinstance(A, jax.core.Tracer):
+        if len(jax.devices()) != 1:
+            return None
+    elif isinstance(A, jax.Array):
+        try:
+            if len(A.sharding.device_set) != 1:
+                return None
+        except Exception:
+            return None
+    else:
+        return None
+    from libskylark_tpu.sketch import pallas_dense
+
+    return getattr(pallas_dense, which)(key, dist, A, s_dim, scale)
+
+
 class DenseTransform(SketchTransform):
     """Base: S = scale × i.i.d. matrix from ``dist``
     (ref: sketch/random_dense_transform_data.hpp:15-76)."""
@@ -87,30 +118,8 @@ class DenseTransform(SketchTransform):
         return A @ S.T
 
     def _try_pallas(self, A, which: str):
-        """Fused generation+matmul TPU kernel (sketch/pallas_dense.py);
-        None when the backend/input don't qualify. Sharded applies keep the
-        XLA path (its partitioning XLA handles); on a tracer the sharding
-        is unreadable, so traced applies use the kernel only when the
-        backend has a single device and sharding is impossible."""
-        if not sketch_params.get_use_pallas():
-            return None
-        import jax
-
-        if isinstance(A, jax.core.Tracer):
-            if len(jax.devices()) != 1:
-                return None
-        elif isinstance(A, jax.Array):
-            try:
-                if len(A.sharding.device_set) != 1:
-                    return None
-            except Exception:
-                return None
-        else:
-            return None
-        from libskylark_tpu.sketch import pallas_dense
-
-        return getattr(pallas_dense, which)(
-            self._alloc.key, self.dist, A, self._S, self.scale
+        return try_pallas_apply(
+            self._alloc.key, self.dist, A, self._S, self.scale, which
         )
 
     # -- sparse input (ref: sketch/dense_transform_Mixed.hpp:19) --

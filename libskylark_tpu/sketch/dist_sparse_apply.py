@@ -98,6 +98,74 @@ def hash_rowwise(T, D: DistSparseMatrix) -> jax.Array:
     return out.reshape(D.pr * bs_r, s_dim)[: D.height]
 
 
+def hash_apply_sparse(T, D: DistSparseMatrix, columnwise: bool = True
+                      ) -> DistSparseMatrix:
+    """Sparse→sparse distributed hash apply: the analog of the reference's
+    SpParMat → SpParMat CombBLAS path (ref:
+    sketch/hash_transform_CombBLAS.hpp:141-632 — sketching a distributed
+    sparse matrix without densifying it).
+
+    A hash sketch maps each nonzero 1:1 — columnwise, (r, c, v) →
+    (h[r], c, vs[r]·v) — so the triplets are rewritten cell-locally with
+    NO arithmetic collective; the cells along the sketched axis then merge
+    into one bucket-extent block (a reshape across that mesh axis — data
+    movement proportional to nnz), leaving a :class:`DistSparseMatrix`
+    distributed on the kept axis only. Padding entries stay padding (v=0
+    at local (0,0)). Duplicate bucket collisions remain separate COO
+    entries — every consumer (spmm/todense/to_local) sums duplicates, the
+    CSC ``set()`` convention of ref: base/sparse_matrix.hpp:136.
+    """
+    import jax as _jax
+    from jax.sharding import NamedSharding
+
+    _check_dim(T, D, columnwise=columnwise)
+    h = T.bucket_indices()
+    vs = T.values(D.dtype)
+    bs_r, bs_c = D.bs_r, D.bs_c
+    row_axis, col_axis = D.row_axis, D.col_axis
+    mesh = D.mesh
+
+    def local(lr, lc, v, h, vs):
+        lr_, lc_, v_ = lr[0, 0], lc[0, 0], v[0, 0]
+        keep = v_ != 0
+        if columnwise:
+            rb = lax.axis_index(row_axis) if row_axis else 0
+            g = rb * bs_r + lr_
+            new_lr = jnp.where(keep, h[g], 0)
+            new_lc = lc_
+        else:
+            cb = lax.axis_index(col_axis) if col_axis else 0
+            g = cb * bs_c + lc_
+            new_lr = lr_
+            new_lc = jnp.where(keep, h[g], 0)
+        new_v = jnp.where(keep, vs[g] * v_, jnp.zeros((), v_.dtype))
+        return (new_lr[None, None], new_lc[None, None], new_v[None, None])
+
+    nlr, nlc, nv = shard_map(
+        local, mesh=mesh,
+        in_specs=(D._triplet_spec(),) * 3 + (P(), P()),
+        out_specs=(D._triplet_spec(),) * 3,
+    )(D.lr, D.lc, D.v, h, vs)
+
+    pr, pc, pad = D.pr, D.pc, D.v.shape[-1]
+    if columnwise:
+        # merge the pr row-cells into the single bucket row block
+        spec = NamedSharding(mesh, P(None, col_axis, None))
+        merge = lambda a: _jax.device_put(
+            a.transpose(1, 0, 2).reshape(1, pc, pr * pad), spec)
+        return DistSparseMatrix(
+            mesh, None, col_axis, (T.sketch_dim, D.width),
+            merge(nlr), merge(nlc), merge(nv),
+        )
+    spec = NamedSharding(mesh, P(row_axis, None, None))
+    merge = lambda a: _jax.device_put(
+        a.reshape(pr, 1, pc * pad), spec)
+    return DistSparseMatrix(
+        mesh, row_axis, None, (D.height, T.sketch_dim),
+        merge(nlr), merge(nlc), merge(nv),
+    )
+
+
 # ---------------------------------------------------------------------------
 # dense transforms (JLT / CT) — virtual-operator panels per cell
 # ---------------------------------------------------------------------------

@@ -90,11 +90,20 @@ class HashTransform(SketchTransform):
         duplicate-summed CSC structure (ref:
         sketch/hash_transform_local_sparse.hpp — the sparse-output path).
         Runs on host; the bucket/value streams are identical to the device
-        path, so results match ``apply`` elementwise."""
+        path, so results match ``apply`` elementwise. A
+        :class:`DistSparseMatrix` input returns a distributed sparse
+        result (the SpParMat→SpParMat analog, all device-side)."""
         import numpy as np
 
+        from libskylark_tpu.base.dist_sparse import DistSparseMatrix
         from libskylark_tpu.base.sparse import SparseMatrix
         from libskylark_tpu.sketch.transform import COLUMNWISE, Dimension
+
+        if isinstance(A, DistSparseMatrix):
+            from libskylark_tpu.sketch import dist_sparse_apply as dsa
+
+            cw = (dimension or COLUMNWISE) == Dimension.COLUMNWISE
+            return dsa.hash_apply_sparse(self, A, columnwise=cw)
 
         dimension = dimension or COLUMNWISE
         if dimension == Dimension.COLUMNWISE:

@@ -50,9 +50,12 @@ def set_use_pallas(on: bool) -> None:
 # ``pallas_precision`` — contraction regime inside the fused kernel.
 # "f32" (default): full-f32 MXU passes (Precision.HIGHEST); the fused
 # apply stays within the framework's 1e-4 determinism oracle vs the XLA
-# path. "bf16": single-pass bf16 inputs + f32 accumulation — fastest, but
-# rounds the contraction at ~2⁻⁸ relative (outside the oracle for large
-# N); throughput-only work opts in explicitly.
+# path. "bf16x3": 3-pass bf16 (Precision.HIGH) — f32-grade rounding at
+# roughly half the cost, pending on-chip oracle validation
+# (tests/test_pallas_dense.py::test_fused_on_chip_*). "bf16": single-pass
+# bf16 inputs + f32 accumulation — fastest, but rounds the contraction at
+# ~2⁻⁸ relative (outside the oracle for large N); throughput-only work
+# opts in explicitly.
 _pallas_precision = "f32"
 
 
@@ -61,7 +64,9 @@ def get_pallas_precision() -> str:
 
 
 def set_pallas_precision(p: str) -> None:
-    if p not in ("f32", "bf16"):
-        raise ValueError(f"pallas_precision must be 'f32' or 'bf16', got {p!r}")
+    if p not in ("f32", "bf16x3", "bf16"):
+        raise ValueError(
+            f"pallas_precision must be 'f32', 'bf16x3' or 'bf16', got {p!r}"
+        )
     global _pallas_precision
     _pallas_precision = p

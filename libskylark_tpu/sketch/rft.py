@@ -65,13 +65,36 @@ class RFT(SketchTransform):
         sh = self.shifts(dt).reshape(shape)
         return self.outscale * jnp.cos(WA * sc + sh)
 
+    def _project_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        """W·A — on TPU via the fused generation+matmul kernel (W is in
+        the same dense-block stream format as the dense transforms); XLA
+        panel materialization otherwise."""
+        from libskylark_tpu.sketch.dense import try_pallas_apply
+
+        out = try_pallas_apply(
+            self.subkey(0), self.dist, A, self._S, self.inscale,
+            "columnwise_apply",
+        )
+        if out is not None:
+            return out
+        return self.w_panel(0, self._N, A.dtype) @ A
+
+    def _project_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        from libskylark_tpu.sketch.dense import try_pallas_apply
+
+        out = try_pallas_apply(
+            self.subkey(0), self.dist, A, self._S, self.inscale,
+            "rowwise_apply",
+        )
+        if out is not None:
+            return out
+        return A @ self.w_panel(0, self._N, A.dtype).T
+
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        W = self.w_panel(0, self._N, A.dtype)
-        return self._featurize(W @ A, feature_axis=0)
+        return self._featurize(self._project_columnwise(A), feature_axis=0)
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        W = self.w_panel(0, self._N, A.dtype)
-        return self._featurize(A @ W.T, feature_axis=1)
+        return self._featurize(self._project_rowwise(A), feature_axis=1)
 
     # -- sparse input: project with the segment-sum spmm kernels --
 

@@ -180,6 +180,29 @@ def test_dense_columnwise_dist_oracle(Tcls, mesh1d, mesh2d, devices):
         np.testing.assert_allclose(got, want, atol=tol, err_msg=str(axes))
 
 
+@pytest.mark.parametrize("cw", [True, False], ids=["columnwise", "rowwise"])
+def test_hash_sparse_to_sparse_dist(cw, mesh1d, mesh2d, devices):
+    """Sparse→sparse distributed hash apply (SpParMat→SpParMat analog):
+    the distributed sparse result must densify to the local sparse→sparse
+    apply's result."""
+    from libskylark_tpu.sketch.transform import COLUMNWISE, ROWWISE as RW
+
+    n, w, s = 100, 37, 24
+    mesh5 = par.make_mesh(devices=devices[:5])
+    shape = (n, w) if cw else (w, n)
+    A = _rand_sparse(*shape, seed=14)
+    for mesh, axes in _grids(mesh1d, mesh2d, mesh5):
+        T = CWT(n, s, Context(seed=23))
+        want = T.apply_sparse(A, COLUMNWISE if cw else RW)
+        D = distribute_sparse(A, mesh, **axes)
+        got = T.apply_sparse(D, COLUMNWISE if cw else RW)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(
+            np.asarray(got.todense()), want.to_scipy().toarray(),
+            atol=ATOL, err_msg=str(axes),
+        )
+
+
 def test_empty_cells_ok(mesh2d):
     """A matrix whose nonzeros all land in one grid cell — the other cells
     are pure padding."""

@@ -78,6 +78,17 @@ def test_read_sharded_equals_one_shot(tmp_path, mesh1d, n):
     np.testing.assert_allclose(np.asarray(Y), Y1, atol=1e-6)
 
 
+def test_read_sharded_2d_mesh(tmp_path, mesh2d):
+    """On a 2D mesh, P('rows', None) replicates each shard across the
+    column axis — every replica device must receive the shard's data
+    (regression test for mesh-order device placement)."""
+    p, _, _ = _write_libsvm(tmp_path, n=48, seed=6)
+    X1, Y1 = skio.read_libsvm(p)
+    X, Y = skio.read_libsvm_sharded(p, mesh2d, batch_rows=11)
+    np.testing.assert_allclose(np.asarray(X), X1, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(Y), Y1, atol=1e-6)
+
+
 def test_stream_sketch_equals_one_shot(tmp_path):
     """Chunked streaming sketch == one-shot CWT of the whole file
     (counter-stream order independence)."""

@@ -1,0 +1,68 @@
+"""Krylov solvers on mesh-sharded operands == local (the solver-level
+analog of the reference's multi-rank unit tests — LSQR/CG are templated
+over distributed matrix types and run under mpirun there; here the same
+solver code takes sharded arrays and XLA inserts the collectives,
+ref: algorithms/Krylov/LSQR.hpp:21, CG.hpp:23, internal.hpp replicated
+scalars)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from libskylark_tpu import parallel as par
+from libskylark_tpu.algorithms.krylov import KrylovParams, cg, lsqr
+
+
+@pytest.fixture()
+def problem():
+    rng = np.random.default_rng(0)
+    m, n, k = 96, 24, 3
+    A = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+    B = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    return A, B
+
+
+def test_lsqr_sharded_matches_local(problem, mesh1d):
+    A, B = problem
+    X0, it0 = lsqr(A, B, KrylovParams(tolerance=1e-8, iter_lim=200))
+    Ad = jax.device_put(A, NamedSharding(mesh1d, P("rows", None)))
+    Bd = jax.device_put(B, NamedSharding(mesh1d, P("rows", None)))
+    X1, it1 = lsqr(Ad, Bd, KrylovParams(tolerance=1e-8, iter_lim=200))
+    np.testing.assert_allclose(
+        np.asarray(X1), np.asarray(X0), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_lsqr_sharded_5_device_submesh(devices):
+    """np=5-style mesh diversity (jax NamedShardings need divisible dims,
+    so the rows are a multiple of 5 — true ragged layouts live in the
+    explicit-padding layers: shard_apply, dist_sparse, pallas_dense)."""
+    rng = np.random.default_rng(2)
+    m, n, k = 90, 24, 3
+    A = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+    B = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    mesh5 = par.make_mesh(devices=devices[:5])
+    X0, _ = lsqr(A, B, KrylovParams(tolerance=1e-8, iter_lim=200))
+    Ad = jax.device_put(A, NamedSharding(mesh5, P("rows", None)))
+    Bd = jax.device_put(B, NamedSharding(mesh5, P("rows", None)))
+    X1, _ = lsqr(Ad, Bd, KrylovParams(tolerance=1e-8, iter_lim=200))
+    np.testing.assert_allclose(
+        np.asarray(X1), np.asarray(X0), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_cg_sharded_matches_local(mesh1d):
+    rng = np.random.default_rng(1)
+    n, k = 48, 2
+    M = rng.standard_normal((n, n)).astype(np.float32)
+    A = jnp.asarray(M @ M.T + n * np.eye(n, dtype=np.float32))
+    B = jnp.asarray(rng.standard_normal((n, k)), jnp.float32)
+    X0, _ = cg(A, B, KrylovParams(tolerance=1e-10, iter_lim=300))
+    Ad = jax.device_put(A, NamedSharding(mesh1d, P("rows", None)))
+    Bd = jax.device_put(B, NamedSharding(mesh1d, P("rows", None)))
+    X1, _ = cg(Ad, Bd, KrylovParams(tolerance=1e-10, iter_lim=300))
+    np.testing.assert_allclose(
+        np.asarray(X1), np.asarray(X0), atol=1e-4, rtol=1e-4
+    )

@@ -221,11 +221,35 @@ def test_try_pallas_interpret_consistency_via_transform():
     )
 
 
+def test_rft_projection_rides_the_kernel():
+    """The RFT frequency matrix shares the dense-block stream format, so
+    the fused kernel path (interpret) must equal the XLA w_panel path
+    after the cos featurization."""
+    from libskylark_tpu.sketch.rft import GaussianRFT
+
+    n, s, m = 512, 64, 24
+    T = GaussianRFT(n, s, Context(seed=13), sigma=2.0)
+    A = jnp.asarray(
+        np.random.default_rng(7).standard_normal((m, n)), jnp.float32
+    )
+    want = np.asarray(T.apply(A, ROWWISE))          # XLA path (fixture)
+    proj = pd.rowwise_apply(
+        T.subkey(0), T.dist, A, s, T.inscale,
+        precision="f32", interpret=True,
+    )
+    assert proj is not None
+    got = np.asarray(T._featurize(proj, feature_axis=1))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
 @pytest.mark.tpu
 @pytest.mark.skipif(not ON_TPU, reason="needs a real TPU backend")
-def test_fused_on_chip_matches_xla():
-    """On-chip (Mosaic-compiled, not interpreted) vs the XLA path."""
-    m, n, s = 256, 1024, 128
+@pytest.mark.parametrize("precision", ["f32", "bf16x3"])
+def test_fused_on_chip_matches_xla(precision):
+    """On-chip (Mosaic-compiled, not interpreted) vs the XLA path. The
+    bf16x3 case certifies Precision.HIGH against the 1e-4 oracle on real
+    MXU rounding — the interpreter can't (it executes HIGH as f32)."""
+    m, n, s = 256, 2048, 128
     ctx = Context(seed=12)
     jlt = JLT(n, s, ctx)
     A = jnp.asarray(
@@ -233,7 +257,7 @@ def test_fused_on_chip_matches_xla():
     )
     want = np.asarray(jlt.apply(A, ROWWISE))
     got = pd.rowwise_apply(
-        jlt._alloc.key, jlt.dist, A, s, jlt.scale, precision="f32"
+        jlt._alloc.key, jlt.dist, A, s, jlt.scale, precision=precision
     )
     assert got is not None
     np.testing.assert_allclose(np.asarray(got), want, atol=1e-4, rtol=1e-4)
