@@ -208,6 +208,22 @@ class DistSparseMatrix:
         out = out.reshape(self.pc * bs_c, k)[: self.width]
         return out[:, 0] if squeeze else out
 
+    def transpose(self) -> "DistSparseMatrix":
+        """Aᵀ — pure relabeling: swap the grid axes and the local
+        coordinates (no data movement beyond the stacked-array transpose;
+        ref: base/sparse_matrix.hpp Transpose:303)."""
+        perm = (1, 0, 2)
+        return DistSparseMatrix(
+            self.mesh, self.col_axis, self.row_axis,
+            (self.width, self.height),
+            self.lc.transpose(perm), self.lr.transpose(perm),
+            self.v.transpose(perm),
+        )
+
+    @property
+    def T(self) -> "DistSparseMatrix":
+        return self.transpose()
+
     def todense(self) -> jax.Array:
         """Dense (h, w) array sharded P(row_axis, col_axis)."""
         bs_r, bs_c = self.bs_r, self.bs_c

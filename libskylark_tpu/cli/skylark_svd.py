@@ -37,6 +37,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--single", action="store_true",
                    help="single precision (f32 is the TPU-native default; "
                    "flag kept for command-line parity)")
+    p.add_argument("--streaming", action="store_true",
+                   help="stream the file into sharded device memory in "
+                   "bounded host memory (the HDFS-reader analog; dense "
+                   "libsvm input only)")
+    p.add_argument("--batch-rows", type=int, default=65536,
+                   help="rows per streamed batch with --streaming")
     p.add_argument("--profile", nargs=2, type=int, metavar=("H", "W"),
                    help="generate a random HxW matrix and run on it")
     p.add_argument("--prefix", default="out")
@@ -57,6 +63,12 @@ def main(argv=None) -> int:
         approximate_symmetric_svd,
     )
 
+    if args.streaming and (args.directory or args.filetype == "ARC_LIST"
+                           or args.sparse or args.profile):
+        print("error: --streaming applies only to a single dense libsvm "
+              "file", file=sys.stderr)
+        return 2
+
     context = Context(seed=args.seed)
     t0 = time.time()
     if args.profile:
@@ -67,13 +79,20 @@ def main(argv=None) -> int:
         print("error: inputfile required (or --profile)", file=sys.stderr)
         return 2
     elif args.filetype == "ARC_LIST":
-        A = skio.read_arc_list(args.inputfile, symmetrize=True).todense()
+        # sparse adjacency operand — never densified (ref: the sparse
+        # branch of nla/skylark_svd.cpp:129-215)
+        A = skio.read_arc_list(args.inputfile, symmetrize=True)
     elif args.directory:
         X, _ = skio.read_dir_libsvm(args.inputfile, sparse=args.sparse)
-        A = X.todense() if args.sparse else jnp.asarray(X)
+        A = X if args.sparse else jnp.asarray(X)
+    elif args.streaming:
+        from libskylark_tpu.parallel import make_mesh
+
+        A, _ = skio.read_libsvm_sharded(
+            args.inputfile, make_mesh(), batch_rows=args.batch_rows)
     else:
         X, _ = skio.read_libsvm(args.inputfile, sparse=args.sparse)
-        A = X.todense() if args.sparse else jnp.asarray(X)
+        A = X if args.sparse else jnp.asarray(X)
     print(f"Reading the matrix... took {time.time() - t0:.2e} sec")
 
     params = ApproximateSVDParams(

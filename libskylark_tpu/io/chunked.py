@@ -200,6 +200,10 @@ def read_libsvm_sharded(
             "read_libsvm_sharded needs a re-readable path (streams: use "
             "iter_libsvm_batches + your own placement)"
         )
+    if n == 0:
+        raise errors.IOError_(
+            f"read_libsvm_sharded: no examples in {source!r}"
+        )
     p = mesh.shape[axis]
     bs = -(-n // p)                     # shard rows (ceil — ragged ok)
     y_cols = max(nt, 1)

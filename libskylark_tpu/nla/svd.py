@@ -35,9 +35,35 @@ class ApproximateSVDParams(Params):
     skip_qr: bool = False
 
 
+def _as_linear_ops(A):
+    """(mv, rmv, shape): X ↦ A·X and X ↦ Aᵀ·X over any operand kind —
+    dense array, local :class:`SparseMatrix`, or mesh-distributed
+    :class:`DistSparseMatrix` (the analog of the reference's
+    matrix-type-templated NLA, e.g. the sparse branch of
+    nla/skylark_svd.cpp:129-215, which never densifies)."""
+    from libskylark_tpu.base.dist_sparse import DistSparseMatrix
+    from libskylark_tpu.base.sparse import SparseMatrix, spmm, spmm_t
+
+    if isinstance(A, SparseMatrix):
+        return (lambda X: spmm(A, X)), (lambda X: spmm_t(A, X)), A.shape
+    if isinstance(A, DistSparseMatrix):
+        return A.spmm, A.spmm_t, A.shape
+    A = jnp.asarray(A)
+    return (lambda X: A @ X), (lambda X: A.T @ X), A.shape
+
+
+def _transposed(A):
+    from libskylark_tpu.base.dist_sparse import DistSparseMatrix
+    from libskylark_tpu.base.sparse import SparseMatrix
+
+    if isinstance(A, (SparseMatrix, DistSparseMatrix)):
+        return A.T
+    return jnp.asarray(A).T
+
+
 @with_solver_precision
 def power_iteration(
-    A: jnp.ndarray,
+    A,
     Q: jnp.ndarray,
     num_iterations: int,
     orthogonalize: bool = True,
@@ -45,12 +71,14 @@ def power_iteration(
 ) -> jnp.ndarray:
     """(A·Aᵀ)^q · Q (or (Aᵀ·A)^q · Q when ``adjoint``) with QR
     re-orthogonalization between products unless disabled
-    (ref: nla/svd.hpp:76-153 — the four orientation combos)."""
+    (ref: nla/svd.hpp:76-153 — the four orientation combos). ``A`` may be
+    dense, sparse, or distributed sparse."""
+    mv, rmv, _ = _as_linear_ops(A)
     for _ in range(num_iterations):
         if adjoint:
-            Q = A.T @ (A @ Q)
+            Q = rmv(mv(Q))
         else:
-            Q = A @ (A.T @ Q)
+            Q = mv(rmv(Q))
         if orthogonalize:
             Q, _ = jnp.linalg.qr(Q)
     return Q
@@ -69,12 +97,23 @@ def approximate_svd(
 
     Sketch size k' = ratio·k + additive; JLT range sketch; power iteration;
     small exact SVD; truncation. Wide matrices (m < n) are handled by
-    factoring Aᵀ and swapping U/V (the reference's second branch)."""
+    factoring Aᵀ and swapping U/V (the reference's second branch).
+
+    ``A`` may be a dense (possibly sharded) array, a local
+    :class:`SparseMatrix`, or a :class:`DistSparseMatrix` — the sparse
+    kinds are never densified (the reference's sparse branch,
+    nla/skylark_svd.cpp:129-215)."""
     params = params or ApproximateSVDParams()
-    A = jnp.asarray(A)
-    if dtype is not None:
-        A = A.astype(dtype)
-    m, n = A.shape
+    if not hasattr(A, "coo") and not hasattr(A, "spmm"):
+        A = jnp.asarray(A)
+        if dtype is not None:
+            A = A.astype(dtype)
+    elif dtype is not None:
+        raise errors.InvalidParametersError(
+            "dtype override is only supported for dense operands; sparse "
+            "operands compute at their device dtype"
+        )
+    mv, rmv, (m, n) = _as_linear_ops(A)
     k = int(rank)
     if k <= 0:
         raise errors.InvalidParametersError(f"rank must be positive, got {rank}")
@@ -83,7 +122,7 @@ def approximate_svd(
     kp = max(kp, k)
 
     if m < n:
-        V, S, U = approximate_svd(A.T, rank, context, params)
+        V, S, U = approximate_svd(_transposed(A), rank, context, params)
         return U, S, V
 
     from libskylark_tpu import sketch as sk
@@ -100,9 +139,9 @@ def approximate_svd(
         # One final orthogonalization is always required before projection.
         Q, _ = jnp.linalg.qr(Q)
 
-    # Rayleigh-Ritz on the range: B = Qᵀ·A, small SVD, rotate back
-    # (ref: nla/svd.hpp:283-290).
-    B = Q.T @ A  # (kp, n)
+    # Rayleigh-Ritz on the range: B = Qᵀ·A = (Aᵀ·Q)ᵀ, small SVD, rotate
+    # back (ref: nla/svd.hpp:283-290).
+    B = rmv(Q).T  # (kp, n)
     Ub, S, Vt = jnp.linalg.svd(B, full_matrices=False)
     U = Q @ Ub[:, :k]
     return U, S[:k], Vt[:k, :].T
@@ -117,11 +156,13 @@ def approximate_symmetric_svd(
 ):
     """Approximate eigendecomposition of symmetric A: returns (V, S) with
     A ≈ V·diag(S)·Vᵀ (ref: nla/svd.hpp:326-396 — Gaussian sketch +
-    SymmetricPowerIteration + Rayleigh-Ritz via HermitianEig)."""
+    SymmetricPowerIteration + Rayleigh-Ritz via HermitianEig). ``A`` may
+    be dense, sparse, or distributed sparse."""
     params = params or ApproximateSVDParams()
-    A = jnp.asarray(A)
-    n = A.shape[0]
-    if A.shape[0] != A.shape[1]:
+    if not hasattr(A, "coo") and not hasattr(A, "spmm"):
+        A = jnp.asarray(A)
+    mv, _rmv, (n, n2) = _as_linear_ops(A)
+    if n != n2:
         raise errors.InvalidParametersError("symmetric SVD expects a square matrix")
     if int(rank) <= 0:
         raise errors.InvalidParametersError(f"rank must be positive, got {rank}")
@@ -136,14 +177,14 @@ def approximate_symmetric_svd(
     Q = T.apply(A, sk.ROWWISE)  # (n, kp) Gaussian range sketch
     Q, _ = jnp.linalg.qr(Q)
     for _ in range(params.num_iterations):
-        Q = A @ Q
+        Q = mv(Q)
         if not params.skip_qr:
             Q, _ = jnp.linalg.qr(Q)
     if params.skip_qr:
         Q, _ = jnp.linalg.qr(Q)
 
     # Rayleigh-Ritz: eigendecomposition of QᵀAQ (ref: nla/svd.hpp:175-225).
-    G = Q.T @ (A @ Q)
+    G = Q.T @ mv(Q)
     G = 0.5 * (G + G.T)
     w, Z = jnp.linalg.eigh(G)
     # take the k largest-magnitude eigenpairs, descending

@@ -68,6 +68,20 @@ class TestSVDCli:
         opt = (u[:, :4] * s[:4]) @ vt[:4]
         assert np.linalg.norm(R - X) <= 1.25 * np.linalg.norm(opt - X) + 1e-5
 
+    def test_streaming_matches_oneshot(self, regression_file, tmp_path):
+        """--streaming (chunked read into sharded HBM) must produce the
+        same factorization as the whole-file read at the same seed."""
+        path, X, _ = regression_file
+        p1, p2 = str(tmp_path / "a"), str(tmp_path / "b")
+        assert skylark_svd.main([path, "-k", "4", "--prefix", p1]) == 0
+        assert skylark_svd.main(
+            [path, "-k", "4", "--prefix", p2,
+             "--streaming", "--batch-rows", "7"]) == 0
+        for suffix in (".U.txt", ".S.txt", ".V.txt"):
+            np.testing.assert_allclose(
+                np.loadtxt(p2 + suffix), np.loadtxt(p1 + suffix),
+                atol=1e-3, rtol=1e-3)
+
     def test_profile_mode(self, tmp_path):
         prefix = str(tmp_path / "prof")
         rc = skylark_svd.main(

@@ -203,6 +203,43 @@ def test_hash_sparse_to_sparse_dist(cw, mesh1d, mesh2d, devices):
         )
 
 
+def test_transpose(mesh2d):
+    A = _rand_sparse(37, 53, seed=15)
+    D = distribute_sparse(A, mesh2d, row_axis="rows", col_axis="cols")
+    np.testing.assert_allclose(
+        np.asarray(D.T.todense()), A.to_scipy().toarray().T, atol=0
+    )
+
+
+def test_approximate_svd_on_dist_sparse(mesh2d, mesh1d):
+    """Randomized SVD on sparse operands without densifying (the
+    reference's sparse branch, ref: nla/skylark_svd.cpp:129-215) — local
+    SparseMatrix and DistSparseMatrix must both track the dense result."""
+    from libskylark_tpu.nla.svd import ApproximateSVDParams, approximate_svd
+
+    rng = np.random.default_rng(16)
+    U0 = rng.standard_normal((120, 5)).astype(np.float32)
+    V0 = rng.standard_normal((5, 60)).astype(np.float32)
+    mask = rng.uniform(size=(120, 60)) < 0.3
+    dense = (U0 @ V0) * mask
+    A = SparseMatrix.from_scipy(sp.csc_matrix(dense))
+    k = 4
+    p = ApproximateSVDParams(num_iterations=2)
+    Ud, Sd, Vd = approximate_svd(jnp.asarray(dense), k, Context(seed=30), p)
+    for operand in (A, distribute_sparse(A, mesh2d, row_axis="rows",
+                                         col_axis="cols")):
+        U, S, V = approximate_svd(operand, k, Context(seed=30), p)
+        np.testing.assert_allclose(np.asarray(S), np.asarray(Sd),
+                                   rtol=1e-3, atol=1e-3)
+        rec = np.asarray(U * S[None]) @ np.asarray(V).T
+        recd = np.asarray(Ud * Sd[None]) @ np.asarray(Vd).T
+        np.testing.assert_allclose(rec, recd, atol=1e-2)
+    # wide branch (m < n) through the transposed operand
+    Uw, Sw, Vw = approximate_svd(A.T, k, Context(seed=30), p)
+    np.testing.assert_allclose(np.asarray(Sw), np.asarray(Sd),
+                               rtol=1e-3, atol=1e-3)
+
+
 def test_empty_cells_ok(mesh2d):
     """A matrix whose nonzeros all land in one grid cell — the other cells
     are pure padding."""
