@@ -99,6 +99,11 @@ def run(m: int = 8192, n: int = 8192, s: int = 1024, repeats: int = 5,
         t2 = time.perf_counter()
         best = min(best, ((t2 - t1) - (t1 - t0)) / (k2 - k1))
 
+    trace_dir = os.environ.get("SKYLARK_BENCH_TRACE")
+    if trace_dir:  # one traced apply for offline kernel analysis
+        with jax.profiler.trace(trace_dir):
+            float(f2(A))
+
     bytes_moved = 4 * (m * n + m * s)
     return bytes_moved / best / 1e9, best
 

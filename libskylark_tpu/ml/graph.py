@@ -81,19 +81,50 @@ class Graph:
                 A[index[u], index[v]] = 1.0
         return A, indexmap
 
+    def adjacency_sparse(self, dtype=np.float32):
+        """Sparse (CSC) adjacency + index map — the scalable operand for
+        spectral embedding (the reference reads arc-lists into a
+        sparse_vc_star matrix and never densifies,
+        ref: utility/io/arc_list.hpp + ml/skylark_graph_se.cpp)."""
+        from libskylark_tpu.base.sparse import SparseMatrix
+
+        indexmap = self.vertices
+        index = {v: i for i, v in enumerate(indexmap)}
+        rows, cols = [], []
+        for u, nbrs in self._adj.items():
+            for v in nbrs:
+                rows.append(index[u])
+                cols.append(index[v])
+        n = len(indexmap)
+        vals = np.ones(len(rows), dtype)
+        return SparseMatrix.from_coo(
+            np.asarray(rows, np.int64), np.asarray(cols, np.int64),
+            vals, (n, n)
+        ), indexmap
+
 
 def approximate_ase(
     G: Graph,
     k: int,
     context: Context,
     params: Optional[ApproximateSVDParams] = None,
+    sparse: Optional[bool] = None,
 ):
     """Approximate Adjacency Spectral Embedding (Lyzinski et al.;
     ref: ml/graph/spectral_embedding.hpp:19-94): X = V·√|Λ| from the
     randomized symmetric eigendecomposition of the adjacency matrix.
-    Returns (X, indexmap) with X (n, k) on device."""
-    A, indexmap = G.adjacency_matrix()
-    V, w = approximate_symmetric_svd(jnp.asarray(A), k, context, params)
+    Returns (X, indexmap) with X (n, k) on device.
+
+    ``sparse``: operate on the CSC adjacency without densifying (default:
+    automatically for graphs past 2048 vertices)."""
+    if sparse is None:
+        sparse = len(G.vertices) > 2048
+    if sparse:
+        A, indexmap = G.adjacency_sparse()
+    else:
+        Ad, indexmap = G.adjacency_matrix()
+        A = jnp.asarray(Ad)
+    V, w = approximate_symmetric_svd(A, k, context, params)
     X = V * jnp.sqrt(jnp.abs(w))[None, :]
     return X, indexmap
 

@@ -39,31 +39,33 @@ from libskylark_tpu.sketch.transform import SketchTransform, register
 BLOCK_COLS = 256
 
 
+def pallas_ambient_ok(A) -> bool:
+    """True when the fused kernel may run on ``A`` in the ambient context:
+    use_pallas is on AND the array is single-device. Sharded applies keep
+    the XLA path (its partitioning XLA handles); on a tracer the sharding
+    is unreadable, so traced applies qualify only when the backend has a
+    single device and sharding is impossible (the multi-device kernel
+    route is the explicit shard_map pipeline, parallel/shard_apply.py)."""
+    if not sketch_params.get_use_pallas():
+        return False
+    import jax
+
+    if isinstance(A, jax.core.Tracer):
+        return len(jax.devices()) == 1
+    if isinstance(A, jax.Array):
+        try:
+            return len(A.sharding.device_set) == 1
+        except Exception:
+            return False
+    return False
+
+
 def try_pallas_apply(key, dist, A, s_dim: int, scale: float, which: str):
     """Fused generation+matmul TPU kernel (sketch/pallas_dense.py) for any
     virtual operator in the dense-block stream format — the dense
     transforms and the RFT frequency matrices share this dispatch.
-
-    Returns None when the backend/input don't qualify. Sharded applies
-    keep the XLA path (its partitioning XLA handles); on a tracer the
-    sharding is unreadable, so traced applies use the kernel only when
-    the backend has a single device and sharding is impossible (the
-    multi-device kernel route is the explicit shard_map pipeline,
-    parallel/shard_apply.py)."""
-    if not sketch_params.get_use_pallas():
-        return None
-    import jax
-
-    if isinstance(A, jax.core.Tracer):
-        if len(jax.devices()) != 1:
-            return None
-    elif isinstance(A, jax.Array):
-        try:
-            if len(A.sharding.device_set) != 1:
-                return None
-        except Exception:
-            return None
-    else:
+    Returns None when the backend/input don't qualify."""
+    if not pallas_ambient_ok(A):
         return None
     from libskylark_tpu.sketch import pallas_dense
 

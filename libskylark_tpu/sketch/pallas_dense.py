@@ -135,13 +135,38 @@ def _resolve_block(dist_kind, s_dim, keys_ref, k, s_scr):
 
 
 def _kernel(dist_kind, s_dim, m_tile, precision, keys_ref, a_ref, out_ref,
-            s_scr=None):
+            s_scr=None, *, epilogue=None, n_blocks=None):
     """Rowwise: out_tile += A_tile @ S_blkᵀ (S entries are bit-exact; only
-    the contraction rounds, per the ``precision`` regime)."""
+    the contraction rounds, per the ``precision`` regime).
+
+    Optional fused epilogue, applied in VMEM after the LAST operator
+    block accumulates — the output never makes the extra HBM round-trip a
+    separate elementwise op would cost. ``epilogue("cos", inscale,
+    outscale)`` finishes the tile as ``outscale·cos(acc·inscale·sc + sh)``
+    (the random-Fourier featurization; ref: RFT_Elemental.hpp:83-156, the
+    reference's fused elementwise loops) with sc/sh (1, s_dim) VMEM refs
+    threaded by the caller."""
     k = pl.program_id(1)
     S_blk = _resolve_block(dist_kind, s_dim, keys_ref, k, s_scr)
     acc = _dot(a_ref[:], S_blk, (((1,), (1,)), ((), ())), precision)
     _accumulate(out_ref, acc, k)
+    if epilogue is not None:
+        kind, inscale, outscale, sc_ref, sh_ref = epilogue
+        assert kind == "cos"
+
+        @pl.when(k == n_blocks - 1)
+        def _epilogue():
+            z = out_ref[:] * inscale * sc_ref[:] + sh_ref[:]
+            out_ref[:] = outscale * jnp.cos(z)
+
+
+def _kernel_cos(dist_kind, s_dim, m_tile, n_blocks, precision, inscale,
+                outscale, keys_ref, a_ref, sc_ref, sh_ref, out_ref,
+                s_scr=None):
+    """Rowwise + cos featurization (see _kernel's epilogue doc)."""
+    _kernel(dist_kind, s_dim, m_tile, precision, keys_ref, a_ref, out_ref,
+            s_scr, epilogue=("cos", inscale, outscale, sc_ref, sh_ref),
+            n_blocks=n_blocks)
 
 
 def _kernel_cw(dist_kind, s_dim, m_tile, precision, keys_ref, a_ref, out_ref,
@@ -206,6 +231,44 @@ def _fused_call(A, keys, *, s_dim, dist_kind, m_tile, precision="f32",
         compiler_params=_grid_params(scratch),
         interpret=interpret,
     )(keys, A)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("s_dim", "dist_kind", "m_tile", "precision",
+                     "inscale", "outscale", "interpret"),
+)
+def _fused_call_cos(A, keys, sc, sh, *, s_dim, dist_kind, m_tile,
+                    precision="f32", inscale=1.0, outscale=1.0,
+                    interpret=False):
+    m, n = A.shape
+    n_blocks = n // BLOCK_COLS
+    grid = (m // m_tile, n_blocks)
+    scratch = _scratch(s_dim, n, m, m_tile)
+    kern = functools.partial(_kernel_cos, dist_kind, s_dim, m_tile,
+                             n_blocks, precision, inscale, outscale)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (m_tile, BLOCK_COLS), lambda i, k: (i, k),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((1, s_dim), lambda i, k: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s_dim), lambda i, k: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (m_tile, s_dim), lambda i, k: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((m, s_dim), jnp.float32),
+        scratch_shapes=scratch,
+        compiler_params=_grid_params(scratch),
+        interpret=interpret,
+    )(keys, A, sc, sh)
 
 
 @functools.partial(
@@ -357,6 +420,43 @@ def columnwise_apply(
     except jax.errors.JaxRuntimeError:
         return None
     return scale * out[:, :m]
+
+
+def rft_rowwise_apply(
+    key: jax.Array,
+    dist,
+    A: jnp.ndarray,
+    s_dim: int,
+    inscale: float,
+    outscale: float,
+    sc: jnp.ndarray,
+    sh: jnp.ndarray,
+    m_tile: int = 256,
+    precision: str | None = None,
+    interpret: bool = False,
+) -> Optional[jnp.ndarray]:
+    """Fused random-Fourier-feature rowwise apply:
+    ``outscale · cos((A @ (inscale·S)ᵀ) ⊙ sc + sh)`` with the cos
+    epilogue applied in VMEM (no extra HBM round-trip of the feature
+    matrix). ``sc``/``sh`` are (s_dim,) per-feature scales/shifts.
+    Returns None when not applicable."""
+    mt = _qualify(dist, A, seq_axis=1, m_tile=m_tile, interpret=interpret)
+    if mt is None:
+        return None
+    m = A.shape[0]
+    Ap = _padded(A, seq_axis=1, mt=mt)
+    try:
+        out = _fused_call_cos(
+            Ap, _block_keys(key, A.shape[1]),
+            jnp.asarray(sc, jnp.float32).reshape(1, s_dim),
+            jnp.asarray(sh, jnp.float32).reshape(1, s_dim),
+            s_dim=s_dim, dist_kind=_DIST_KINDS[type(dist)], m_tile=mt,
+            precision=precision or _default_precision(),
+            inscale=float(inscale), outscale=float(outscale),
+            interpret=interpret)
+    except jax.errors.JaxRuntimeError:
+        return None
+    return out[:m]
 
 
 def _default_precision() -> str:

@@ -211,7 +211,7 @@ def test_transpose(mesh2d):
     )
 
 
-def test_approximate_svd_on_dist_sparse(mesh2d, mesh1d):
+def test_approximate_svd_on_dist_sparse(mesh2d):
     """Randomized SVD on sparse operands without densifying (the
     reference's sparse branch, ref: nla/skylark_svd.cpp:129-215) — local
     SparseMatrix and DistSparseMatrix must both track the dense result."""

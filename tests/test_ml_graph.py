@@ -56,6 +56,20 @@ class TestApproximateASE:
         agree = (side == labels).mean()
         assert agree > 0.9 or agree < 0.1
 
+    def test_sparse_operand_matches_dense(self):
+        """The sparse-adjacency path (no densification) equals the dense
+        path at the same seed — same randomized algorithm, same streams."""
+        G = _two_blocks()
+        p = ApproximateSVDParams(num_iterations=3)
+        Xd, idxd = ml.approximate_ase(G, 2, Context(seed=5), p,
+                                      sparse=False)
+        Xs, idxs = ml.approximate_ase(G, 2, Context(seed=5), p,
+                                      sparse=True)
+        assert idxd == idxs
+        np.testing.assert_allclose(
+            np.asarray(Xs), np.asarray(Xd), atol=1e-3, rtol=1e-3
+        )
+
 
 class TestTimeDependentPPR:
     def test_localized_and_seeded(self):

@@ -221,6 +221,31 @@ def test_try_pallas_interpret_consistency_via_transform():
     )
 
 
+@pytest.mark.parametrize("shape", [(24, 512), (13, 300)])
+def test_rft_fully_fused_epilogue(shape):
+    """Generation + matmul + cos epilogue in ONE kernel must equal the
+    production apply (XLA path) — incl. ragged shapes. Normal-frequency
+    transforms only: Cauchy frequencies (Laplacian) give heavy-tailed
+    phases where f32 cos is ill-conditioned, so the fused path is gated
+    off for them (rft.py _try_fused_rowwise)."""
+    from libskylark_tpu.sketch.rft import GaussianRFT
+
+    m, n = shape
+    s = 64
+    T = GaussianRFT(n, s, Context(seed=14), sigma=2.0)
+    A = jnp.asarray(
+        np.random.default_rng(8).standard_normal((m, n)), jnp.float32
+    )
+    want = np.asarray(T.apply(A, ROWWISE))      # XLA path (fixture)
+    got = pd.rft_rowwise_apply(
+        T.subkey(0), T.dist, A, s, T.inscale, T.outscale,
+        np.asarray(T.row_scales()), np.asarray(T.shifts()),
+        precision="f32", interpret=True,
+    )
+    assert got is not None
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4, rtol=1e-4)
+
+
 def test_rft_projection_rides_the_kernel():
     """The RFT frequency matrix shares the dense-block stream format, so
     the fused kernel path (interpret) must equal the XLA w_panel path

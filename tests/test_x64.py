@@ -1,0 +1,76 @@
+"""float64 capability smoke tests.
+
+The reference computes in double precision throughout; the TPU-native
+policy is f32 on device with f64 available under jax x64 (SURVEY.md §7
+"f64 policy", base/precision.py). These tests prove the f64 paths exist
+and keep the determinism oracle: within x64, sharded apply == local
+apply, and the solver stack runs at f64 accuracy.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from libskylark_tpu.base.context import Context
+
+
+@pytest.fixture()
+def x64():
+    with jax.enable_x64():
+        yield
+
+
+def test_jlt_f64_sharded_oracle(x64, mesh1d):
+    from libskylark_tpu import sketch as sk
+    from libskylark_tpu.sketch import params as sketch_params
+
+    sketch_params.set_use_pallas(False)  # kernel is f32-only by design
+    try:
+        N, S, m = 512, 64, 24
+        rng = np.random.default_rng(0)
+        A = jnp.asarray(rng.standard_normal((m, N)), jnp.float64)
+        T = sk.JLT(N, S, Context(seed=3))
+        local = T.apply(A, sk.ROWWISE)
+        assert local.dtype == jnp.float64
+        Ad = jax.device_put(A, NamedSharding(mesh1d, P(None, "rows")))
+        shard = T.apply(Ad, sk.ROWWISE)
+        np.testing.assert_allclose(
+            np.asarray(shard), np.asarray(local), atol=1e-12
+        )
+    finally:
+        sketch_params.set_use_pallas(True)
+
+
+def test_lsqr_f64_accuracy(x64):
+    """LSQR at f64 reaches residuals far below f32's floor — the
+    capability the reference's double-precision stack provides."""
+    from libskylark_tpu.algorithms.krylov import KrylovParams, lsqr
+
+    rng = np.random.default_rng(1)
+    m, n = 120, 30
+    A = jnp.asarray(rng.standard_normal((m, n)), jnp.float64)
+    x_true = jnp.asarray(rng.standard_normal(n), jnp.float64)
+    b = A @ x_true
+    x, _ = lsqr(A, b, KrylovParams(tolerance=1e-14, iter_lim=500))
+    assert x.dtype == jnp.float64
+    rel = float(jnp.linalg.norm(x - x_true) / jnp.linalg.norm(x_true))
+    assert rel < 1e-8, rel
+
+
+def test_sparse_f64_products(x64):
+    import scipy.sparse as sp
+
+    from libskylark_tpu.base.sparse import SparseMatrix, spmm
+
+    A = sp.random(40, 30, density=0.2, random_state=0, dtype=np.float64)
+    S = SparseMatrix.from_scipy(A.tocsc())
+    B = np.random.default_rng(2).standard_normal((30, 4))
+    # explicit f64 request keeps f64 on device under x64
+    r, c, v = S.coo(dtype=jnp.float64)
+    assert v.dtype == jnp.float64
+    out = spmm(S, jnp.asarray(B, jnp.float64))
+    np.testing.assert_allclose(
+        np.asarray(out), A.toarray() @ B, atol=1e-12
+    )
