@@ -71,15 +71,11 @@ class Graph:
 
     def adjacency_matrix(self, dtype=np.float32):
         """Dense adjacency + index map (ref: GraphType::adjacency_matrix).
-        Returns (A, indexmap) where indexmap[i] is the vertex of row i."""
-        indexmap = self.vertices
-        index = {v: i for i, v in enumerate(indexmap)}
-        n = len(indexmap)
-        A = np.zeros((n, n), dtype)
-        for u, nbrs in self._adj.items():
-            for v in nbrs:
-                A[index[u], index[v]] = 1.0
-        return A, indexmap
+        Returns (A, indexmap) where indexmap[i] is the vertex of row i —
+        the densified :meth:`adjacency_sparse` (one edge walk, one
+        ordering contract)."""
+        S, indexmap = self.adjacency_sparse(dtype)
+        return S.to_scipy().toarray(), indexmap
 
     def adjacency_sparse(self, dtype=np.float32):
         """Sparse (CSC) adjacency + index map — the scalable operand for

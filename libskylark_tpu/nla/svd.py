@@ -27,12 +27,30 @@ from libskylark_tpu.base.precision import with_solver_precision
 @dataclasses.dataclass
 class ApproximateSVDParams(Params):
     """ref: nla/svd.hpp:24-52 (defaults oversampling_ratio=2, additive=0,
-    num_iterations=0, skip_qr=False; JSON-loadable)."""
+    num_iterations=0, skip_qr=False; JSON-loadable).
+
+    ``ortho`` selects the panel orthogonalization: "qr" (Householder — the
+    reference's El::qr) or "cqr2" (CholeskyQR2, nla/tsqr.py — the
+    mesh-native choice: local gemm + one psum + triangular solve, all
+    MXU work; accurate for cond(panel) ≲ 1/√ε)."""
 
     oversampling_ratio: float = 2.0
     oversampling_additive: int = 0
     num_iterations: int = 0
     skip_qr: bool = False
+    ortho: str = "qr"
+
+
+def _orthonormalize(Q: jnp.ndarray, method: str) -> jnp.ndarray:
+    if method == "cqr2":
+        from libskylark_tpu.nla.tsqr import cholesky_qr2
+
+        return cholesky_qr2(Q)[0]
+    if method != "qr":
+        raise errors.InvalidParametersError(
+            f"ortho must be 'qr' or 'cqr2', got {method!r}"
+        )
+    return jnp.linalg.qr(Q)[0]
 
 
 def _as_linear_ops(A):
@@ -68,11 +86,13 @@ def power_iteration(
     num_iterations: int,
     orthogonalize: bool = True,
     adjoint: bool = False,
+    ortho: str = "qr",
 ) -> jnp.ndarray:
-    """(A·Aᵀ)^q · Q (or (Aᵀ·A)^q · Q when ``adjoint``) with QR
+    """(A·Aᵀ)^q · Q (or (Aᵀ·A)^q · Q when ``adjoint``) with
     re-orthogonalization between products unless disabled
     (ref: nla/svd.hpp:76-153 — the four orientation combos). ``A`` may be
-    dense, sparse, or distributed sparse."""
+    dense, sparse, or distributed sparse; ``ortho`` as in
+    :class:`ApproximateSVDParams`."""
     mv, rmv, _ = _as_linear_ops(A)
     for _ in range(num_iterations):
         if adjoint:
@@ -80,7 +100,7 @@ def power_iteration(
         else:
             Q = mv(rmv(Q))
         if orthogonalize:
-            Q, _ = jnp.linalg.qr(Q)
+            Q = _orthonormalize(Q, ortho)
     return Q
 
 
@@ -132,12 +152,13 @@ def approximate_svd(
     T = sk.JLT(n, kp, context)
     Q = T.apply(A, sk.ROWWISE)  # (m, kp)
     if not params.skip_qr:
-        Q, _ = jnp.linalg.qr(Q)
+        Q = _orthonormalize(Q, params.ortho)
     Q = power_iteration(A, Q, params.num_iterations,
-                        orthogonalize=not params.skip_qr)
+                        orthogonalize=not params.skip_qr,
+                        ortho=params.ortho)
     if params.skip_qr:
         # One final orthogonalization is always required before projection.
-        Q, _ = jnp.linalg.qr(Q)
+        Q = _orthonormalize(Q, params.ortho)
 
     # Rayleigh-Ritz on the range: B = Qᵀ·A = (Aᵀ·Q)ᵀ, small SVD, rotate
     # back (ref: nla/svd.hpp:283-290).
@@ -175,13 +196,13 @@ def approximate_symmetric_svd(
 
     T = sk.JLT(n, kp, context)
     Q = T.apply(A, sk.ROWWISE)  # (n, kp) Gaussian range sketch
-    Q, _ = jnp.linalg.qr(Q)
+    Q = _orthonormalize(Q, params.ortho)
     for _ in range(params.num_iterations):
         Q = mv(Q)
         if not params.skip_qr:
-            Q, _ = jnp.linalg.qr(Q)
+            Q = _orthonormalize(Q, params.ortho)
     if params.skip_qr:
-        Q, _ = jnp.linalg.qr(Q)
+        Q = _orthonormalize(Q, params.ortho)
 
     # Rayleigh-Ritz: eigendecomposition of QᵀAQ (ref: nla/svd.hpp:175-225).
     G = Q.T @ mv(Q)

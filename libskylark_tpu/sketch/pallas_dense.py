@@ -201,17 +201,16 @@ def _grid_params(scratch):
     )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("s_dim", "dist_kind", "m_tile", "precision", "interpret"),
-)
-def _fused_call(A, keys, *, s_dim, dist_kind, m_tile, precision="f32",
-                interpret=False):
+def _rowwise_pallas_call(A, keys, extra_operands, kern, *, s_dim, m_tile,
+                         interpret):
+    """Shared rowwise pallas_call plumbing: grid, key-table SMEM spec,
+    A-tile spec, accumulator out spec, operator scratch, compiler params.
+    ``extra_operands`` are (1, s_dim) VMEM vectors threaded to the kernel
+    between a_ref and out_ref (epilogue operands)."""
     m, n = A.shape
     n_blocks = n // BLOCK_COLS
     grid = (m // m_tile, n_blocks)
     scratch = _scratch(s_dim, n, m, m_tile)
-    kern = functools.partial(_kernel, dist_kind, s_dim, m_tile, precision)
     return pl.pallas_call(
         kern,
         grid=grid,
@@ -222,6 +221,10 @@ def _fused_call(A, keys, *, s_dim, dist_kind, m_tile, precision="f32",
                 (m_tile, BLOCK_COLS), lambda i, k: (i, k),
                 memory_space=pltpu.VMEM,
             ),
+        ] + [
+            pl.BlockSpec((1, s_dim), lambda i, k: (0, 0),
+                         memory_space=pltpu.VMEM)
+            for _ in extra_operands
         ],
         out_specs=pl.BlockSpec(
             (m_tile, s_dim), lambda i, k: (i, 0), memory_space=pltpu.VMEM
@@ -230,7 +233,18 @@ def _fused_call(A, keys, *, s_dim, dist_kind, m_tile, precision="f32",
         scratch_shapes=scratch,
         compiler_params=_grid_params(scratch),
         interpret=interpret,
-    )(keys, A)
+    )(keys, A, *extra_operands)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("s_dim", "dist_kind", "m_tile", "precision", "interpret"),
+)
+def _fused_call(A, keys, *, s_dim, dist_kind, m_tile, precision="f32",
+                interpret=False):
+    kern = functools.partial(_kernel, dist_kind, s_dim, m_tile, precision)
+    return _rowwise_pallas_call(A, keys, (), kern, s_dim=s_dim,
+                                m_tile=m_tile, interpret=interpret)
 
 
 @functools.partial(
@@ -241,34 +255,11 @@ def _fused_call(A, keys, *, s_dim, dist_kind, m_tile, precision="f32",
 def _fused_call_cos(A, keys, sc, sh, *, s_dim, dist_kind, m_tile,
                     precision="f32", inscale=1.0, outscale=1.0,
                     interpret=False):
-    m, n = A.shape
-    n_blocks = n // BLOCK_COLS
-    grid = (m // m_tile, n_blocks)
-    scratch = _scratch(s_dim, n, m, m_tile)
+    n_blocks = A.shape[1] // BLOCK_COLS
     kern = functools.partial(_kernel_cos, dist_kind, s_dim, m_tile,
                              n_blocks, precision, inscale, outscale)
-    return pl.pallas_call(
-        kern,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(
-                (m_tile, BLOCK_COLS), lambda i, k: (i, k),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec((1, s_dim), lambda i, k: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s_dim), lambda i, k: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec(
-            (m_tile, s_dim), lambda i, k: (i, 0), memory_space=pltpu.VMEM
-        ),
-        out_shape=jax.ShapeDtypeStruct((m, s_dim), jnp.float32),
-        scratch_shapes=scratch,
-        compiler_params=_grid_params(scratch),
-        interpret=interpret,
-    )(keys, A, sc, sh)
+    return _rowwise_pallas_call(A, keys, (sc, sh), kern, s_dim=s_dim,
+                                m_tile=m_tile, interpret=interpret)
 
 
 @functools.partial(
