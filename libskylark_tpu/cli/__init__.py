@@ -36,3 +36,23 @@ def read_dataset(path: str, fileformat: int, min_d: int = 0):
 def write_ascii_matrix(path: str, M, digits: int = 8) -> None:
     """El::Write(..., El::ASCII) equivalent (ref: nla/skylark_svd.cpp:110)."""
     np.savetxt(path, np.asarray(M), fmt=f"%.{digits}g")
+
+
+def add_streaming_args(p) -> None:
+    """Shared --streaming/--batch-rows flags (bounded-memory sharded
+    ingestion; the HDFS-reader analog) for the libsvm-reading CLIs."""
+    p.add_argument("--streaming", action="store_true",
+                   help="stream the (dense libsvm) file into sharded "
+                   "device memory in bounded host memory")
+    p.add_argument("--batch-rows", type=int, default=65536,
+                   help="rows per streamed batch with --streaming")
+
+
+def read_streaming(path: str, batch_rows: int):
+    """Stream ``path`` into a row-sharded device array over the default
+    1D mesh (see io.read_libsvm_sharded)."""
+    import libskylark_tpu.io as skio
+    from libskylark_tpu.parallel import make_mesh
+
+    return skio.read_libsvm_sharded(path, make_mesh(),
+                                    batch_rows=batch_rows)

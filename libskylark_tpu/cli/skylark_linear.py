@@ -27,6 +27,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="kept for command-line parity (f32 is the default)")
     p.add_argument("--prefix", default="out",
                    help="solution written to prefix.x.txt")
+    from libskylark_tpu.cli import add_streaming_args
+
+    add_streaming_args(p)
     return p
 
 
@@ -43,8 +46,17 @@ def main(argv=None) -> int:
     )
 
     t0 = time.time()
-    reader = skio.read_dir_libsvm if args.directory else skio.read_libsvm
-    X, Y = reader(args.inputfile)
+    if args.streaming:
+        if args.directory:
+            print("error: --streaming reads a single libsvm file",
+                  file=sys.stderr)
+            return 2
+        from libskylark_tpu.cli import read_streaming
+
+        X, Y = read_streaming(args.inputfile, args.batch_rows)
+    else:
+        reader = skio.read_dir_libsvm if args.directory else skio.read_libsvm
+        X, Y = reader(args.inputfile)
     print(f"Reading the matrix... took {time.time() - t0:.2e} sec")
 
     context = Context(seed=args.seed)

@@ -54,6 +54,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="0 libsvm-dense, 1 libsvm-sparse, 2 hdf5-dense, "
                    "3 hdf5-sparse")
     p.add_argument("-i", "--MAXITER", type=int, default=10)
+    from libskylark_tpu.cli import add_streaming_args
+
+    add_streaming_args(p)
     p.add_argument("--modelfile", default="")
     p.add_argument("--valfile", default="")
     p.add_argument("--testfile", default="")
@@ -114,7 +117,16 @@ def _train(args) -> int:
         print("error: modelfile required", file=sys.stderr)
         return 2
 
-    X, Y = read_dataset(args.trainfile, args.fileformat)
+    if args.streaming:
+        if args.fileformat != 0:
+            print("error: --streaming supports fileformat 0 (libsvm-dense)",
+                  file=sys.stderr)
+            return 2
+        from libskylark_tpu.cli import read_streaming
+
+        X, Y = read_streaming(args.trainfile, args.batch_rows)
+    else:
+        X, Y = read_dataset(args.trainfile, args.fileformat)
     d = X.shape[1]
     context = Context(seed=args.seed)
     loss = _make_loss(args)

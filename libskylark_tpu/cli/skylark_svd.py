@@ -37,12 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--single", action="store_true",
                    help="single precision (f32 is the TPU-native default; "
                    "flag kept for command-line parity)")
-    p.add_argument("--streaming", action="store_true",
-                   help="stream the file into sharded device memory in "
-                   "bounded host memory (the HDFS-reader analog; dense "
-                   "libsvm input only)")
-    p.add_argument("--batch-rows", type=int, default=65536,
-                   help="rows per streamed batch with --streaming")
+    from libskylark_tpu.cli import add_streaming_args
+
+    add_streaming_args(p)
     p.add_argument("--profile", nargs=2, type=int, metavar=("H", "W"),
                    help="generate a random HxW matrix and run on it")
     p.add_argument("--prefix", default="out")
@@ -86,10 +83,9 @@ def main(argv=None) -> int:
         X, _ = skio.read_dir_libsvm(args.inputfile, sparse=args.sparse)
         A = X if args.sparse else jnp.asarray(X)
     elif args.streaming:
-        from libskylark_tpu.parallel import make_mesh
+        from libskylark_tpu.cli import read_streaming
 
-        A, _ = skio.read_libsvm_sharded(
-            args.inputfile, make_mesh(), batch_rows=args.batch_rows)
+        A, _ = read_streaming(args.inputfile, args.batch_rows)
     else:
         X, _ = skio.read_libsvm(args.inputfile, sparse=args.sparse)
         A = X if args.sparse else jnp.asarray(X)

@@ -172,19 +172,21 @@ def hash_apply_sparse(T, D: DistSparseMatrix, columnwise: bool = True
 
 
 def _cell_panel(T, block_start, width: int, dtype):
-    """S[:, block_start*1 .. +width) with a *traced* start column.
+    """S[:, block_start .. +width) with a *traced* start column.
 
     Generates the static number of BLOCK_COLS blocks covering any
-    alignment, then dynamic-slices — each device materializes only its
-    own (S_dim × width(+BC)) window of the virtual operator."""
+    alignment (one vmapped generator call — a single traced kernel, not
+    nb unrolled ones), then dynamic-slices — each device materializes
+    only its own (S_dim × width(+BC)) window of the virtual operator."""
     from libskylark_tpu.sketch.dense import BLOCK_COLS
 
     nb = -(-width // BLOCK_COLS) + 1
     first = block_start // BLOCK_COLS
     off = block_start % BLOCK_COLS
-    panel = jnp.concatenate(
-        [T.s_block(first + b, dtype) for b in range(nb)], axis=1
-    )
+    blocks = jax.vmap(
+        lambda b: T.s_block(b, dtype)
+    )(first + jnp.arange(nb, dtype=jnp.int32))        # (nb, s_dim, BC)
+    panel = blocks.transpose(1, 0, 2).reshape(T.sketch_dim, nb * BLOCK_COLS)
     return lax.dynamic_slice(
         panel, (0, off), (T.sketch_dim, width)
     )

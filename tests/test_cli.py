@@ -106,6 +106,17 @@ class TestLinearCli:
         x = np.loadtxt(prefix + ".x.txt")
         assert np.linalg.norm(x - w) / np.linalg.norm(w) < 0.2
 
+    def test_streaming_matches_whole_file(self, regression_file, tmp_path):
+        path, X, y = regression_file
+        p1, p2 = str(tmp_path / "a"), str(tmp_path / "b")
+        assert skylark_linear.main([path, "-p", "--prefix", p1]) == 0
+        assert skylark_linear.main(
+            [path, "-p", "--prefix", p2,
+             "--streaming", "--batch-rows", "9"]) == 0
+        np.testing.assert_allclose(
+            np.loadtxt(p2 + ".x.txt"), np.loadtxt(p1 + ".x.txt"),
+            atol=1e-3, rtol=1e-3)
+
     def test_highprecision(self, regression_file, tmp_path):
         path, X, w = regression_file
         prefix = str(tmp_path / "linhp")
@@ -127,6 +138,24 @@ class TestMLCli:
         rc = skylark_ml.main(["--testfile", classification_file,
                               "--modelfile", model])
         assert rc == 0
+
+    def test_train_streaming_matches_whole_file(self, classification_file,
+                                                tmp_path):
+        """--streaming ingestion trains to the same model as the
+        whole-file read (same seed, same streams)."""
+        path = classification_file
+        m1 = str(tmp_path / "m1.json")
+        m2 = str(tmp_path / "m2.json")
+        common = [path, "-k", "1", "-g", "3.0", "-f", "64", "-i", "4",
+                  "-c", "0.01", "-l", "2", "-r", "1"]
+        assert skylark_ml.main(common + [m1]) == 0
+        assert skylark_ml.main(
+            common + [m2, "--streaming", "--batch-rows", "13"]) == 0
+        from libskylark_tpu.ml.model import HilbertModel
+
+        c1 = np.asarray(HilbertModel.load(m1).coef)
+        c2 = np.asarray(HilbertModel.load(m2).coef)
+        np.testing.assert_allclose(c2, c1, atol=1e-3, rtol=1e-3)
 
     def test_train_regression_linear(self, regression_file, tmp_path):
         path, _, _ = regression_file
