@@ -167,6 +167,75 @@ def hash_apply_sparse(T, D: DistSparseMatrix, columnwise: bool = True
 
 
 # ---------------------------------------------------------------------------
+# UST (row/column sampling) — per-cell one-hot selection + psum
+# ---------------------------------------------------------------------------
+
+
+def ust_columnwise(T, D: DistSparseMatrix) -> jax.Array:
+    """S·A = A[idx, :] for A (N, w) distributed sparse → (S_dim, w)
+    dense, sharded on ``col_axis``. Each cell scatters the nonzeros whose
+    global row is sampled into the output slots (handles
+    with-replacement duplicates: every slot t with idx[t] == r receives
+    row r)."""
+    _check_dim(T, D, columnwise=True)
+    idx = T.sample_indices()                      # (S_dim,) global rows
+    s_dim, bs_r, bs_c = T.sketch_dim, D.bs_r, D.bs_c
+    row_axis, col_axis = D.row_axis, D.col_axis
+
+    # out[t, c] = Σ_j sel[t, j] · v[j] · [lc[j] == c]
+    def local(lr, lc, v, idx):
+        lr_, lc_, v_ = lr[0, 0], lc[0, 0], v[0, 0]
+        rb = lax.axis_index(row_axis) if row_axis else 0
+        g = rb * bs_r + lr_
+        sel = (idx[:, None] == g[None, :]).astype(v_.dtype)  # (s, pad)
+        weighted = sel * v_[None, :]
+        part = jax.ops.segment_sum(
+            weighted.T, lc_, num_segments=bs_c
+        ).T                                        # (s, bs_c)
+        if row_axis:
+            part = lax.psum(part, row_axis)
+        return part[None]
+
+    out = shard_map(
+        local,
+        mesh=D.mesh,
+        in_specs=(D._triplet_spec(),) * 3 + (P(),),
+        out_specs=P(col_axis, None, None),
+    )(D.lr, D.lc, D.v, idx)
+    return out.transpose(1, 0, 2).reshape(s_dim, D.pc * bs_c)[:, : D.width]
+
+
+def ust_rowwise(T, D: DistSparseMatrix) -> jax.Array:
+    """A·Sᵀ = A[:, idx] for A (m, N) distributed sparse → (m, S_dim)
+    dense, sharded on ``row_axis``."""
+    _check_dim(T, D, columnwise=False)
+    idx = T.sample_indices()
+    s_dim, bs_r, bs_c = T.sketch_dim, D.bs_r, D.bs_c
+    row_axis, col_axis = D.row_axis, D.col_axis
+
+    def local(lr, lc, v, idx):
+        lr_, lc_, v_ = lr[0, 0], lc[0, 0], v[0, 0]
+        cb = lax.axis_index(col_axis) if col_axis else 0
+        g = cb * bs_c + lc_
+        sel = (g[:, None] == idx[None, :]).astype(v_.dtype)  # (pad, s)
+        weighted = sel * v_[:, None]
+        part = jax.ops.segment_sum(
+            weighted, lr_, num_segments=bs_r
+        )                                          # (bs_r, s)
+        if col_axis:
+            part = lax.psum(part, col_axis)
+        return part[None]
+
+    out = shard_map(
+        local,
+        mesh=D.mesh,
+        in_specs=(D._triplet_spec(),) * 3 + (P(),),
+        out_specs=P(row_axis, None, None),
+    )(D.lr, D.lc, D.v, idx)
+    return out.reshape(D.pr * bs_r, s_dim)[: D.height]
+
+
+# ---------------------------------------------------------------------------
 # dense transforms (JLT / CT) — virtual-operator panels per cell
 # ---------------------------------------------------------------------------
 

@@ -46,6 +46,14 @@ class RFT(SketchTransform):
             self.subkey(0), self.dist, self._S, col_start, col_stop, BLOCK_COLS, dtype
         )
 
+    def s_block(self, block_id, dtype=jnp.float32) -> jnp.ndarray:
+        """Column block of W (traced id ok) — the DenseTransform block
+        protocol, so the distributed-sparse panel machinery
+        (sketch/dist_sparse_apply.py) applies to frequency matrices too."""
+        return self.inscale * randgen.dense_block(
+            self.subkey(0), self.dist, self._S, block_id, BLOCK_COLS, dtype
+        )
+
     def shifts(self, dtype=jnp.float32) -> jnp.ndarray:
         return randgen.stream_slice(
             self.subkey(1), randgen.Uniform(0.0, 2.0 * math.pi), 0, self._S,
@@ -139,6 +147,21 @@ class RFT(SketchTransform):
 
         W = self.w_panel(0, self._N, A.device_dtype)
         return self._featurize(spmm(A, W.T), feature_axis=1)
+
+    # -- distributed sparse input: project with the per-cell virtual
+    # panel machinery, then featurize (ref: the mixed sparse-input
+    # RFT specializations, sketch/RFT.hpp dispatch) --
+
+    def _apply_columnwise_dist_sparse(self, A) -> jnp.ndarray:
+        from libskylark_tpu.sketch import dist_sparse_apply as dsa
+
+        return self._featurize(dsa.dense_columnwise(self, A),
+                               feature_axis=0)
+
+    def _apply_rowwise_dist_sparse(self, A) -> jnp.ndarray:
+        from libskylark_tpu.sketch import dist_sparse_apply as dsa
+
+        return self._featurize(dsa.dense_rowwise(self, A), feature_axis=1)
 
 
 @register

@@ -63,6 +63,21 @@ class UST(SketchTransform):
             A.to_scipy()[:, idx].toarray().astype(A.device_dtype)
         )
 
+    # -- distributed sparse input: per-cell one-hot selection + psum
+    # (the redistribute-then-sample pattern of ref:
+    # sketch/UST_Elemental.hpp:144-174, without the redistribution —
+    # each cell contributes the sampled slice of its own rows/cols) --
+
+    def _apply_columnwise_dist_sparse(self, A) -> jnp.ndarray:
+        from libskylark_tpu.sketch import dist_sparse_apply as dsa
+
+        return dsa.ust_columnwise(self, A)
+
+    def _apply_rowwise_dist_sparse(self, A) -> jnp.ndarray:
+        from libskylark_tpu.sketch import dist_sparse_apply as dsa
+
+        return dsa.ust_rowwise(self, A)
+
     def _extra_params(self) -> dict[str, Any]:
         return {"replace": self._replace}
 

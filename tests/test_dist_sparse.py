@@ -203,6 +203,55 @@ def test_hash_sparse_to_sparse_dist(cw, mesh1d, mesh2d, devices):
         )
 
 
+@pytest.mark.parametrize("replace", [True, False], ids=["with", "without"])
+def test_ust_dist_oracle(replace, mesh1d, mesh2d, devices):
+    """Row/col sampling of a distributed sparse matrix == local gather
+    (incl. with-replacement duplicate slots)."""
+    from libskylark_tpu.sketch import UST
+
+    n, w, s = 100, 37, 24
+    A = _rand_sparse(n, w, seed=21)
+    Ar = _rand_sparse(w, n, seed=22)
+    mesh5 = par.make_mesh(devices=devices[:5])
+    for mesh, axes in _grids(mesh1d, mesh2d, mesh5):
+        T = UST(n, s, Context(seed=31), replace=replace)
+        want = np.asarray(T.apply(A, COLUMNWISE))
+        got = np.asarray(T.apply(
+            distribute_sparse(A, mesh, **axes), COLUMNWISE))
+        np.testing.assert_allclose(got, want, atol=ATOL, err_msg=str(axes))
+        wantr = np.asarray(T.apply(Ar, ROWWISE))
+        gotr = np.asarray(T.apply(
+            distribute_sparse(Ar, mesh, **axes), ROWWISE))
+        np.testing.assert_allclose(gotr, wantr, atol=ATOL,
+                                   err_msg=str(axes))
+
+
+def test_rft_dist_sparse_oracle(mesh2d, devices):
+    """Random-feature maps on a distributed sparse input == local sparse
+    apply (kernel features from sparse libsvm-style data at scale)."""
+    from libskylark_tpu.sketch.rft import GaussianRFT
+
+    m, n, s = 29, 300, 16
+    A = _rand_sparse(m, n, seed=23)
+    mesh5 = par.make_mesh(devices=devices[:5])
+    for mesh, axes in [(mesh2d, dict(row_axis="rows", col_axis="cols")),
+                       (mesh5, dict(row_axis="rows"))]:
+        T = GaussianRFT(n, s, Context(seed=33), sigma=1.5)
+        want = np.asarray(T.apply(A, ROWWISE))
+        got = np.asarray(T.apply(
+            distribute_sparse(A, mesh, **axes), ROWWISE))
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, atol=ATOL, err_msg=str(axes))
+        # columnwise direction too (input transposed: sketched dim = rows)
+        Ac = A.T
+        wantc = np.asarray(T.apply(Ac, COLUMNWISE))
+        gotc = np.asarray(T.apply(
+            distribute_sparse(Ac, mesh, **axes), COLUMNWISE))
+        assert gotc.shape == wantc.shape
+        np.testing.assert_allclose(gotc, wantc, atol=ATOL,
+                                   err_msg=str(axes))
+
+
 def test_transpose(mesh2d):
     A = _rand_sparse(37, 53, seed=15)
     D = distribute_sparse(A, mesh2d, row_axis="rows", col_axis="cols")
