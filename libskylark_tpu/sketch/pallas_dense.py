@@ -38,6 +38,14 @@ from libskylark_tpu.sketch.dense import BLOCK_COLS  # the stream format's
 _HALF = BLOCK_COLS // 2
 
 
+def _DEFAULT_M_TILE() -> int:
+    """Tuning knob lives in sketch/params.py (runtime get/set, env-seeded
+    via SKYLARK_PALLAS_MTILE)."""
+    from libskylark_tpu.sketch import params as sketch_params
+
+    return sketch_params.get_pallas_m_tile()
+
+
 def available() -> bool:
     """True when the default backend can run the Mosaic kernel."""
     try:
@@ -362,13 +370,14 @@ def rowwise_apply(
     A: jnp.ndarray,
     s_dim: int,
     scale: float,
-    m_tile: int = 256,
+    m_tile: int | None = None,
     precision: str | None = None,
     interpret: bool = False,
 ) -> Optional[jnp.ndarray]:
     """out = scale · A @ Sᵀ with S the virtual (s_dim × N) matrix of
     :func:`randgen.dense_block`. Returns None when not applicable (caller
     falls back to the XLA path)."""
+    m_tile = m_tile or _DEFAULT_M_TILE()
     mt = _qualify(dist, A, seq_axis=1, m_tile=m_tile, interpret=interpret)
     if mt is None:
         return None
@@ -392,12 +401,13 @@ def columnwise_apply(
     A: jnp.ndarray,
     s_dim: int,
     scale: float,
-    m_tile: int = 256,
+    m_tile: int | None = None,
     precision: str | None = None,
     interpret: bool = False,
 ) -> Optional[jnp.ndarray]:
     """out = scale · S @ A for A (N, m); same fused generation, transposed
     contraction."""
+    m_tile = m_tile or _DEFAULT_M_TILE()
     mt = _qualify(dist, A, seq_axis=0, m_tile=m_tile, interpret=interpret)
     if mt is None:
         return None
@@ -422,7 +432,7 @@ def rft_rowwise_apply(
     outscale: float,
     sc: jnp.ndarray,
     sh: jnp.ndarray,
-    m_tile: int = 256,
+    m_tile: int | None = None,
     precision: str | None = None,
     interpret: bool = False,
 ) -> Optional[jnp.ndarray]:
@@ -431,6 +441,7 @@ def rft_rowwise_apply(
     epilogue applied in VMEM (no extra HBM round-trip of the feature
     matrix). ``sc``/``sh`` are (s_dim,) per-feature scales/shifts.
     Returns None when not applicable."""
+    m_tile = m_tile or _DEFAULT_M_TILE()
     mt = _qualify(dist, A, seq_axis=1, m_tile=m_tile, interpret=interpret)
     if mt is None:
         return None
@@ -462,7 +473,7 @@ def fused_partial(
     A_loc: jnp.ndarray,
     s_dim: int,
     seq_axis: int,
-    m_tile: int = 256,
+    m_tile: int | None = None,
     precision: str | None = None,
     interpret: bool = False,
 ) -> Optional[jnp.ndarray]:
@@ -479,6 +490,7 @@ def fused_partial(
     backend/distribution qualification is _qualify's)."""
     if A_loc.shape[seq_axis] != keys.shape[0] * BLOCK_COLS:
         return None
+    m_tile = m_tile or _DEFAULT_M_TILE()
     mt = _qualify(dist, A_loc, seq_axis=seq_axis, m_tile=m_tile,
                   interpret=interpret)
     if mt is None:

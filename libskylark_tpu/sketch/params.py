@@ -70,3 +70,32 @@ def set_pallas_precision(p: str) -> None:
         )
     global _pallas_precision
     _pallas_precision = p
+
+
+# ``pallas_m_tile`` — rows of A per fused-kernel grid step. Larger tiles
+# amortize operator generation/caching over more MXU work at the cost of
+# VMEM. Seeded from SKYLARK_PALLAS_MTILE for on-chip sweeps without code
+# changes; invalid values fall back to the default.
+def _env_m_tile() -> int:
+    import os
+
+    try:
+        v = int(os.environ.get("SKYLARK_PALLAS_MTILE", 256))
+    except ValueError:
+        return 256
+    return v if v >= 8 else 256
+
+
+_pallas_m_tile = _env_m_tile()
+
+
+def get_pallas_m_tile() -> int:
+    return _pallas_m_tile
+
+
+def set_pallas_m_tile(t: int) -> None:
+    t = int(t)
+    if t < 8:
+        raise ValueError(f"pallas_m_tile must be >= 8, got {t}")
+    global _pallas_m_tile
+    _pallas_m_tile = t

@@ -12,7 +12,13 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from libskylark_tpu import parallel as par
-from libskylark_tpu.algorithms.krylov import KrylovParams, cg, lsqr
+from libskylark_tpu.algorithms.krylov import (
+    KrylovParams,
+    cg,
+    chebyshev,
+    flexible_cg,
+    lsqr,
+)
 
 
 @pytest.fixture()
@@ -53,16 +59,46 @@ def test_lsqr_sharded_5_device_submesh(devices):
     )
 
 
-def test_cg_sharded_matches_local(mesh1d):
-    rng = np.random.default_rng(1)
-    n, k = 48, 2
+def _spd(n=48, seed=1):
+    rng = np.random.default_rng(seed)
     M = rng.standard_normal((n, n)).astype(np.float32)
     A = jnp.asarray(M @ M.T + n * np.eye(n, dtype=np.float32))
-    B = jnp.asarray(rng.standard_normal((n, k)), jnp.float32)
+    B = jnp.asarray(rng.standard_normal((n, 2)), jnp.float32)
+    return A, B
+
+
+def _sharded(mesh, *arrays):
+    sh = NamedSharding(mesh, P("rows", None))
+    return tuple(jax.device_put(a, sh) for a in arrays)
+
+
+def test_cg_sharded_matches_local(mesh1d):
+    A, B = _spd()
     X0, _ = cg(A, B, KrylovParams(tolerance=1e-10, iter_lim=300))
-    Ad = jax.device_put(A, NamedSharding(mesh1d, P("rows", None)))
-    Bd = jax.device_put(B, NamedSharding(mesh1d, P("rows", None)))
+    Ad, Bd = _sharded(mesh1d, A, B)
     X1, _ = cg(Ad, Bd, KrylovParams(tolerance=1e-10, iter_lim=300))
+    np.testing.assert_allclose(
+        np.asarray(X1), np.asarray(X0), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_flexible_cg_sharded_matches_local(mesh1d):
+    A, B = _spd(seed=4)
+    X0, _ = flexible_cg(A, B, KrylovParams(tolerance=1e-10, iter_lim=300))
+    Ad, Bd = _sharded(mesh1d, A, B)
+    X1, _ = flexible_cg(Ad, Bd, KrylovParams(tolerance=1e-10, iter_lim=300))
+    np.testing.assert_allclose(
+        np.asarray(X1), np.asarray(X0), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_chebyshev_sharded_matches_local(mesh1d):
+    A, B = _spd(seed=5)
+    w = np.linalg.eigvalsh(np.asarray(A))
+    lo, hi = float(w[0]) * 0.9, float(w[-1]) * 1.1
+    X0, _ = chebyshev(A, B, lo, hi, KrylovParams(iter_lim=80))
+    Ad, Bd = _sharded(mesh1d, A, B)
+    X1, _ = chebyshev(Ad, Bd, lo, hi, KrylovParams(iter_lim=80))
     np.testing.assert_allclose(
         np.asarray(X1), np.asarray(X0), atol=1e-4, rtol=1e-4
     )
