@@ -18,5 +18,10 @@ __version__ = "0.1.0"
 
 from libskylark_tpu.base.context import Context
 from libskylark_tpu.base import errors
+from libskylark_tpu.base.sparse import SparseMatrix
+from libskylark_tpu.base.dist_sparse import DistSparseMatrix, distribute_sparse
 
-__all__ = ["Context", "errors", "__version__"]
+__all__ = [
+    "Context", "errors", "__version__",
+    "SparseMatrix", "DistSparseMatrix", "distribute_sparse",
+]

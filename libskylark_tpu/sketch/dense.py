@@ -99,12 +99,33 @@ class DenseTransform(SketchTransform):
 
     # -- apply --
 
+    def _effective_blocksize(self, dtype) -> int:
+        """The panel width to apply at: the global ``blocksize`` knob, or
+        — when unset (0) but the full operator would exceed the
+        auto-blocking threshold — an automatic panel width. The reference
+        defaults to blocked apply (blocksize=1000,
+        ref: sketch/sketch_params.hpp:15-19) precisely so S never
+        materializes; unbounded materialization of an (S_dim × N)
+        operator at huge N would OOM where the reference works."""
+        blocksize = sketch_params.get_blocksize()
+        if blocksize:
+            return blocksize if self._N > blocksize else 0
+        itemsize = jnp.dtype(dtype).itemsize
+        if self._S * self._N * itemsize > sketch_params.get_auto_block_bytes():
+            # raw width; _panel_schedule rounds to BLOCK_COLS multiples
+            return max(
+                BLOCK_COLS,
+                sketch_params.get_auto_block_bytes()
+                // max(self._S * itemsize, 1),
+            )
+        return 0
+
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
         out = self._try_pallas(A, "columnwise_apply")
         if out is not None:
             return out
-        blocksize = sketch_params.get_blocksize()
-        if blocksize and self._N > blocksize:
+        blocksize = self._effective_blocksize(A.dtype)
+        if blocksize:
             return self._apply_columnwise_blocked(A, blocksize)
         S = self.s_panel(0, self._N, A.dtype)
         return S @ A
@@ -113,8 +134,8 @@ class DenseTransform(SketchTransform):
         out = self._try_pallas(A, "rowwise_apply")
         if out is not None:
             return out
-        blocksize = sketch_params.get_blocksize()
-        if blocksize and self._N > blocksize:
+        blocksize = self._effective_blocksize(A.dtype)
+        if blocksize:
             return self._apply_rowwise_blocked(A, blocksize)
         S = self.s_panel(0, self._N, A.dtype)
         return A @ S.T
@@ -129,8 +150,8 @@ class DenseTransform(SketchTransform):
     def _apply_columnwise_sparse(self, A) -> jnp.ndarray:
         from libskylark_tpu.base.sparse import spmm_t
 
-        blocksize = sketch_params.get_blocksize()
-        if blocksize and self._N > blocksize:
+        blocksize = self._effective_blocksize(A.device_dtype)
+        if blocksize:
             # S·A = (Aᵀ·Sᵀ)ᵀ; Aᵀ's columns are A's rows = the sketched dim,
             # so the panel loop runs over Aᵀ (host CSC transpose, O(nnz)).
             return self._sparse_panel_loop(A.transpose(), blocksize).T
@@ -140,8 +161,8 @@ class DenseTransform(SketchTransform):
     def _apply_rowwise_sparse(self, A) -> jnp.ndarray:
         from libskylark_tpu.base.sparse import spmm
 
-        blocksize = sketch_params.get_blocksize()
-        if blocksize and self._N > blocksize:
+        blocksize = self._effective_blocksize(A.device_dtype)
+        if blocksize:
             return self._sparse_panel_loop(A, blocksize)
         S = self.s_panel(0, self._N, A.device_dtype)
         return spmm(A, S.T)              # A·Sᵀ

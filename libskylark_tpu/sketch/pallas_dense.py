@@ -340,6 +340,10 @@ def _qualify(dist, A, seq_axis: int, m_tile: int, interpret: bool):
     if not supported(dist, A.dtype):
         return None
     m = _pad_to(max(A.shape[1 - seq_axis], 8), 8)
+    # power-of-two tile ≥ 8: the halving search below then always
+    # terminates at a divisor of the 8-aligned m (a non-pow2 request,
+    # e.g. SKYLARK_PALLAS_MTILE=100, would otherwise collapse to 1)
+    m_tile = max(8, 1 << (max(m_tile, 8).bit_length() - 1))
     m_tile = min(m_tile, m)
     while m % m_tile:
         m_tile //= 2

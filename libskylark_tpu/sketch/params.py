@@ -31,6 +31,26 @@ def set_factor(f: int) -> None:
     _factor = int(f)
 
 
+# ``auto_block_bytes`` — even with ``blocksize`` unset (0), a dense apply
+# whose full virtual operator would exceed this many bytes switches to
+# the panel-blocked path automatically (the memory-safety default the
+# reference gets from blocksize=1000; our default unblocked mode is the
+# fast path for everything that fits comfortably in HBM).
+_auto_block_bytes = 2 << 30  # 2 GiB
+
+
+def get_auto_block_bytes() -> int:
+    return _auto_block_bytes
+
+
+def set_auto_block_bytes(b: int) -> None:
+    b = int(b)
+    if b <= 0:
+        raise ValueError(f"auto_block_bytes must be positive, got {b}")
+    global _auto_block_bytes
+    _auto_block_bytes = b
+
+
 # ``use_pallas`` — route dense-transform applies through the fused Pallas
 # TPU kernel (sketch/pallas_dense.py) when the input/backend qualify. The
 # sketch operator entries are bit-exact either way; only the contraction

@@ -72,6 +72,32 @@ def test_blocked_apply_is_panel_bounded(dimension):
     )
 
 
+def test_auto_blocking_guards_huge_operators():
+    """With blocksize unset, an apply whose operator exceeds the
+    auto-block threshold takes the panel path anyway — the memory-safety
+    default the reference gets from blocksize=1000."""
+    N, S, m = 16384, 64, 8
+    T = JLT(N, S, Context(seed=2))
+    A = jnp.zeros((m, N), jnp.float32)
+    old = sketch_params.get_auto_block_bytes()
+    sketch_params.set_auto_block_bytes(1 << 20)  # 1 MiB: S (4 MiB) exceeds
+    try:
+        jaxpr = jax.make_jaxpr(lambda X: T.apply(X, ROWWISE))(A)
+    finally:
+        sketch_params.set_auto_block_bytes(old)
+    assert _max_intermediate_elems(jaxpr.jaxpr) < S * N
+    # correctness at the auto-chosen panel size
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.standard_normal((m, N)), jnp.float32)
+    want = np.asarray(T.apply(A, ROWWISE))
+    sketch_params.set_auto_block_bytes(1 << 20)
+    try:
+        got = np.asarray(T.apply(A, ROWWISE))
+    finally:
+        sketch_params.set_auto_block_bytes(old)
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
 def test_unblocked_apply_does_materialize():
     """Sanity check on the measuring stick: with blocking off, the full
     operator IS an intermediate — so the blocked assertion above is
