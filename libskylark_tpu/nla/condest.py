@@ -24,22 +24,33 @@ from libskylark_tpu.base.precision import with_solver_precision
 
 @with_solver_precision
 def condest(
-    A: jnp.ndarray,
+    A,
     context: Context,
     max_iter: int = 100,
     tol: float = 1e-3,
 ) -> Tuple[float, float, float]:
     """Estimate (cond, sigma_max, sigma_min) of A (m ≥ n recommended).
 
-    Deterministic given the context (the start vector comes from an
-    allocation key). Host-side driver loop; each step is two matvecs.
+    ``A`` may be a dense array, a :class:`SparseMatrix`, or a
+    :class:`DistSparseMatrix` (sparse operands drive the loop through
+    scipy matvecs). Deterministic given the context (the start vector
+    comes from an allocation key). Host-side driver loop; each step is
+    two matvecs.
     """
+    from libskylark_tpu.base.dist_sparse import DistSparseMatrix
+    from libskylark_tpu.base.sparse import SparseMatrix
     # Full float64 with one-sided reorthogonalization: Golub-Kahan in f32
     # loses orthogonality within tens of steps and manufactures spurious
     # small singular values, wrecking the sigma_min estimate. This is a
     # host-side diagnostic (the reference's is serial LAPACK too,
     # ref: nla/CondEst.hpp:12-16), so f64 numpy is the right tool.
-    A = np.asarray(jax.device_get(A), dtype=np.float64)
+    # Sparse operands stay sparse: scipy matvecs drive the same loop.
+    if isinstance(A, SparseMatrix):
+        A = A.to_scipy().astype(np.float64)
+    elif isinstance(A, DistSparseMatrix):
+        A = A.to_local().to_scipy().astype(np.float64)
+    else:
+        A = np.asarray(jax.device_get(A), dtype=np.float64)
     m, n = A.shape
     key = context.allocate().key
     b = np.asarray(jr.normal(key, (m,), jnp.float32), dtype=np.float64)

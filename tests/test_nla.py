@@ -161,6 +161,30 @@ class TestCondEst:
         e2 = nla.estimate_condition(A, Context(seed=41))
         assert e1 == e2
 
+    def test_sparse_operand_matches_dense(self, mesh1d):
+        """Sparse and distributed-sparse operands drive the same
+        Golub-Kahan loop through scipy matvecs. Tolerance is loose on
+        purpose: the dense path runs BLAS gemv, the sparse path scipy CSC
+        matvecs — different accumulation orders can flip the discrete
+        convergence checks on some BLAS builds, shifting the stop
+        iteration by one tol=1e-3 window."""
+        import scipy.sparse as sp
+
+        from libskylark_tpu.base.dist_sparse import distribute_sparse
+        from libskylark_tpu.base.sparse import SparseMatrix
+
+        rng = np.random.default_rng(13)
+        dense = (rng.standard_normal((120, 20)) *
+                 (rng.uniform(size=(120, 20)) < 0.3)).astype(np.float32)
+        A = SparseMatrix.from_scipy(sp.csc_matrix(dense))
+        e_dense = nla.estimate_condition(jnp.asarray(dense),
+                                         Context(seed=43))
+        e_sparse = nla.estimate_condition(A, Context(seed=43))
+        np.testing.assert_allclose(e_sparse, e_dense, rtol=5e-3)
+        D = distribute_sparse(A, mesh1d, row_axis="rows")
+        e_dist = nla.estimate_condition(D, Context(seed=43))
+        np.testing.assert_allclose(e_dist, e_sparse, rtol=1e-8)
+
 
 class TestSpectral:
     def test_chebyshev_points(self):
