@@ -37,8 +37,18 @@ class KrylovParams(Params):
 
 
 def _as_ops(A: Operator):
+    """(mv, rmv) over any operand kind: explicit pair, dense array,
+    SparseMatrix, or DistSparseMatrix (the reference's matrix-type
+    templating of the Krylov loops, ref: algorithms/Krylov/LSQR.hpp:21)."""
     if isinstance(A, tuple):
         return A
+    from libskylark_tpu.base.dist_sparse import DistSparseMatrix
+    from libskylark_tpu.base.sparse import SparseMatrix, spmm, spmm_t
+
+    if isinstance(A, SparseMatrix):
+        return (lambda x: spmm(A, x)), (lambda x: spmm_t(A, x))
+    if isinstance(A, DistSparseMatrix):
+        return A.spmm, A.spmm_t
     M = jnp.asarray(A)
     return (lambda x: M @ x), (lambda x: M.T @ x)
 
@@ -73,7 +83,7 @@ def lsqr(
     if shape is None:
         if isinstance(A, tuple):
             raise ValueError("shape=(m, n) required for operator-pair A")
-        shape = jnp.asarray(A).shape
+        shape = A.shape if hasattr(A, "shape") else jnp.asarray(A).shape
     m, n = shape
     k = B.shape[1]
     dt = B.dtype

@@ -166,9 +166,21 @@ def solve_l2_accelerated(
     (ref: accelerated_linearl2_regression_solver_Elemental.hpp:208-276).
 
     Returns (X, iterations); iterations == 0 signals the exact fallback.
+
+    ``A`` may be dense, a :class:`SparseMatrix`, or a
+    :class:`DistSparseMatrix` — sparse operands default the sketch to CWT
+    (the reference's sparse-input path; the FJLT needs a dense fast
+    transform) and run LSQR through the sparse matvecs.
     """
+    from libskylark_tpu.base.sparse import is_sparse_operand
+
     params = params or AcceleratedParams()
-    A = jnp.asarray(A)
+    is_sparse = is_sparse_operand(A)
+    if is_sparse:
+        if params.sketch == "fjlt":
+            params = dataclasses.replace(params, sketch="cwt")
+    else:
+        A = jnp.asarray(A)
     B = jnp.asarray(B)
 
     if method in ("blendenpik", "simplified_blendenpik"):
@@ -177,18 +189,19 @@ def solve_l2_accelerated(
             precond, R = build_blendenpik_precond(A, context, p2)
         else:
             precond, R = build_blendenpik_precond(A, context, params)
-        # Condition check on the small R factor — the reference runs CondEst
+        # Condition of the small R factor — the reference runs CondEst
         # and falls back to the exact SVD solver (ref: :241-253).
         cond = jnp.linalg.cond(R)
-        if not bool(jnp.isfinite(cond)) or float(cond) > params.cond_threshold:
-            return solve_l2_exact(A, B, method="svd"), jnp.int32(0)
     elif method == "lsrn":
         precond, sv = build_lsrn_precond(A, context, params)
         cond = sv[0] / jnp.maximum(sv[-1], jnp.finfo(A.dtype).tiny)
-        if not bool(jnp.isfinite(cond)) or float(cond) > params.cond_threshold:
-            return solve_l2_exact(A, B, method="svd"), jnp.int32(0)
     else:
         raise errors.InvalidParametersError(f"unknown accelerated method {method!r}")
+
+    if not bool(jnp.isfinite(cond)) or float(cond) > params.cond_threshold:
+        # exact fallback is a dense factorization (as in the reference)
+        Ad = A.todense() if is_sparse else A
+        return solve_l2_exact(Ad, B, method="svd"), jnp.int32(0)
 
     kp = krylov.KrylovParams(tolerance=params.tolerance, iter_lim=params.iter_lim)
     return krylov.lsqr(A, B, params=kp, precond=precond)

@@ -195,6 +195,15 @@ class SparseMatrix:
         )
 
 
+def is_sparse_operand(A) -> bool:
+    """True for the framework's sparse matrix kinds (local
+    :class:`SparseMatrix` or mesh-distributed ``DistSparseMatrix``) —
+    the shared predicate for operand dispatch in the solver layers."""
+    from libskylark_tpu.base.dist_sparse import DistSparseMatrix
+
+    return isinstance(A, (SparseMatrix, DistSparseMatrix))
+
+
 def spmm(A: SparseMatrix, B) -> jax.Array:
     """A @ B with A sparse (h×w), B dense (w×k) → dense (h×k).
 

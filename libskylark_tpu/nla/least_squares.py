@@ -22,10 +22,17 @@ def approximate_least_squares(
     sketch: str = "fjlt",
 ):
     """Sketch-and-solve least squares (Drineas et al.); default sketch size
-    4×Width(A) with an FJLT (ref: nla/least_squares.hpp:41-83)."""
+    4×Width(A) with an FJLT (ref: nla/least_squares.hpp:41-83). Sparse
+    operands (``SparseMatrix``/``DistSparseMatrix``) default to a CWT
+    sketch (the FJLT needs a dense fast transform)."""
     from libskylark_tpu import sketch as sk
+    from libskylark_tpu.base.sparse import is_sparse_operand
 
-    A = jnp.asarray(A)
+    if is_sparse_operand(A):
+        if sketch == "fjlt":
+            sketch = "cwt"
+    else:
+        A = jnp.asarray(A)
     m, n = A.shape
     s = int(sketch_size) if sketch_size else 4 * n
     s = min(max(s, n + 1), m)
