@@ -245,11 +245,18 @@ def main():
         (bench_nla, "nla_wallclock_s"),
         (bench_admm, "admm_train_wallclock_s"),
     )
+    if args.only:
+        wanted = [s.strip() for s in args.only.split(",") if s.strip()]
+        selected = [
+            (fn, metric) for fn, metric in benches
+            if any(s in fn.__name__ or s in metric for s in wanted)
+        ]
+        if not selected:
+            names = ", ".join(f"{fn.__name__}/{m}" for fn, m in benches)
+            sys.exit(f"--only {args.only!r} matched no bench "
+                     f"(available: {names})")
+        benches = tuple(selected)
     for fn, metric in benches:
-        if args.only and not any(
-            s in fn.__name__ for s in args.only.split(",")
-        ):
-            continue
         try:
             rec = fn(args.scale)
         except Exception as e:  # record the failure under its REAL metric

@@ -16,6 +16,13 @@ data layout (ref: base/randgen.hpp:98-115, base/context.hpp:19-194).
 
 __version__ = "0.1.0"
 
+from libskylark_tpu.base.precision import install_default_matmul_precision
+
+# f32 matmuls must actually be f32 on TPU (default lowering is one bf16
+# MXU pass — outside the 1e-4 oracle; see base/precision.py for the
+# measurement). Env opt-out: SKYLARK_MATMUL_PRECISION=default.
+install_default_matmul_precision()
+
 from libskylark_tpu.base.context import Context
 from libskylark_tpu.base import errors
 from libskylark_tpu.base.sparse import SparseMatrix

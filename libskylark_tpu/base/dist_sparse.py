@@ -208,6 +208,36 @@ class DistSparseMatrix:
         out = out.reshape(self.pc * bs_c, k)[: self.width]
         return out[:, 0] if squeeze else out
 
+    def compact(self, utilization_threshold: float = 0.5
+                ) -> "DistSparseMatrix":
+        """Shrink the per-cell padding to the true max cell nnz when slot
+        utilization has dropped below ``utilization_threshold``.
+
+        Cell-merging operations (e.g. the sparse→sparse hash apply,
+        sketch/dist_sparse_apply.py) multiply the padded slot count by the
+        merged mesh-axis extent while the real nnz stays fixed, so chained
+        applies compound mostly-zero slots that every downstream
+        spmm/todense then segment-sums over. Compaction is device-side
+        with a static output shape: one global-nnz readback picks the new
+        pad, a per-cell stable argsort on the padding flag moves real
+        entries first, and the slot axis is sliced. Entries with v == 0
+        are semantically padding for every consumer (they contribute
+        nothing to any product, the CSC duplicate-sum convention of
+        ref: base/sparse_matrix.hpp:136), so dropping them is exact."""
+        pad = self.v.shape[-1]
+        true_pad = max(int(jnp.max(jnp.count_nonzero(self.v, axis=-1))), 1)
+        if true_pad > pad * utilization_threshold:
+            return self
+        order = jnp.argsort((self.v == 0).astype(jnp.int32), axis=-1,
+                            stable=True)[..., :true_pad]
+        spec = NamedSharding(self.mesh, self._triplet_spec())
+        take = lambda a: jax.device_put(
+            jnp.take_along_axis(a, order, axis=-1), spec)
+        return DistSparseMatrix(
+            self.mesh, self.row_axis, self.col_axis, self._shape,
+            take(self.lr), take(self.lc), take(self.v),
+        )
+
     def transpose(self) -> "DistSparseMatrix":
         """Aᵀ — pure relabeling: swap the grid axes and the local
         coordinates (no data movement beyond the stacked-array transpose;

@@ -153,17 +153,25 @@ def hash_apply_sparse(T, D: DistSparseMatrix, columnwise: bool = True
         spec = NamedSharding(mesh, P(None, col_axis, None))
         merge = lambda a: _jax.device_put(
             a.transpose(1, 0, 2).reshape(1, pc, pr * pad), spec)
-        return DistSparseMatrix(
+        out = DistSparseMatrix(
             mesh, None, col_axis, (T.sketch_dim, D.width),
             merge(nlr), merge(nlc), merge(nv),
         )
-    spec = NamedSharding(mesh, P(row_axis, None, None))
-    merge = lambda a: _jax.device_put(
-        a.reshape(pr, 1, pc * pad), spec)
-    return DistSparseMatrix(
-        mesh, row_axis, None, (D.height, T.sketch_dim),
-        merge(nlr), merge(nlc), merge(nv),
-    )
+    else:
+        spec = NamedSharding(mesh, P(row_axis, None, None))
+        merge = lambda a: _jax.device_put(
+            a.reshape(pr, 1, pc * pad), spec)
+        out = DistSparseMatrix(
+            mesh, row_axis, None, (D.height, T.sketch_dim),
+            merge(nlr), merge(nlc), merge(nv),
+        )
+    # the merge multiplied the slot count by the merged axis extent while
+    # real nnz stayed fixed; re-compact so chained sparse applies don't
+    # compound mostly-zero slots (advisor r2 finding). Skipped when the
+    # merged axis had extent 1 (no growth): compact()'s nnz readback is a
+    # blocking device sync not worth paying on the no-op case.
+    merged_extent = pr if columnwise else pc
+    return out.compact() if merged_extent > 1 else out
 
 
 # ---------------------------------------------------------------------------

@@ -16,6 +16,7 @@ layouts fall back to the XLA path in sketch/dense.py.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -91,40 +92,81 @@ def _dot(lhs, rhs, dims, precision):
     ``"f32"`` (the default, set in sketch/params.py): full-f32 passes
     (``Precision.HIGHEST``) — keeps the fused apply inside the framework's
     1e-4 determinism oracle vs the XLA/CPU path on deep contractions.
-    ``"bf16x3"``: 3-pass bf16 (``Precision.HIGH``) — f32-grade rounding
-    at roughly half the HIGHEST cost; candidate default once validated
-    against the oracle on real hardware (the interpreter executes it as
-    f32, so only the on-chip test can certify it).
+    ``"bf16x3"``: 3-pass error-compensated bf16 split (spelled out below;
+    Mosaic has no ``Precision.HIGH`` lowering) — f32-grade rounding at
+    roughly half the HIGHEST cost. The explicit hi/lo split performs real
+    bf16 rounding in interpret mode too, so both the interpreter and the
+    on-chip test exercise the same arithmetic.
     ``"bf16"``: single-pass bf16 inputs + f32 accumulation — the fastest
     MXU regime; contraction rounds at ~2⁻⁸ relative, which EXCEEDS the
     1e-4 oracle for large N (quantified in tests/test_pallas_dense.py), so
     callers opt in explicitly for throughput-only work."""
-    if precision == "bf16":
+
+    def bf16_dot(a, b):
+        # precision pinned explicitly: the package-level default matmul
+        # precision is "highest", which on bf16 operands asks Mosaic for
+        # an fp32 contraction it can't lower ("Bad lhs type")
         return jax.lax.dot_general(
-            lhs.astype(jnp.bfloat16),
-            rhs.astype(jnp.bfloat16),
+            a.astype(jnp.bfloat16),
+            b.astype(jnp.bfloat16),
             dims,
+            precision=jax.lax.Precision.DEFAULT,
             preferred_element_type=jnp.float32,
         )
-    prec = (jax.lax.Precision.HIGH if precision == "bf16x3"
-            else jax.lax.Precision.HIGHEST)
+
+    if precision == "bf16":
+        return bf16_dot(lhs, rhs)
+    if precision == "bf16x3":
+        # Error-compensated 3-pass split. Mosaic has no lowering for
+        # Precision.HIGH (verified on v5e: "Unsupported dot precision:
+        # HIGH"), so the split is spelled out: x = hi + lo with hi the
+        # bf16 rounding of x; hi·hi + hi·lo + lo·hi recovers all but the
+        # lo·lo term (~2⁻¹⁶ relative) — f32-grade for the 1e-4 oracle.
+        lhs_hi = lhs.astype(jnp.bfloat16).astype(jnp.float32)
+        rhs_hi = rhs.astype(jnp.bfloat16).astype(jnp.float32)
+        lhs_lo = lhs - lhs_hi
+        rhs_lo = rhs - rhs_hi
+        return bf16_dot(lhs_hi, rhs_hi) + (
+            bf16_dot(lhs_hi, rhs_lo) + bf16_dot(lhs_lo, rhs_hi)
+        )
     return jax.lax.dot_general(
         lhs,
         rhs,
         dims,
-        precision=prec,
+        precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32,
     )
 
+
+# Per-core VMEM budget the kernel plans against. ~16 MiB/core is the
+# common figure across current generations (v4/v5e/v5p; pallas_guide.md
+# memory-hierarchy table) — there is no runtime query API, so the default
+# is conservative and env-overridable for parts that have more.
+_VMEM_BUDGET_BYTES = int(os.environ.get(
+    "SKYLARK_PALLAS_VMEM_BUDGET", 16 * 1024 * 1024))
 
 # VMEM budget for caching the generated operator across m-tiles. When the
 # full virtual S fits, each block is generated ONCE (first m-tile sweep)
 # and every later tile contracts against the cached copy — generation cost
 # amortizes over m instead of being paid per tile. Larger operators fall
-# back to per-tile regeneration. Sized for current-generation chips
-# (≥64 MiB VMEM/core); override for smaller parts via the env var.
-_SCRATCH_CAP_BYTES = int(__import__("os").environ.get(
-    "SKYLARK_PALLAS_SCRATCH_CAP", 48 * 1024 * 1024))
+# back to per-tile regeneration. Must leave room for the pipeline's
+# double-buffered A/out tiles inside _VMEM_BUDGET_BYTES (advisor r2
+# medium finding: the old 48 MiB default exceeded whole-VMEM on v5e and
+# could fail Mosaic compilation outright on the shard_map path).
+_SCRATCH_CAP_BYTES = int(os.environ.get(
+    "SKYLARK_PALLAS_SCRATCH_CAP", 8 * 1024 * 1024))
+
+
+def _vmem_estimate(m_tile: int, s_dim: int, scratch_bytes: int) -> int:
+    """Rough per-core VMEM plan for one grid step: double-buffered A tile
+    (m_tile × BLOCK_COLS) and out tile (m_tile × s_dim), the generated
+    operator block + generation temporaries (~4 × s_dim × BLOCK_COLS),
+    plus the optional operator-cache scratch."""
+    return 4 * (
+        2 * m_tile * BLOCK_COLS
+        + 2 * m_tile * s_dim
+        + 4 * s_dim * BLOCK_COLS
+    ) + scratch_bytes
 
 
 def _resolve_block(dist_kind, s_dim, keys_ref, k, s_scr):
@@ -188,11 +230,15 @@ def _kernel_cw(dist_kind, s_dim, m_tile, precision, keys_ref, a_ref, out_ref,
 
 def _scratch(s_dim: int, n: int, m: int, m_tile: int):
     """Scratch shapes for the operator cache, or [] when it doesn't pay
-    (single m-tile → no reuse) or doesn't fit."""
+    (single m-tile → no reuse) or doesn't fit the cap / the whole-kernel
+    VMEM budget."""
     n_blocks = n // BLOCK_COLS
     if m // m_tile <= 1:
         return []
-    if s_dim * n_blocks * BLOCK_COLS * 4 > _SCRATCH_CAP_BYTES:
+    scratch_bytes = s_dim * n_blocks * BLOCK_COLS * 4
+    if scratch_bytes > _SCRATCH_CAP_BYTES:
+        return []
+    if _vmem_estimate(m_tile, s_dim, scratch_bytes) > _VMEM_BUDGET_BYTES:
         return []
     return [pltpu.VMEM((s_dim, n_blocks * BLOCK_COLS), jnp.float32)]
 
@@ -324,9 +370,17 @@ def _pad_to(x: int, mult: int) -> int:
     return -(-x // mult) * mult
 
 
-def _qualify(dist, A, seq_axis: int, m_tile: int, interpret: bool):
+def _qualify(dist, A, seq_axis: int, m_tile: int, interpret: bool,
+             s_dim: int = 0):
     """Common qualification: backend + distribution. Returns the m-tile
     size for the (possibly padded) m extent, or None for fallback.
+
+    The returned tile is pre-shrunk so the kernel's VMEM plan
+    (:func:`_vmem_estimate`, scratch excluded — _scratch checks itself)
+    fits ``_VMEM_BUDGET_BYTES``: a Mosaic VMEM-exhaustion failure inside a
+    jitted shard_map pipeline has no catchable fallback seam, so the
+    pre-flight must make compilation succeed, not try/except it (advisor
+    r2 medium finding).
 
     Ragged shapes are handled by the callers via zero-padding (exact for
     these contractions: padded A columns multiply virtual S columns by
@@ -347,6 +401,13 @@ def _qualify(dist, A, seq_axis: int, m_tile: int, interpret: bool):
     m_tile = min(m_tile, m)
     while m % m_tile:
         m_tile //= 2
+    while (m_tile > 8
+           and _vmem_estimate(m_tile, s_dim, 0) > _VMEM_BUDGET_BYTES):
+        m_tile //= 2
+    if _vmem_estimate(m_tile, s_dim, 0) > _VMEM_BUDGET_BYTES:
+        # even the smallest tile can't fit (the generation term scales
+        # with s_dim alone) — XLA fallback instead of a Mosaic abort
+        return None
     return m_tile
 
 
@@ -382,7 +443,8 @@ def rowwise_apply(
     :func:`randgen.dense_block`. Returns None when not applicable (caller
     falls back to the XLA path)."""
     m_tile = m_tile or _DEFAULT_M_TILE()
-    mt = _qualify(dist, A, seq_axis=1, m_tile=m_tile, interpret=interpret)
+    mt = _qualify(dist, A, seq_axis=1, m_tile=m_tile, interpret=interpret,
+                  s_dim=s_dim)
     if mt is None:
         return None
     m = A.shape[0]
@@ -412,7 +474,8 @@ def columnwise_apply(
     """out = scale · S @ A for A (N, m); same fused generation, transposed
     contraction."""
     m_tile = m_tile or _DEFAULT_M_TILE()
-    mt = _qualify(dist, A, seq_axis=0, m_tile=m_tile, interpret=interpret)
+    mt = _qualify(dist, A, seq_axis=0, m_tile=m_tile, interpret=interpret,
+                  s_dim=s_dim)
     if mt is None:
         return None
     m = A.shape[1]
@@ -446,7 +509,8 @@ def rft_rowwise_apply(
     matrix). ``sc``/``sh`` are (s_dim,) per-feature scales/shifts.
     Returns None when not applicable."""
     m_tile = m_tile or _DEFAULT_M_TILE()
-    mt = _qualify(dist, A, seq_axis=1, m_tile=m_tile, interpret=interpret)
+    mt = _qualify(dist, A, seq_axis=1, m_tile=m_tile, interpret=interpret,
+                  s_dim=s_dim)
     if mt is None:
         return None
     m = A.shape[0]
@@ -496,7 +560,7 @@ def fused_partial(
         return None
     m_tile = m_tile or _DEFAULT_M_TILE()
     mt = _qualify(dist, A_loc, seq_axis=seq_axis, m_tile=m_tile,
-                  interpret=interpret)
+                  interpret=interpret, s_dim=s_dim)
     if mt is None:
         return None
     m = A_loc.shape[1 - seq_axis]
