@@ -53,6 +53,7 @@ DIRECTIONS = {
     "cwt_sparse_apply_Mnnz_per_s": +1,
     "cwt_dist_sparse_apply_Mnnz_per_s": +1,
     "rft_feature_map_Mrows_per_s": +1,
+    "frft_feature_map_Mrows_per_s": +1,
     "nla_wallclock_s": -1,
     "admm_train_wallclock_s": -1,
 }
@@ -150,6 +151,34 @@ def bench_feature_maps(scale: str):
             "unit": "Mrows/s", "fast": out["fast"]}
 
 
+def bench_frft(scale: str):
+    """Fastfood at high input dimension — the regime it exists for
+    (SHGΠHB beats the dense frequency-matrix GEMM,
+    ref: sketch/FRFT_Elemental.hpp, sketch/FUT.hpp:225-347). The WHT core
+    runs as the kron-factored MXU matmul (sketch/fut.py). Reported with
+    the dense-RFT rows/s on the SAME config so the speedup is in the
+    record (r2 finding: FRFT was 4× slower than RFT; the criterion is
+    ≥2× faster at d ≥ 4096)."""
+    from libskylark_tpu.base.context import Context
+    from libskylark_tpu.sketch import ROWWISE
+    from libskylark_tpu.sketch.frft import FastGaussianRFT
+    from libskylark_tpu.sketch.rft import GaussianRFT
+
+    n, d, s = (16384, 4096, 4096) if scale == "full" else (2048, 512, 512)
+    X = jnp.asarray(np.random.default_rng(8).standard_normal((n, d)),
+                    jnp.float32)
+    out = {}
+    for tag, T in (
+        ("frft", FastGaussianRFT(d, s, Context(seed=9), sigma=2.0)),
+        ("rft", GaussianRFT(d, s, Context(seed=9), sigma=2.0)),
+    ):
+        f = jax.jit(lambda X, T=T: jnp.sum(jnp.abs(T.apply(X, ROWWISE))))
+        out[tag] = round(n / _time_scalar(f, X) / 1e6, 3)
+    return {"metric": "frft_feature_map_Mrows_per_s", "value": out["frft"],
+            "unit": "Mrows/s", "rft_same_config": out["rft"],
+            "speedup_vs_rft": round(out["frft"] / out["rft"], 3)}
+
+
 def bench_nla(scale: str):
     from libskylark_tpu.base.context import Context
     from libskylark_tpu.nla.least_squares import fast_least_squares
@@ -231,7 +260,8 @@ def main():
                     help="exit 1 if any metric regresses >10%% vs the "
                          "best prior round")
     ap.add_argument("--only", default=None,
-                    help="comma-separated metric substrings to run")
+                    help="comma-separated bench-name substrings or exact "
+                         "metric names to run")
     args = ap.parse_args()
 
     prior = _prior_best(args.scale, jax.default_backend())
@@ -242,14 +272,18 @@ def main():
         (bench_cwt_sparse, "cwt_sparse_apply_Mnnz_per_s"),
         (bench_cwt_dist_sparse, "cwt_dist_sparse_apply_Mnnz_per_s"),
         (bench_feature_maps, "rft_feature_map_Mrows_per_s"),
+        (bench_frft, "frft_feature_map_Mrows_per_s"),
         (bench_nla, "nla_wallclock_s"),
         (bench_admm, "admm_train_wallclock_s"),
     )
     if args.only:
+        # bench-name substrings or EXACT metric names — substring matching
+        # on metrics would make some benches unselectable alone
+        # ("rft_feature_map_Mrows_per_s" is a substring of the frft metric)
         wanted = [s.strip() for s in args.only.split(",") if s.strip()]
         selected = [
             (fn, metric) for fn, metric in benches
-            if any(s in fn.__name__ or s in metric for s in wanted)
+            if any(s in fn.__name__ or s == metric for s in wanted)
         ]
         if not selected:
             names = ", ".join(f"{fn.__name__}/{m}" for fn, m in benches)

@@ -34,11 +34,18 @@ from libskylark_tpu.sketch.transform import SketchTransform, register
 
 
 class FastRFT(SketchTransform):
-    """Base Fastfood transform (ref: sketch/FRFT_data.hpp:26-139)."""
+    """Base Fastfood transform (ref: sketch/FRFT_data.hpp:26-139).
+
+    Default FUT is the Walsh-Hadamard transform — the reference's
+    preferred Fastfood core when SpiralWHT is available
+    (ref: FRFT_data.hpp:125, sketch/FUT.hpp:225-347); here it runs as the
+    kron-factored MXU matmul (fut.py _wht_matmul), which is what makes
+    Fastfood *fast* on TPU. ``fut="dct"`` keeps the FFT-based FFTW-analog
+    path (any N without padding)."""
 
     sketch_type = "FastRFT"
 
-    def __init__(self, N, S, context, fut: str = "dct"):
+    def __init__(self, N, S, context, fut: str = "wht"):
         self._fut_name = fut
         super().__init__(N, S, context)
 
@@ -120,7 +127,7 @@ class FastGaussianRFT(FastRFT):
 
     sketch_type = "FastGaussianRFT"
 
-    def __init__(self, N, S, context, sigma: float = 1.0, fut: str = "dct"):
+    def __init__(self, N, S, context, sigma: float = 1.0, fut: str = "wht"):
         self._sigma = float(sigma)
         super().__init__(N, S, context, fut=fut)
 
@@ -138,7 +145,7 @@ class FastGaussianRFT(FastRFT):
     @classmethod
     def _from_parts(cls, N, S, alloc, d):
         return cls(N, S, alloc, sigma=float(d.get("sigma", 1.0)),
-                   fut=d.get("fut", "dct"))
+                   fut=d.get("fut", "wht"))
 
 
 @register
@@ -149,7 +156,7 @@ class FastMaternRFT(FastRFT):
     sketch_type = "FastMaternRFT"
 
     def __init__(self, N, S, context, nu: float = 1.0, l: float = 1.0,
-                 fut: str = "dct"):
+                 fut: str = "wht"):
         self._nu = float(nu)
         self._l = float(l)
         super().__init__(N, S, context, fut=fut)
@@ -172,4 +179,4 @@ class FastMaternRFT(FastRFT):
     @classmethod
     def _from_parts(cls, N, S, alloc, d):
         return cls(N, S, alloc, nu=float(d.get("nu", 1.0)),
-                   l=float(d.get("l", 1.0)), fut=d.get("fut", "dct"))
+                   l=float(d.get("l", 1.0)), fut=d.get("fut", "wht"))

@@ -14,11 +14,13 @@ DHT/WHT, making scale·F approximately orthonormal.
 
 from __future__ import annotations
 
+import functools
 import math
 from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _dct2_last(x: jnp.ndarray) -> jnp.ndarray:
@@ -74,15 +76,50 @@ def dht(A: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
     return jnp.real(F) - jnp.imag(F)
 
 
-def wht(A: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
-    """Fast Walsh-Hadamard transform (natural/Hadamard ordering), N = 2^k
-    (SpiralWHT analog, ref: sketch/FUT.hpp:225-347). Unnormalized, self-inverse
-    up to N."""
+# Transform length at which the WHT switches from the VPU butterfly to
+# the kron-factored matmul formulation (H_N = H_a ⊗ H_b, a·b = N): two
+# dense contractions against small ±1 Hadamard factors that run on the
+# MXU. N(√N+√N) MXU FLOPs beat N·log2(N) VPU passes (each a strided
+# reshape across the whole array) well before N = 512 on TPU; the two
+# paths are exact-arithmetic-identical (±1 entries, f32 adds).
+_MATMUL_MIN_N = 512
+
+
+@functools.lru_cache(maxsize=None)
+def _hadamard_np(n: int):
+    """Dense Sylvester Hadamard H_n (±1, natural ordering), n = 2^k."""
+    H = np.ones((1, 1), np.float32)
+    while H.shape[0] < n:
+        H = np.block([[H, H], [H, -H]])
+    return H
+
+
+@functools.partial(jax.jit, static_argnames="axis")
+def _wht_matmul(A: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """WHT along ``axis`` as H_a · X · H_b over the (a, b)-folded axis.
+
+    Sylvester ordering is kron-associative (H_{2^k} = H_2^{⊗k}), so for
+    any split a·b = N, row-major folding x[p·b+q] = X[p, q] gives
+    (H_a ⊗ H_b)x = vec(H_a X H_bᵀ); H is symmetric, hence H_a X H_b.
+    Jitted so the Hadamard factors are baked into the program as
+    constants."""
+    x = jnp.moveaxis(A, axis, -1)
+    n = x.shape[-1]
+    k = n.bit_length() - 1
+    a = 1 << (k - k // 2)
+    b = 1 << (k // 2)
+    Ha = jnp.asarray(_hadamard_np(a), x.dtype)
+    Hb = jnp.asarray(_hadamard_np(b), x.dtype)
+    X = x.reshape(x.shape[:-1] + (a, b))
+    Y = jnp.einsum("ia,...ab,bj->...ij", Ha, X, Hb)
+    return jnp.moveaxis(Y.reshape(x.shape), -1, axis)
+
+
+def _wht_butterfly(A: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+    """log2(N) in-register butterfly passes (the SpiralWHT shape)."""
     if axis != 0:
-        return jnp.moveaxis(wht(jnp.moveaxis(A, axis, 0)), 0, axis)
+        return jnp.moveaxis(_wht_butterfly(jnp.moveaxis(A, axis, 0)), 0, axis)
     n = A.shape[0]
-    if n & (n - 1):
-        raise ValueError(f"WHT requires power-of-2 length, got {n}")
     orig_shape = A.shape
     x = A.reshape(n, -1)
     h = 1
@@ -92,6 +129,19 @@ def wht(A: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
         x = jnp.stack([a + b, a - b], axis=1).reshape(n, -1)
         h *= 2
     return x.reshape(orig_shape)
+
+
+def wht(A: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+    """Fast Walsh-Hadamard transform (natural/Hadamard ordering), N = 2^k
+    (SpiralWHT analog, ref: sketch/FUT.hpp:225-347). Unnormalized,
+    self-inverse up to N. Large lengths take the MXU matmul formulation
+    (:func:`_wht_matmul`); small ones the VPU butterfly."""
+    n = A.shape[axis]
+    if n & (n - 1):
+        raise ValueError(f"WHT requires power-of-2 length, got {n}")
+    if n >= _MATMUL_MIN_N:
+        return _wht_matmul(A, axis)
+    return _wht_butterfly(A, axis)
 
 
 class FUT:

@@ -401,13 +401,18 @@ def _qualify(dist, A, seq_axis: int, m_tile: int, interpret: bool,
     m_tile = min(m_tile, m)
     while m % m_tile:
         m_tile //= 2
-    while (m_tile > 8
-           and _vmem_estimate(m_tile, s_dim, 0) > _VMEM_BUDGET_BYTES):
-        m_tile //= 2
-    if _vmem_estimate(m_tile, s_dim, 0) > _VMEM_BUDGET_BYTES:
-        # even the smallest tile can't fit (the generation term scales
-        # with s_dim alone) — XLA fallback instead of a Mosaic abort
-        return None
+    while _vmem_estimate(m_tile, s_dim, 0) > _VMEM_BUDGET_BYTES:
+        # shrink only through tiles that keep the invariants: ≥ 8, a
+        # multiple of 8 (sublane tiling), and a divisor of the padded m.
+        # (m_tile may be the non-power-of-2 m itself via min(m_tile, m),
+        # so blind halving could land on a misaligned tile.)
+        half = m_tile // 2
+        if half >= 8 and half % 8 == 0 and m % half == 0:
+            m_tile = half
+        else:
+            # no smaller valid tile fits (the generation term scales with
+            # s_dim alone) — XLA fallback instead of a Mosaic abort
+            return None
     return m_tile
 
 

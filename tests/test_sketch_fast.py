@@ -53,6 +53,19 @@ class TestFUT:
         with pytest.raises(ValueError, match="power-of-2"):
             fut.wht(jnp.zeros((12, 2)))
 
+    @pytest.mark.parametrize("n", [512, 2048])
+    @pytest.mark.parametrize("axis", [0, 1])
+    def test_wht_matmul_path_matches_butterfly(self, n, axis):
+        """Lengths ≥ _MATMUL_MIN_N route through the kron-factored MXU
+        matmul (H_N = H_a ⊗ H_b); it must equal the VPU butterfly bit for
+        bit in exact arithmetic terms (±1 factors, same adds) — here to
+        f32 tolerance on random input, any axis."""
+        shape = (n, 3) if axis == 0 else (3, n)
+        x = _rand(*shape)
+        got = np.asarray(fut.wht(jnp.asarray(x), axis=axis))
+        want = np.asarray(fut._wht_butterfly(jnp.asarray(x), axis=axis))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-3)
+
     @pytest.mark.parametrize("name,n", [("dct", 20), ("dht", 20), ("wht", 16)])
     def test_scaled_fut_near_orthogonal(self, name, n):
         """scale·F preserves norms approximately (exactly for WHT/DHT;
