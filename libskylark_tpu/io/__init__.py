@@ -29,6 +29,7 @@ from libskylark_tpu.io.chunked import (
     scan_libsvm_dims,
     stream_sketch_libsvm,
 )
+from libskylark_tpu.io.webhdfs import webhdfs_lines
 
 __all__ = [
     "read_libsvm",
@@ -45,4 +46,5 @@ __all__ = [
     "read_libsvm_sharded",
     "scan_libsvm_dims",
     "stream_sketch_libsvm",
+    "webhdfs_lines",
 ]

@@ -179,6 +179,7 @@ def read_libsvm_sharded(
     batch_rows: int = 4096,
     max_n: int = -1,
     dtype=np.float32,
+    dims: Optional[Tuple[int, ...]] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Stream a libsvm source directly into a row-sharded device array.
 
@@ -190,15 +191,28 @@ def read_libsvm_sharded(
     one batch plus one shard, independent of n. Ragged n (not divisible
     by the mesh axis) zero-pads the last shard; the returned array is
     sliced back to n rows.
+
+    A path source is scanned first (the reference's two-pass discipline,
+    ref: libsvm_io.hpp:44-82). One-shot stream sources (e.g.
+    :func:`libskylark_tpu.io.webhdfs.webhdfs_lines`) can't be re-read:
+    pass ``dims=(n, d)`` (or ``(n, d, n_targets)``) from a prior
+    :func:`scan_libsvm_dims` over a fresh stream.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    if isinstance(source, (str, bytes)) or hasattr(source, "__fspath__"):
+    if dims is not None:
+        n, d = int(dims[0]), int(dims[1])
+        nt = int(dims[2]) if len(dims) > 2 else 1
+        # bound the read at n rows (a stream that has grown since the
+        # scan must not overrun the shard plan) …
+        max_n = n if max_n < 0 else min(max_n, n)
+    elif isinstance(source, (str, bytes)) or hasattr(source, "__fspath__"):
         n, d, nt = scan_libsvm_dims(source, max_n)
     else:
         raise errors.InvalidParametersError(
-            "read_libsvm_sharded needs a re-readable path (streams: use "
-            "iter_libsvm_batches + your own placement)"
+            "read_libsvm_sharded on a one-shot stream needs dims=(n, d): "
+            "scan a fresh stream with scan_libsvm_dims first (paths are "
+            "scanned automatically)"
         )
     if n == 0:
         raise errors.IOError_(
@@ -225,10 +239,12 @@ def read_libsvm_sharded(
     x_parts, y_parts = [], []
     filled = 0
     si = 0
+    consumed = 0
     for Xb, Yb in iter_libsvm_batches(
         source, batch_rows, d=d, max_n=max_n, dtype=dtype
     ):
         Yb = Yb.reshape(len(Xb), -1)
+        consumed += len(Xb)
         while len(Xb):
             take = min(bs - filled, len(Xb))
             xs.append(Xb[:take])
@@ -241,6 +257,13 @@ def read_libsvm_sharded(
                 xs, ys = [], []
                 filled = 0
                 si += 1
+    if dims is not None and consumed < n:
+        # … and a stream that has SHRUNK must not have its missing rows
+        # fabricated as zero-padding (silent data corruption)
+        raise errors.IOError_(
+            f"read_libsvm_sharded: dims promised {n} examples but the "
+            f"stream yielded {consumed}"
+        )
     if filled or si < p:
         # ragged tail: zero-pad the final shard; later shards are zeros
         tail_x = np.concatenate(xs) if xs else np.zeros((0, d), dtype)

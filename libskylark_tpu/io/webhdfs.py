@@ -1,0 +1,89 @@
+"""WebHDFS transport for the streaming readers.
+
+TPU-native analog of the reference's libhdfs line streamer
+(ref: utility/hdfs.hpp:11 ``hdfs_line_streamer_t``, used by the HDFS
+reader variants in utility/io/libsvm_io.hpp:1395-1876). The reference
+links libhdfs (JNI) and reads through a buffered ``hdfsRead`` loop; here
+the transport speaks HDFS's standard REST interface (WebHDFS,
+``GET /webhdfs/v1/<path>?op=OPEN``) over stdlib ``urllib`` — no native
+client required — and yields decoded text lines, which is exactly the
+seam every reader in :mod:`libskylark_tpu.io.chunked` accepts
+(``iter_libsvm_batches(webhdfs_lines(...))``,
+``read_libsvm_sharded(webhdfs_lines(...), mesh)``, ...).
+
+The namenode answers OPEN with a 307 redirect to the datanode that owns
+the first block; ``urllib`` follows it transparently. Reads stream in
+``buffer_bytes`` chunks with a carry for the partial last line — memory
+stays O(buffer), matching the reference's bounded ``hdfsRead`` buffer
+discipline.
+
+Offline environments: the transport is exercised against a local REST
+stub in tests/test_io_chunked.py (a real HDFS namenode is just the same
+protocol on another host).
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+import urllib.request
+from typing import Iterator, Optional
+
+from libskylark_tpu.base import errors
+
+
+def _open_url(namenode: str, path: str, user: Optional[str],
+              offset: int, length: Optional[int],
+              buffer_bytes: int, timeout: float):
+    if not path.startswith("/"):
+        path = "/" + path
+    params = {"op": "OPEN", "buffersize": str(buffer_bytes)}
+    if user:
+        params["user.name"] = user
+    if offset:
+        params["offset"] = str(offset)
+    if length is not None:
+        params["length"] = str(length)
+    url = (namenode.rstrip("/") + "/webhdfs/v1" +
+           urllib.parse.quote(path) + "?" + urllib.parse.urlencode(params))
+    try:
+        return urllib.request.urlopen(url, timeout=timeout)
+    except Exception as e:  # pragma: no cover - network-specific messages
+        raise errors.IOError_(
+            f"webhdfs OPEN failed for {path!r} via {namenode!r}: {e}"
+        ) from e
+
+
+def webhdfs_lines(
+    namenode: str,
+    path: str,
+    user: Optional[str] = None,
+    offset: int = 0,
+    length: Optional[int] = None,
+    buffer_bytes: int = 1 << 20,
+    encoding: str = "utf-8",
+    timeout: float = 60.0,
+) -> Iterator[str]:
+    """Stream the lines of an HDFS file through WebHDFS.
+
+    ``namenode`` is the REST endpoint (``http://host:9870``); ``path``
+    the absolute HDFS path. Yields text lines (newline stripped by the
+    consumer — same contract as a file handle). O(buffer_bytes) memory.
+    """
+    resp = _open_url(namenode, path, user, offset, length,
+                     buffer_bytes, timeout)
+    carry = b""
+    try:
+        while True:
+            chunk = resp.read(buffer_bytes)
+            if not chunk:
+                break
+            carry += chunk
+            # split out complete lines; keep the partial tail
+            if b"\n" in carry:
+                complete, carry = carry.rsplit(b"\n", 1)
+                for line in complete.split(b"\n"):
+                    yield line.decode(encoding) + "\n"
+    finally:
+        resp.close()
+    if carry:
+        yield carry.decode(encoding)

@@ -401,18 +401,17 @@ def _qualify(dist, A, seq_axis: int, m_tile: int, interpret: bool,
     m_tile = min(m_tile, m)
     while m % m_tile:
         m_tile //= 2
-    while _vmem_estimate(m_tile, s_dim, 0) > _VMEM_BUDGET_BYTES:
-        # shrink only through tiles that keep the invariants: ≥ 8, a
-        # multiple of 8 (sublane tiling), and a divisor of the padded m.
-        # (m_tile may be the non-power-of-2 m itself via min(m_tile, m),
-        # so blind halving could land on a misaligned tile.)
-        half = m_tile // 2
-        if half >= 8 and half % 8 == 0 and m % half == 0:
-            m_tile = half
-        else:
-            # no smaller valid tile fits (the generation term scales with
-            # s_dim alone) — XLA fallback instead of a Mosaic abort
-            return None
+    if _vmem_estimate(m_tile, s_dim, 0) > _VMEM_BUDGET_BYTES:
+        # scan smaller valid tiles — ≥ 8, multiples of 8 (sublane
+        # tiling), divisors of the padded m — largest first. (m_tile may
+        # be the non-power-of-2 m itself via min(m_tile, m), so blind
+        # halving could skip valid tiles or land misaligned.)
+        for t in range(min(m_tile - 8, _pad_to(m_tile // 2, 8)), 7, -8):
+            if m % t == 0 and _vmem_estimate(t, s_dim, 0) <= _VMEM_BUDGET_BYTES:
+                return t
+        # no valid tile fits (the generation term scales with s_dim
+        # alone) — XLA fallback instead of a Mosaic abort
+        return None
     return m_tile
 
 

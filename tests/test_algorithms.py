@@ -126,6 +126,7 @@ class TestCG:
 
 
 class TestRandBlock:
+    @pytest.mark.slow
     def test_gauss_seidel_converges(self):
         A, B = _spd_problem(100, seed=5, cond=20.0)
         x, sweeps = alg.asynch.rand_block_gauss_seidel(
@@ -136,6 +137,7 @@ class TestRandBlock:
         np.testing.assert_allclose(np.asarray(x), np.linalg.solve(A, B),
                                    atol=5e-3)
 
+    @pytest.mark.slow
     def test_fcg_with_gs_preconditioner(self):
         A, B = _spd_problem(64, seed=6, cond=200.0)
         x, it = alg.asynch.rand_block_fcg(

@@ -93,6 +93,7 @@ class TestStream:
             part = randgen.dense_panel(self.key, dist, rows, lo, hi, bc)
             np.testing.assert_array_equal(np.asarray(full[:, lo:hi]), np.asarray(part))
 
+    @pytest.mark.slow
     def test_distribution_statistics(self):
         n = 1 << 16
         normal = np.asarray(randgen.stream_slice(self.key, randgen.Normal(), 0, n))
@@ -217,6 +218,7 @@ class TestSequenceParallelApply:
         seq = np.asarray(shard_apply.rowwise(T, A, mesh1d))
         np.testing.assert_allclose(seq, local, atol=1e-3, rtol=1e-3)
 
+    @pytest.mark.slow
     def test_ragged_n_matches_local(self, mesh1d, devices):
         """Non-dividing N zero-pads exactly — the np∈{5,7} ragged-layout
         discipline (ref: tests/unit/CMakeLists.txt:31-33), including on a
@@ -252,6 +254,7 @@ class TestSequenceParallelApply:
             shard_apply.columnwise(cwt, np.zeros((2048, 4), np.float32),
                                    mesh1d)
 
+    @pytest.mark.slow
     def test_pallas_fused_pipeline_interpret(self, mesh1d):
         """The fused kernel runs per-device inside the shard_map pipeline
         (interpret mode on the CPU mesh) and matches the local apply —

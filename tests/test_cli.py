@@ -89,6 +89,7 @@ class TestSVDCli:
         assert rc == 0
         assert np.loadtxt(prefix + ".S.txt").shape == (3,)
 
+    @pytest.mark.slow
     def test_arclist_symmetric(self, graph_file, tmp_path):
         prefix = str(tmp_path / "g")
         rc = skylark_svd.main([graph_file, "--filetype", "ARC_LIST",
@@ -106,6 +107,7 @@ class TestLinearCli:
         x = np.loadtxt(prefix + ".x.txt")
         assert np.linalg.norm(x - w) / np.linalg.norm(w) < 0.2
 
+    @pytest.mark.slow
     def test_streaming_matches_whole_file(self, regression_file, tmp_path):
         path, X, y = regression_file
         p1, p2 = str(tmp_path / "a"), str(tmp_path / "b")
@@ -127,6 +129,7 @@ class TestLinearCli:
 
 
 class TestMLCli:
+    @pytest.mark.slow
     def test_train_and_test_classification(self, classification_file,
                                            tmp_path):
         model = str(tmp_path / "model.json")
@@ -139,6 +142,7 @@ class TestMLCli:
                               "--modelfile", model])
         assert rc == 0
 
+    @pytest.mark.slow
     def test_train_streaming_matches_whole_file(self, classification_file,
                                                 tmp_path):
         """--streaming ingestion trains to the same model as the

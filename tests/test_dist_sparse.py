@@ -70,6 +70,7 @@ def test_todense_matches(mesh2d):
 
 
 @pytest.mark.parametrize("hw", [(64, 48), (53, 41)])
+@pytest.mark.slow
 def test_spmm_oracle(hw, mesh1d, mesh2d, devices):
     h, w = hw
     A = _rand_sparse(h, w, seed=3)
@@ -85,6 +86,7 @@ def test_spmm_oracle(hw, mesh1d, mesh2d, devices):
 
 
 @pytest.mark.parametrize("hw", [(64, 48), (53, 41)])
+@pytest.mark.slow
 def test_spmm_t_oracle(hw, mesh1d, mesh2d, devices):
     h, w = hw
     A = _rand_sparse(h, w, seed=5)
@@ -116,6 +118,7 @@ def test_spmm_vector(mesh2d):
 
 
 @pytest.mark.parametrize("Tcls", [CWT, MMT, WZT], ids=lambda c: c.__name__)
+@pytest.mark.slow
 def test_hash_columnwise_dist_oracle(Tcls, mesh1d, mesh2d, devices):
     n, w, s = 100, 37, 24
     A = _rand_sparse(n, w, seed=9)
@@ -131,6 +134,7 @@ def test_hash_columnwise_dist_oracle(Tcls, mesh1d, mesh2d, devices):
 
 
 @pytest.mark.parametrize("Tcls", [CWT, MMT], ids=lambda c: c.__name__)
+@pytest.mark.slow
 def test_hash_rowwise_dist_oracle(Tcls, mesh1d, mesh2d, devices):
     m, n, s = 37, 100, 24
     A = _rand_sparse(m, n, seed=10)
@@ -146,6 +150,7 @@ def test_hash_rowwise_dist_oracle(Tcls, mesh1d, mesh2d, devices):
 
 
 @pytest.mark.parametrize("Tcls", [JLT, CT], ids=lambda c: c.__name__)
+@pytest.mark.slow
 def test_dense_rowwise_dist_oracle(Tcls, mesh1d, mesh2d, devices):
     m, n, s = 29, 300, 16
     A = _rand_sparse(m, n, seed=11)
@@ -165,6 +170,7 @@ def test_dense_rowwise_dist_oracle(Tcls, mesh1d, mesh2d, devices):
 
 
 @pytest.mark.parametrize("Tcls", [JLT], ids=lambda c: c.__name__)
+@pytest.mark.slow
 def test_dense_columnwise_dist_oracle(Tcls, mesh1d, mesh2d, devices):
     n, w, s = 300, 29, 16
     A = _rand_sparse(n, w, seed=12)
@@ -181,6 +187,7 @@ def test_dense_columnwise_dist_oracle(Tcls, mesh1d, mesh2d, devices):
 
 
 @pytest.mark.parametrize("cw", [True, False], ids=["columnwise", "rowwise"])
+@pytest.mark.slow
 def test_hash_sparse_to_sparse_dist(cw, mesh1d, mesh2d, devices):
     """Sparse→sparse distributed hash apply (SpParMat→SpParMat analog):
     the distributed sparse result must densify to the local sparse→sparse
@@ -203,6 +210,7 @@ def test_hash_sparse_to_sparse_dist(cw, mesh1d, mesh2d, devices):
         )
 
 
+@pytest.mark.slow
 def test_hash_sparse_chained_pad_bounded(mesh2d, devices):
     """Chained sparse→sparse applies must not compound padded slots by the
     merged-axis factor each round (advisor r2: re-bucket/compact after the
@@ -230,6 +238,7 @@ def test_hash_sparse_chained_pad_bounded(mesh2d, devices):
 
 
 @pytest.mark.parametrize("replace", [True, False], ids=["with", "without"])
+@pytest.mark.slow
 def test_ust_dist_oracle(replace, mesh1d, mesh2d, devices):
     """Row/col sampling of a distributed sparse matrix == local gather
     (incl. with-replacement duplicate slots)."""
@@ -252,6 +261,7 @@ def test_ust_dist_oracle(replace, mesh1d, mesh2d, devices):
                                    err_msg=str(axes))
 
 
+@pytest.mark.slow
 def test_rft_dist_sparse_oracle(mesh2d, devices):
     """Random-feature maps on a distributed sparse input == local sparse
     apply (kernel features from sparse libsvm-style data at scale)."""
@@ -286,6 +296,7 @@ def test_transpose(mesh2d):
     )
 
 
+@pytest.mark.slow
 def test_approximate_svd_on_dist_sparse(mesh2d):
     """Randomized SVD on sparse operands without densifying (the
     reference's sparse branch, ref: nla/skylark_svd.cpp:129-215) — local
@@ -315,6 +326,7 @@ def test_approximate_svd_on_dist_sparse(mesh2d):
                                rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.slow
 def test_empty_cells_ok(mesh2d):
     """A matrix whose nonzeros all land in one grid cell — the other cells
     are pure padding."""

@@ -7,6 +7,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 sys.path.insert(
     0, str(pathlib.Path(__file__).resolve().parent.parent / "examples")
 )

@@ -111,3 +111,100 @@ def test_hdf5_batches(tmp_path):
     xs, ys = zip(*skio.iter_hdf5_batches(p, 8))
     np.testing.assert_allclose(np.concatenate(xs), X, atol=1e-6)
     np.testing.assert_allclose(np.concatenate(ys), Y, atol=1e-6)
+
+
+class _WebHDFSStub:
+    """Minimal in-process WebHDFS REST endpoint: the namenode answers OPEN
+    with a 307 redirect to a /data URL on the same server (the
+    namenode→datanode hop of the real protocol), which then streams the
+    file bytes. Runs on 127.0.0.1 — exercises the full urllib path of
+    io/webhdfs.py without any external service."""
+
+    def __init__(self, files: dict):
+        import http.server
+        import threading
+
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence
+                pass
+
+            def do_GET(self):
+                from urllib.parse import parse_qs, urlparse
+
+                u = urlparse(self.path)
+                if u.path.startswith("/webhdfs/v1"):
+                    q = parse_qs(u.query)
+                    assert q.get("op") == ["OPEN"], q
+                    hdfs_path = u.path[len("/webhdfs/v1"):]
+                    self.send_response(307)
+                    self.send_header(
+                        "Location",
+                        f"http://127.0.0.1:{stub.port}/data{hdfs_path}")
+                    self.end_headers()
+                elif u.path.startswith("/data"):
+                    body = stub.files.get(u.path[len("/data"):])
+                    if body is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+        self.files = files
+        self.httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_webhdfs_transport_lines(tmp_path):
+    """webhdfs_lines streams a file through the REST protocol (with the
+    namenode→datanode redirect) and yields the same lines as local open —
+    including a file without a trailing newline and multi-chunk reads."""
+    content = "".join(f"line {i} αβ\n" for i in range(500)) + "tail-no-nl"
+    stub = _WebHDFSStub({"/user/x/data.txt": content.encode()})
+    try:
+        got = list(skio.webhdfs_lines(
+            stub.url, "/user/x/data.txt", buffer_bytes=256))
+    finally:
+        stub.close()
+    assert got == content.splitlines(keepends=True)
+
+
+def test_webhdfs_feeds_the_reader_seam(tmp_path, mesh1d):
+    """The transport plugs into the chunked readers: read_libsvm_sharded
+    off a WebHDFS stream == local file read (ref: the reference's HDFS
+    libsvm variants, utility/io/libsvm_io.hpp:1395-1876)."""
+    p, _, _ = _write_libsvm(tmp_path, n=24, seed=11)
+    with open(p) as fh:
+        body = fh.read().encode()
+    stub = _WebHDFSStub({"/ds/train.libsvm": body})
+    try:
+        X1, Y1 = skio.read_libsvm(p)
+        # dims scan + data pass are two separate streams over the seam
+        n, d, _ = skio.scan_libsvm_dims(
+            skio.webhdfs_lines(stub.url, "/ds/train.libsvm"))
+        X, Y = skio.read_libsvm_sharded(
+            skio.webhdfs_lines(stub.url, "/ds/train.libsvm"), mesh1d,
+            batch_rows=7, dims=(n, d))
+    finally:
+        stub.close()
+    np.testing.assert_allclose(np.asarray(X), X1, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(Y), Y1, atol=1e-6)

@@ -30,6 +30,7 @@ def problem():
     return A, B
 
 
+@pytest.mark.slow
 def test_lsqr_sharded_matches_local(problem, mesh1d):
     A, B = problem
     X0, it0 = lsqr(A, B, KrylovParams(tolerance=1e-8, iter_lim=200))

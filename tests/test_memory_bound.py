@@ -72,6 +72,7 @@ def test_blocked_apply_is_panel_bounded(dimension):
     )
 
 
+@pytest.mark.slow
 def test_auto_blocking_guards_huge_operators():
     """With blocksize unset, an apply whose operator exceeds the
     auto-block threshold takes the panel path anyway — the memory-safety
@@ -98,6 +99,7 @@ def test_auto_blocking_guards_huge_operators():
     np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_unblocked_apply_does_materialize():
     """Sanity check on the measuring stick: with blocking off, the full
     operator IS an intermediate — so the blocked assertion above is

@@ -95,6 +95,7 @@ class TestBlockADMMLinear:
 
 class TestBlockADMMKernel:
     @pytest.mark.parametrize("loss", [prox.HingeLoss(), prox.LogisticLoss()])
+    @pytest.mark.slow
     def test_classification(self, loss):
         X, y = _blobs()
         solver = ml.BlockADMMSolver.from_kernel(
@@ -107,6 +108,7 @@ class TestBlockADMMKernel:
         labels, _ = model.predict(X)
         assert (np.asarray(labels) == y).mean() > 0.9
 
+    @pytest.mark.slow
     def test_model_round_trip_after_training(self, tmp_path):
         X, y = _blobs(seed=5)
         solver = ml.BlockADMMSolver.from_kernel(
@@ -123,6 +125,7 @@ class TestBlockADMMKernel:
         l2, _ = m2.predict(X)
         np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
 
+    @pytest.mark.slow
     def test_cache_transforms_same_result(self):
         X, y = _linear_data(n=40, d=4, seed=7)
         def run(cache):

@@ -44,6 +44,7 @@ class TestKernelRidge:
         resid = (K + lam * np.eye(len(X))) @ np.asarray(A) - Y
         assert np.max(np.abs(resid)) < 1e-3
 
+    @pytest.mark.slow
     def test_faster_matches_exact(self):
         X, Y = _regression_data(seed=2)
         k = ml.Gaussian(X.shape[1], sigma=2.0)
@@ -81,6 +82,7 @@ class TestApproximateKernelRidge:
         resid = (Z.T @ Z + lam * np.eye(64)) @ np.asarray(W) - Z.T @ Y
         assert np.max(np.abs(resid)) < 1e-3
 
+    @pytest.mark.slow
     def test_sketched_rr_close_to_unsketched(self):
         X, Y = _regression_data(n=200, seed=5)
         k = ml.Gaussian(X.shape[1], sigma=2.0)
@@ -109,6 +111,7 @@ class TestApproximateKernelRidge:
 
 
 class TestSketchedApproximateKernelRidge:
+    @pytest.mark.slow
     def test_splits_and_shapes(self):
         X, Y = _regression_data(n=80, seed=7)
         k = ml.Gaussian(X.shape[1], sigma=2.0)
@@ -120,6 +123,7 @@ class TestSketchedApproximateKernelRidge:
         assert len(transforms) > 1
         assert W.shape == (48, 1)
 
+    @pytest.mark.slow
     def test_unbounded_split_schedule(self):
         """max_split=0 -> sinc = input dim, last chunk absorbs <= 2*sinc
         (ref: ml/krr.hpp:246-248)."""
@@ -132,6 +136,7 @@ class TestSketchedApproximateKernelRidge:
 
 
 class TestLargeScaleKernelRidge:
+    @pytest.mark.slow
     def test_normal_equations_at_convergence(self):
         X, Y = _regression_data(n=70, seed=9)
         k = ml.Gaussian(X.shape[1], sigma=2.0)
@@ -175,6 +180,7 @@ class TestRLSC:
         pred = ml.dummy_decode(jnp.asarray(scores), coding)
         assert (pred == y).mean() > 0.95
 
+    @pytest.mark.slow
     def test_large_scale_rlsc_separates(self):
         X, y = _blobs(seed=4)
         k = ml.Gaussian(X.shape[1], sigma=3.0)

@@ -47,6 +47,41 @@ class TestShardedKRR:
                                    atol=1e-3, rtol=1e-3)
 
 
+class TestShardedKRRRagged:
+    """The np=5 discipline for ml/: a 5-device submesh runs the same
+    solver paths at a non-power-of-2 device count
+    (ref: tests/unit/CMakeLists.txt:31-33 — rank counts 1/4/5/7). Dense
+    shardings need divisible extents (250 = 5·50); truly non-dividing
+    layouts live in the dist-sparse suite."""
+
+    @pytest.mark.slow
+    def test_kernel_ridge_ragged_submesh(self, data, devices):
+        X, Y = data
+        X, Y = X[:250], Y[:250]
+        mesh5 = par.make_mesh(devices=devices[:5])
+        k = kernels.Gaussian(X.shape[1], sigma=2.0)
+        local = np.asarray(
+            krr.kernel_ridge(k, jnp.asarray(X), jnp.asarray(Y), 0.01))
+        Xs = par.distribute(X, par.row_sharded(mesh5))
+        Ys = par.distribute(Y, par.vec_sharded(mesh5))
+        sharded = np.asarray(krr.kernel_ridge(k, Xs, Ys, 0.01))
+        np.testing.assert_allclose(sharded, local, atol=1e-3, rtol=1e-3)
+
+    def test_approximate_kernel_ridge_ragged_submesh(self, data, devices):
+        X, Y = data
+        X, Y = X[:250], Y[:250]
+        mesh5 = par.make_mesh(devices=devices[:5])
+        k = kernels.Gaussian(X.shape[1], sigma=2.0)
+        fmap_l, w_l = krr.approximate_kernel_ridge(
+            k, jnp.asarray(X), jnp.asarray(Y), 0.01, s=64,
+            context=Context(seed=3))
+        Xs = par.distribute(X, par.row_sharded(mesh5))
+        fmap_s, w_s = krr.approximate_kernel_ridge(
+            k, Xs, jnp.asarray(Y), 0.01, s=64, context=Context(seed=3))
+        np.testing.assert_allclose(np.asarray(w_s), np.asarray(w_l),
+                                   atol=1e-3, rtol=1e-3)
+
+
 class TestShardedADMM:
     def test_train_sharded_matches_local(self, data, mesh1d):
         from libskylark_tpu.algorithms.prox import (
@@ -67,6 +102,32 @@ class TestShardedADMM:
 
         local = train(jnp.asarray(X))
         sharded = train(par.distribute(X, par.row_sharded(mesh1d)))
+        np.testing.assert_allclose(
+            np.asarray(sharded.coef), np.asarray(local.coef),
+            atol=1e-3, rtol=1e-3)
+
+    def test_train_ragged_submesh_matches_local(self, data, devices):
+        """ADMM at the np=5 device count (250 = 5·50 examples)."""
+        from libskylark_tpu.algorithms.prox import (
+            L2Regularizer,
+            SquaredLoss,
+        )
+        from libskylark_tpu.ml.admm import BlockADMMSolver
+
+        X, Y = data
+        X, Y = X[:250], Y[:250]
+        y = (Y > 0).astype(np.int64)
+        mesh5 = par.make_mesh(devices=devices[:5])
+
+        def train(Xin):
+            s = BlockADMMSolver(SquaredLoss(), L2Regularizer(), 0.01,
+                                X.shape[1], num_partitions=2)
+            s.maxiter = 6
+            s.tol = 0.0
+            return s.train(Xin, y)
+
+        local = train(jnp.asarray(X))
+        sharded = train(par.distribute(X, par.row_sharded(mesh5)))
         np.testing.assert_allclose(
             np.asarray(sharded.coef), np.asarray(local.coef),
             atol=1e-3, rtol=1e-3)

@@ -33,6 +33,7 @@ class TestApproximateSVD:
         err = np.linalg.norm(recon - A) / np.linalg.norm(A)
         assert err < 1e-4
 
+    @pytest.mark.slow
     def test_wide_matrix_branch(self):
         A = _lowrank(60, 300, 5, seed=2)
         U, S, V = nla.approximate_svd(jnp.asarray(A), 5, Context(seed=5),
@@ -56,6 +57,7 @@ class TestApproximateSVD:
         np.testing.assert_allclose(np.asarray(V.T @ V), np.eye(8), atol=1e-4)
         assert (np.diff(np.asarray(S)) <= 1e-6).all()  # descending
 
+    @pytest.mark.slow
     def test_power_iteration_improves_noisy(self):
         A = _lowrank(300, 200, 10, seed=5, noise=0.5)
         best = np.linalg.svd(A, compute_uv=False)
@@ -182,6 +184,7 @@ class TestCondEst:
         e_sparse = nla.estimate_condition(A, Context(seed=43))
         np.testing.assert_allclose(e_sparse, e_dense, rtol=5e-3)
 
+    @pytest.mark.slow
     def test_dist_sparse_operand_never_materializes(self, mesh2d,
                                                     monkeypatch):
         """DistSparseMatrix operands drive the Golub-Kahan recurrence ON

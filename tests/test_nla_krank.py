@@ -161,6 +161,7 @@ class TestRandEVD:
 
 
 class TestLowrank:
+    @pytest.mark.slow
     def test_dominant_subspace(self):
         from libskylark_tpu.nla.lowrank import (
             approximate_dominant_subspace_basis,
@@ -184,6 +185,7 @@ def _classification_data(n=300, d=8, seed=0):
 
 
 class TestNonlinear:
+    @pytest.mark.slow
     def test_rls(self):
         from libskylark_tpu.ml.kernels import Gaussian
         from libskylark_tpu.ml.metrics import classification_accuracy
@@ -208,6 +210,7 @@ class TestNonlinear:
         assert classification_accuracy(pred, y[200:]) > 75
 
     @pytest.mark.parametrize("probdist", ["uniform", "leverages"])
+    @pytest.mark.slow
     def test_nystromrls(self, probdist):
         from libskylark_tpu.ml.kernels import Gaussian
         from libskylark_tpu.ml.metrics import classification_accuracy
@@ -220,6 +223,7 @@ class TestNonlinear:
         pred = model.predict(X[200:])
         assert classification_accuracy(pred, y[200:]) > 75
 
+    @pytest.mark.slow
     def test_sketchpcr(self):
         from libskylark_tpu.ml.kernels import Gaussian
         from libskylark_tpu.ml.metrics import classification_accuracy

@@ -7,6 +7,8 @@ import pathlib
 import nbformat
 import pytest
 
+pytestmark = pytest.mark.slow
+
 NB_DIR = pathlib.Path(__file__).resolve().parent.parent / "examples" / "notebooks"
 NOTEBOOKS = sorted(NB_DIR.glob("*.ipynb"))
 

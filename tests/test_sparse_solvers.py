@@ -36,6 +36,7 @@ def problem():
     return A, dense, b, x_true
 
 
+@pytest.mark.slow
 def test_lsqr_sparse_operand(problem):
     A, dense, b, x_true = problem
     x, _ = lsqr(A, b, KrylovParams(tolerance=1e-8, iter_lim=500))
@@ -45,6 +46,7 @@ def test_lsqr_sparse_operand(problem):
                                atol=1e-3, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_blendenpik_sparse_operand(problem):
     """fast_least_squares on a SparseMatrix: CWT preconditioner + LSQR
     through sparse matvecs; solution matches the dense run."""
@@ -56,6 +58,7 @@ def test_blendenpik_sparse_operand(problem):
     assert int(it) > 0  # no exact fallback
 
 
+@pytest.mark.slow
 def test_blendenpik_dist_sparse_operand(problem, mesh1d):
     A, dense, b, x_true = problem
     D = distribute_sparse(A, mesh1d, row_axis="rows")

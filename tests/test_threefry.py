@@ -74,6 +74,7 @@ class TestDenseBlockFormat:
         )(jnp.int32(5))
         np.testing.assert_array_equal(np.asarray(host), np.asarray(traced))
 
+    @pytest.mark.slow
     def test_fallback_distribution(self):
         """Distributions without a bit transform keep the legacy sample()
         definition."""

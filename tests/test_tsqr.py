@@ -54,6 +54,7 @@ def test_sharded_matches_local(mesh1d):
                                atol=1e-3, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_rand_svd_with_cqr2_matches_qr(mesh1d):
     """approximate_svd(ortho='cqr2') tracks the Householder-QR result on
     the same streams, local and sharded."""

@@ -11,6 +11,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from libskylark_tpu.base.context import Context
