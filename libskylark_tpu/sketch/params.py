@@ -68,15 +68,17 @@ def set_use_pallas(on: bool) -> None:
 
 
 # ``pallas_precision`` — contraction regime inside the fused kernel.
-# "f32" (default): full-f32 MXU passes (Precision.HIGHEST); the fused
-# apply stays within the framework's 1e-4 determinism oracle vs the XLA
-# path. "bf16x3": 3-pass bf16 (Precision.HIGH) — f32-grade rounding at
-# roughly half the cost, pending on-chip oracle validation
-# (tests/test_pallas_dense.py::test_fused_on_chip_*). "bf16": single-pass
-# bf16 inputs + f32 accumulation — fastest, but rounds the contraction at
+# "bf16x3" (default): 3-pass error-compensated bf16 split — f32-grade
+# rounding at roughly twice the MXU rate of full-f32 passes;
+# oracle-certified ON CHIP against the XLA path at 1e-4
+# (tests/test_pallas_dense.py::test_fused_on_chip_matches_xla,
+# benchmarks/tpu_validation_r03.txt — the certification the r2 plan
+# required before making it the default). "f32": full-f32 passes
+# (Precision.HIGHEST), the conservative regime. "bf16": single-pass bf16
+# inputs + f32 accumulation — fastest, but rounds the contraction at
 # ~2⁻⁸ relative (outside the oracle for large N); throughput-only work
 # opts in explicitly.
-_pallas_precision = "f32"
+_pallas_precision = "bf16x3"
 
 
 def get_pallas_precision() -> str:
@@ -93,17 +95,23 @@ def set_pallas_precision(p: str) -> None:
 
 
 # ``pallas_m_tile`` — rows of A per fused-kernel grid step. Larger tiles
-# amortize operator generation/caching over more MXU work at the cost of
-# VMEM. Seeded from SKYLARK_PALLAS_MTILE for on-chip sweeps without code
-# changes; invalid values fall back to the default.
+# amortize operator generation over more MXU work at the cost of VMEM:
+# each grid sweep regenerates the whole virtual operator on the VPU
+# (Threefry + inverse-CDF ≈ 50 ops/entry), so at the headline config the
+# generation bill is ~m/m_tile × 0.1 ms/MB — the dominant non-MXU cost
+# (r2 on-chip numbers). 512 halves it vs 256 while keeping the VMEM plan
+# (_vmem_estimate) ≈ 9 MiB at s_dim=1024, inside the 16 MiB budget;
+# _qualify still shrinks per-call when s_dim is larger. Seeded from
+# SKYLARK_PALLAS_MTILE for on-chip sweeps without code changes; invalid
+# values fall back to the default.
 def _env_m_tile() -> int:
     import os
 
     try:
-        v = int(os.environ.get("SKYLARK_PALLAS_MTILE", 256))
+        v = int(os.environ.get("SKYLARK_PALLAS_MTILE", 512))
     except ValueError:
-        return 256
-    return v if v >= 8 else 256
+        return 512
+    return v if v >= 8 else 512
 
 
 _pallas_m_tile = _env_m_tile()
