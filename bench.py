@@ -76,43 +76,48 @@ def run(m: int = 8192, n: int = 8192, s: int = 1024, repeats: int = 5,
     xla_mode = precision.startswith("xla")
     prev_use_pallas = sketch_params.get_use_pallas()
     prev_precision = sketch_params.get_pallas_precision()
-    if xla_mode:
-        sketch_params.set_use_pallas(False)
-        prec_ctx = jax.default_matmul_precision(
-            {"xla_high": "high", "xla_highest": "highest"}[precision])
-    else:
-        sketch_params.set_use_pallas(True)
-        sketch_params.set_pallas_precision(precision)
-        prec_ctx = contextlib.nullcontext()
-    ctx = Context(seed=0)
-    jlt = JLT(n, s, ctx)
-    key = jlt._alloc.key
-    use_pallas = pd.available() and not xla_mode
-
-    rng = np.random.default_rng(1)
-    A = jax.device_put(jnp.asarray(
-        rng.standard_normal((m, n), dtype=np.float32)))
-
-    def one_apply(X):
-        if use_pallas:
-            out = pd.rowwise_apply(key, jlt.dist, X, s, jlt.scale,
-                                   precision=precision)
-            if out is not None:
-                return out
-        return jlt.apply(X, ROWWISE)
-
-    def iterate(X, K):
-        def body(_, acc):
-            SA = one_apply(X + acc)
-            # consume every element of SA; scale keeps the carry ~0 so the
-            # input matrix is numerically unchanged between iterations
-            return jnp.sum(jnp.abs(SA)).astype(jnp.float32) * 1e-37
-        return lax.fori_loop(0, K, body, jnp.float32(0.0))
-
-    k1, k2 = 2, 12
-    f1 = jax.jit(lambda X: iterate(X, k1))
-    f2 = jax.jit(lambda X: iterate(X, k2))
     try:
+        # globals are mutated INSIDE the try: a setup failure (e.g.
+        # device_put on a wedged TPU) must not leak use_pallas=False into
+        # the rest of the process (run_all runs several benches in one
+        # interpreter)
+        if xla_mode:
+            sketch_params.set_use_pallas(False)
+            prec_ctx = jax.default_matmul_precision(
+                {"xla_high": "high", "xla_highest": "highest"}[precision])
+        else:
+            sketch_params.set_use_pallas(True)
+            sketch_params.set_pallas_precision(precision)
+            prec_ctx = contextlib.nullcontext()
+        ctx = Context(seed=0)
+        jlt = JLT(n, s, ctx)
+        key = jlt._alloc.key
+        use_pallas = pd.available() and not xla_mode
+
+        rng = np.random.default_rng(1)
+        A = jax.device_put(jnp.asarray(
+            rng.standard_normal((m, n), dtype=np.float32)))
+
+        def one_apply(X):
+            if use_pallas:
+                out = pd.rowwise_apply(key, jlt.dist, X, s, jlt.scale,
+                                       precision=precision)
+                if out is not None:
+                    return out
+            return jlt.apply(X, ROWWISE)
+
+        def iterate(X, K):
+            def body(_, acc):
+                SA = one_apply(X + acc)
+                # consume every element of SA; scale keeps the carry ~0
+                # so the input matrix is numerically unchanged between
+                # iterations
+                return jnp.sum(jnp.abs(SA)).astype(jnp.float32) * 1e-37
+            return lax.fori_loop(0, K, body, jnp.float32(0.0))
+
+        k1, k2 = 2, 12
+        f1 = jax.jit(lambda X: iterate(X, k1))
+        f2 = jax.jit(lambda X: iterate(X, k2))
         # the precision context must cover the timed calls too, not just
         # the warm-up: jax_default_matmul_precision is part of the trace
         # context, so a call outside it would silently retrace (and time)

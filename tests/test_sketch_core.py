@@ -275,3 +275,61 @@ class TestStreamFormatGate:
         d["stream_format"] = 1
         with pytest.raises(Exception, match="stream format"):
             sk.deserialize_sketch(d)
+
+
+class TestMaterialize:
+    def test_materialized_apply_matches_virtual(self):
+        """materialize() pins S and takes the one-gemm path; results must
+        equal the virtual-operator apply to the oracle (identical entries
+        by construction; only contraction scheduling differs)."""
+        import numpy as np
+
+        from libskylark_tpu.sketch import JLT, ROWWISE, COLUMNWISE
+
+        n, s, m = 512, 64, 40
+        T = JLT(n, s, Context(seed=61))
+        rng = np.random.default_rng(6)
+        A_r = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+        A_c = jnp.asarray(rng.standard_normal((n, m)), jnp.float32)
+        want_r = np.asarray(T.apply(A_r, ROWWISE))
+        want_c = np.asarray(T.apply(A_c, COLUMNWISE))
+        T.materialize()
+        assert T._S_cache is not None
+        np.testing.assert_allclose(np.asarray(T.apply(A_r, ROWWISE)),
+                                   want_r, atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(T.apply(A_c, COLUMNWISE)),
+                                   want_c, atol=1e-4, rtol=1e-4)
+        T.dematerialize()
+        assert T._S_cache is None
+
+    def test_materialized_sparse_apply_matches_virtual(self):
+        """Sparse operands take the cached-gemm path too."""
+        import numpy as np
+        import scipy.sparse as sp
+
+        from libskylark_tpu.base.sparse import SparseMatrix
+        from libskylark_tpu.sketch import JLT, ROWWISE
+
+        n, s, m = 512, 48, 30
+        T = JLT(n, s, Context(seed=63))
+        A = SparseMatrix.from_scipy(sp.random(
+            m, n, density=0.1, random_state=np.random.default_rng(7),
+            format="csc", dtype=np.float32))
+        want = np.asarray(T.apply(A, ROWWISE))
+        T.materialize()
+        np.testing.assert_allclose(np.asarray(T.apply(A, ROWWISE)), want,
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_cache_not_serialized(self):
+        """The cache is runtime state: serialize/deserialize round-trips
+        the (seed, counter) definition only."""
+        import json as _json
+
+        from libskylark_tpu import sketch as sk
+        from libskylark_tpu.sketch import JLT
+
+        T = JLT(256, 32, Context(seed=62)).materialize()
+        payload = T.to_dict()
+        assert "cache" not in _json.dumps(payload).lower()
+        T2 = sk.deserialize_sketch(payload)
+        assert T2._S_cache is None
