@@ -33,7 +33,8 @@ from jax import lax
 
 from libskylark_tpu.base import errors, randgen
 from libskylark_tpu.sketch import params as sketch_params
-from libskylark_tpu.sketch.transform import SketchTransform, register
+from libskylark_tpu.sketch.transform import (OperatorCache,
+                                             SketchTransform, register)
 
 # Width of a virtual-S column block; part of the stream format.
 BLOCK_COLS = 256
@@ -72,7 +73,7 @@ def try_pallas_apply(key, dist, A, s_dim: int, scale: float, which: str):
     return getattr(pallas_dense, which)(key, dist, A, s_dim, scale)
 
 
-class DenseTransform(SketchTransform):
+class DenseTransform(OperatorCache, SketchTransform):
     """Base: S = scale × i.i.d. matrix from ``dist``
     (ref: sketch/random_dense_transform_data.hpp:15-76)."""
 
@@ -97,41 +98,11 @@ class DenseTransform(SketchTransform):
             self._alloc.key, self.dist, self._S, block_id, BLOCK_COLS, dtype
         )
 
-    # -- materialize-and-reuse (the steady-state regime) --
+    # -- materialize-and-reuse (OperatorCache; entries identical to the
+    # virtual stream's by construction — same s_panel) --
 
-    _S_cache = None
-
-    def materialize(self, dtype=jnp.float32) -> "DenseTransform":
-        """Pin the full operator S (S_dim × N) in device memory; later
-        applies contract against the cached array (one gemm) instead of
-        regenerating entries per apply.
-
-        The virtual-operator design pays generation on EVERY apply — the
-        right trade for one-shot sketches of huge operands. Workloads
-        that apply the same transform repeatedly (feature maps inside
-        solver iterations, ref: ml/BlockADMM.hpp:434 cached transforms;
-        power-iteration re-applies) amortize generation to zero by
-        materializing once, at S_dim×N×itemsize bytes of device memory.
-        Entries are identical to the virtual stream's by construction
-        (same ``s_panel``). Returns ``self`` for chaining;
-        ``dematerialize()`` drops the cache. The cache is runtime state —
-        never serialized (serialize() stays (seed, counter)-based)."""
-        self._S_cache = self.s_panel(0, self._N, dtype)
-        return self
-
-    def dematerialize(self) -> "DenseTransform":
-        self._S_cache = None
-        return self
-
-    def _cached_S(self, dtype):
-        """The pinned operator, cast to the apply dtype if needed (the
-        cast is O(S_dim·N) elementwise — noise next to the gemm; silently
-        skipping the cache on a dtype mismatch would defeat the
-        explicitly requested amortization)."""
-        c = self._S_cache
-        if c is None:
-            return None
-        return c if c.dtype == jnp.dtype(dtype) else c.astype(dtype)
+    def _full_operator(self, dtype) -> jnp.ndarray:
+        return self.s_panel(0, self._N, dtype)
 
     # -- apply --
 
@@ -157,7 +128,7 @@ class DenseTransform(SketchTransform):
         return 0
 
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        S = self._cached_S(A.dtype)
+        S = self._cached_op(A.dtype)
         if S is not None:
             return S @ A
         out = self._try_pallas(A, "columnwise_apply")
@@ -170,7 +141,7 @@ class DenseTransform(SketchTransform):
         return S @ A
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        S = self._cached_S(A.dtype)
+        S = self._cached_op(A.dtype)
         if S is not None:
             return A @ S.T
         out = self._try_pallas(A, "rowwise_apply")
@@ -192,7 +163,7 @@ class DenseTransform(SketchTransform):
     def _apply_columnwise_sparse(self, A) -> jnp.ndarray:
         from libskylark_tpu.base.sparse import spmm_t
 
-        S = self._cached_S(A.device_dtype)
+        S = self._cached_op(A.device_dtype)
         if S is not None:
             return spmm_t(A, S.T).T      # S·A = (Aᵀ·Sᵀ)ᵀ
         blocksize = self._effective_blocksize(A.device_dtype)
@@ -206,7 +177,7 @@ class DenseTransform(SketchTransform):
     def _apply_rowwise_sparse(self, A) -> jnp.ndarray:
         from libskylark_tpu.base.sparse import spmm
 
-        S = self._cached_S(A.device_dtype)
+        S = self._cached_op(A.device_dtype)
         if S is not None:
             return spmm(A, S.T)          # A·Sᵀ
         blocksize = self._effective_blocksize(A.device_dtype)

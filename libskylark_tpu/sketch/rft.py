@@ -22,11 +22,17 @@ import jax.numpy as jnp
 
 from libskylark_tpu.base import randgen
 from libskylark_tpu.sketch.dense import BLOCK_COLS
-from libskylark_tpu.sketch.transform import SketchTransform, register
+from libskylark_tpu.sketch.transform import (OperatorCache,
+                                             SketchTransform, register)
 
 
-class RFT(SketchTransform):
-    """Base random-Fourier-feature transform."""
+class RFT(OperatorCache, SketchTransform):
+    """Base random-Fourier-feature transform. ``materialize()`` pins the
+    frequency matrix W (OperatorCache) — the serving-predict /
+    repeated-featurization reuse regime."""
+
+    def _full_operator(self, dtype) -> jnp.ndarray:
+        return self.w_panel(0, self._N, dtype)
 
     sketch_type = "RFT"
     dist: randgen.Distribution = randgen.Normal()
@@ -74,11 +80,15 @@ class RFT(SketchTransform):
         return self.outscale * jnp.cos(WA * sc + sh)
 
     def _project_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        """W·A — on TPU via the fused generation+matmul kernel (W is in
-        the same dense-block stream format as the dense transforms); XLA
-        panel materialization otherwise."""
+        """W·A — the pinned W when materialized; on TPU via the fused
+        generation+matmul kernel (W is in the same dense-block stream
+        format as the dense transforms); XLA panel materialization
+        otherwise."""
         from libskylark_tpu.sketch.dense import try_pallas_apply
 
+        W = self._cached_op(A.dtype)
+        if W is not None:
+            return W @ A
         out = try_pallas_apply(
             self.subkey(0), self.dist, A, self._S, self.inscale,
             "columnwise_apply",
@@ -90,6 +100,9 @@ class RFT(SketchTransform):
     def _project_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
         from libskylark_tpu.sketch.dense import try_pallas_apply
 
+        W = self._cached_op(A.dtype)
+        if W is not None:
+            return A @ W.T
         out = try_pallas_apply(
             self.subkey(0), self.dist, A, self._S, self.inscale,
             "rowwise_apply",
@@ -102,9 +115,10 @@ class RFT(SketchTransform):
         return self._featurize(self._project_columnwise(A), feature_axis=0)
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        out = self._try_fused_rowwise(A)
-        if out is not None:
-            return out
+        if self._op_cache is None:
+            out = self._try_fused_rowwise(A)
+            if out is not None:
+                return out
         return self._featurize(self._project_rowwise(A), feature_axis=1)
 
     def _try_fused_rowwise(self, A):
@@ -139,13 +153,17 @@ class RFT(SketchTransform):
     def _apply_columnwise_sparse(self, A) -> jnp.ndarray:
         from libskylark_tpu.base.sparse import spmm_t
 
-        W = self.w_panel(0, self._N, A.device_dtype)
+        W = self._cached_op(A.device_dtype)
+        if W is None:
+            W = self.w_panel(0, self._N, A.device_dtype)
         return self._featurize(spmm_t(A, W.T).T, feature_axis=0)
 
     def _apply_rowwise_sparse(self, A) -> jnp.ndarray:
         from libskylark_tpu.base.sparse import spmm
 
-        W = self.w_panel(0, self._N, A.device_dtype)
+        W = self._cached_op(A.device_dtype)
+        if W is None:
+            W = self.w_panel(0, self._N, A.device_dtype)
         return self._featurize(spmm(A, W.T), feature_axis=1)
 
     # -- distributed sparse input: project with the per-cell virtual

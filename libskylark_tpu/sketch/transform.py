@@ -49,6 +49,47 @@ def register(cls: type["SketchTransform"]) -> type["SketchTransform"]:
     return cls
 
 
+class OperatorCache:
+    """Opt-in materialize-and-reuse for transforms whose operator is a
+    lazily generated dense matrix (DenseTransform's S, RFT's frequency
+    matrix W).
+
+    The virtual-operator design pays generation on EVERY apply — the
+    right trade for one-shot sketches of huge operands. Workloads that
+    apply the same transform repeatedly (feature maps inside solver
+    iterations, ref: ml/BlockADMM.hpp:434 cached transforms; serving
+    predict paths) call ``materialize()`` to pin the operator in device
+    memory and amortize generation to zero, at rows×N×itemsize bytes.
+    The cache is runtime state — never serialized (serialization stays
+    (seed, counter)-based)."""
+
+    _op_cache = None
+
+    def _full_operator(self, dtype) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def materialize(self, dtype=jnp.float32):
+        """Pin the full operator; later applies contract against the
+        cached array instead of regenerating. Returns ``self``."""
+        self._op_cache = self._full_operator(dtype)
+        return self
+
+    def dematerialize(self):
+        """Drop the pinned operator."""
+        self._op_cache = None
+        return self
+
+    def _cached_op(self, dtype):
+        """The pinned operator, cast to the apply dtype if needed (the
+        cast is O(elements) — noise next to the gemm; silently skipping
+        the cache on a dtype mismatch would defeat the explicitly
+        requested amortization)."""
+        c = self._op_cache
+        if c is None:
+            return None
+        return c if c.dtype == jnp.dtype(dtype) else c.astype(dtype)
+
+
 class SketchTransform:
     """A sketching transform S: R^N -> R^S_dim.
 

@@ -294,13 +294,13 @@ class TestMaterialize:
         want_r = np.asarray(T.apply(A_r, ROWWISE))
         want_c = np.asarray(T.apply(A_c, COLUMNWISE))
         T.materialize()
-        assert T._S_cache is not None
+        assert T._op_cache is not None
         np.testing.assert_allclose(np.asarray(T.apply(A_r, ROWWISE)),
                                    want_r, atol=1e-4, rtol=1e-4)
         np.testing.assert_allclose(np.asarray(T.apply(A_c, COLUMNWISE)),
                                    want_c, atol=1e-4, rtol=1e-4)
         T.dematerialize()
-        assert T._S_cache is None
+        assert T._op_cache is None
 
     def test_materialized_sparse_apply_matches_virtual(self):
         """Sparse operands take the cached-gemm path too."""
@@ -320,6 +320,48 @@ class TestMaterialize:
         np.testing.assert_allclose(np.asarray(T.apply(A, ROWWISE)), want,
                                    atol=1e-4, rtol=1e-4)
 
+    def test_rft_materialize_matches_virtual(self):
+        """RFT pins its frequency matrix W through the same OperatorCache
+        protocol; featurized outputs must match the virtual path."""
+        import numpy as np
+
+        from libskylark_tpu.sketch import ROWWISE, COLUMNWISE
+        from libskylark_tpu.sketch.rft import GaussianRFT
+
+        n, s, m = 512, 64, 24
+        T = GaussianRFT(n, s, Context(seed=64), sigma=2.0)
+        rng = np.random.default_rng(8)
+        A_r = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+        A_c = jnp.asarray(rng.standard_normal((n, m)), jnp.float32)
+        want_r = np.asarray(T.apply(A_r, ROWWISE))
+        want_c = np.asarray(T.apply(A_c, COLUMNWISE))
+        T.materialize()
+        np.testing.assert_allclose(np.asarray(T.apply(A_r, ROWWISE)),
+                                   want_r, atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(T.apply(A_c, COLUMNWISE)),
+                                   want_c, atol=1e-4, rtol=1e-4)
+        # sparse operands take the cached-W path too
+        import scipy.sparse as sp
+
+        from libskylark_tpu.base.sparse import SparseMatrix
+
+        As = SparseMatrix.from_scipy(sp.random(
+            16, n, density=0.1, random_state=np.random.default_rng(9),
+            format="csc", dtype=np.float32))
+        T.dematerialize()
+        want_s = np.asarray(T.apply(As, ROWWISE))
+        T.materialize()
+        np.testing.assert_allclose(np.asarray(T.apply(As, ROWWISE)),
+                                   want_s, atol=1e-4, rtol=1e-4)
+        Asc = SparseMatrix.from_scipy(sp.random(
+            n, 16, density=0.1, random_state=np.random.default_rng(10),
+            format="csc", dtype=np.float32))
+        T.dematerialize()
+        want_sc = np.asarray(T.apply(Asc, COLUMNWISE))
+        T.materialize()
+        np.testing.assert_allclose(np.asarray(T.apply(Asc, COLUMNWISE)),
+                                   want_sc, atol=1e-4, rtol=1e-4)
+
     def test_cache_not_serialized(self):
         """The cache is runtime state: serialize/deserialize round-trips
         the (seed, counter) definition only."""
@@ -332,4 +374,4 @@ class TestMaterialize:
         payload = T.to_dict()
         assert "cache" not in _json.dumps(payload).lower()
         T2 = sk.deserialize_sketch(payload)
-        assert T2._S_cache is None
+        assert T2._op_cache is None
