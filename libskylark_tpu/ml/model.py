@@ -86,6 +86,23 @@ class HilbertModel:
             DV = DV + Z @ self.coef[start : start + sj]
         return DV
 
+    def materialize(self) -> "HilbertModel":
+        """Pin every feature map's operator in device memory (the maps
+        that support :class:`~libskylark_tpu.sketch.transform.
+        OperatorCache`) — the serving regime: repeated ``predict`` calls
+        stop regenerating/re-uploading operators per call. Returns
+        ``self``; ``dematerialize`` drops the caches."""
+        for mp in self.maps:
+            if hasattr(mp, "materialize"):
+                mp.materialize()
+        return self
+
+    def dematerialize(self) -> "HilbertModel":
+        for mp in self.maps:
+            if hasattr(mp, "dematerialize"):
+                mp.dematerialize()
+        return self
+
     def predict(self, X):
         """Returns (labels, decision_values). Regression: labels are the
         decision values. Classification: sign for one output, argmax column

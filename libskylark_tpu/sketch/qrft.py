@@ -21,7 +21,8 @@ import numpy as np
 from scipy import special as sps
 
 from libskylark_tpu.base.quasirand import LeapedHaltonSequence, QMCSequence
-from libskylark_tpu.sketch.transform import SketchTransform, register
+from libskylark_tpu.sketch.transform import (OperatorCache,
+                                             SketchTransform, register)
 
 
 def _normal_quantile(p: np.ndarray) -> np.ndarray:
@@ -39,8 +40,14 @@ def _levy_quantile(p: np.ndarray) -> np.ndarray:
     return 1.0 / (2.0 * v * v)
 
 
-class QRFT(SketchTransform):
-    """Base quasi-random Fourier features."""
+class QRFT(OperatorCache, SketchTransform):
+    """Base quasi-random Fourier features. W lives on HOST
+    (quasi-Monte-Carlo points are built in f64 numpy); each apply
+    re-uploads it — ``materialize()`` (OperatorCache) pins the device
+    copy for repeated applies."""
+
+    def _full_operator(self, dtype):
+        return self.w_matrix(dtype)
 
     sketch_type = "QRFT"
     _quantile = staticmethod(_normal_quantile)
@@ -75,12 +82,15 @@ class QRFT(SketchTransform):
     def shifts(self, dtype=jnp.float32) -> jnp.ndarray:
         return jnp.asarray(self._shifts_host, dtype=dtype)
 
+    def _device_W(self, dtype) -> jnp.ndarray:
+        return self._op_or(dtype, self.w_matrix)
+
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        W = self.w_matrix(A.dtype)
+        W = self._device_W(A.dtype)
         return self.outscale * jnp.cos(W @ A + self.shifts(A.dtype)[:, None])
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        W = self.w_matrix(A.dtype)
+        W = self._device_W(A.dtype)
         return self.outscale * jnp.cos(A @ W.T + self.shifts(A.dtype)[None, :])
 
     def _extra_params(self) -> dict[str, Any]:
@@ -172,11 +182,11 @@ class ExpSemigroupQRLT(QRFT):
         return math.sqrt(1.0 / self._S)
 
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        W = self.w_matrix(A.dtype)
+        W = self._device_W(A.dtype)
         return self.outscale * jnp.exp(-(W @ A))
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        W = self.w_matrix(A.dtype)
+        W = self._device_W(A.dtype)
         return self.outscale * jnp.exp(-(A @ W.T))
 
     def _extra_params(self):

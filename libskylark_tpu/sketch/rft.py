@@ -153,17 +153,15 @@ class RFT(OperatorCache, SketchTransform):
     def _apply_columnwise_sparse(self, A) -> jnp.ndarray:
         from libskylark_tpu.base.sparse import spmm_t
 
-        W = self._cached_op(A.device_dtype)
-        if W is None:
-            W = self.w_panel(0, self._N, A.device_dtype)
+        W = self._op_or(A.device_dtype,
+                        lambda dt: self.w_panel(0, self._N, dt))
         return self._featurize(spmm_t(A, W.T).T, feature_axis=0)
 
     def _apply_rowwise_sparse(self, A) -> jnp.ndarray:
         from libskylark_tpu.base.sparse import spmm
 
-        W = self._cached_op(A.device_dtype)
-        if W is None:
-            W = self.w_panel(0, self._N, A.device_dtype)
+        W = self._op_or(A.device_dtype,
+                        lambda dt: self.w_panel(0, self._N, dt))
         return self._featurize(spmm(A, W.T), feature_axis=1)
 
     # -- distributed sparse input: project with the per-cell virtual

@@ -82,12 +82,23 @@ class OperatorCache:
     def _cached_op(self, dtype):
         """The pinned operator, cast to the apply dtype if needed (the
         cast is O(elements) — noise next to the gemm; silently skipping
-        the cache on a dtype mismatch would defeat the explicitly
-        requested amortization)."""
+        the cache on a narrower dtype would defeat the explicitly
+        requested amortization). A request WIDER than the cache returns
+        None — upcasting a truncated cache would silently degrade e.g.
+        f64 applies (QRFT builds W in host f64; under jax x64 the
+        virtual path is full-precision), so wide applies regenerate."""
         c = self._op_cache
         if c is None:
             return None
-        return c if c.dtype == jnp.dtype(dtype) else c.astype(dtype)
+        want = jnp.dtype(dtype)
+        if want.itemsize > c.dtype.itemsize:
+            return None
+        return c if c.dtype == want else c.astype(want)
+
+    def _op_or(self, dtype, build):
+        """The cached operator for ``dtype``, else ``build(dtype)``."""
+        c = self._cached_op(dtype)
+        return c if c is not None else build(dtype)
 
 
 class SketchTransform:

@@ -194,3 +194,32 @@ class TestRLSC:
         )
         pred = ml.dummy_decode(jnp.asarray(Z @ np.asarray(W)), coding)
         assert (pred == y).mean() > 0.95
+
+
+def test_model_materialize_predict_unchanged():
+    """HilbertModel.materialize pins every supporting map's operator; the
+    serving predict path must be unchanged (the caches hold the same
+    entries the virtual streams generate)."""
+    import numpy as np
+
+    from libskylark_tpu.base.context import Context
+    from libskylark_tpu.ml.model import HilbertModel
+    from libskylark_tpu.sketch.rft import GaussianRFT
+
+    rng = np.random.default_rng(3)
+    d, s, k, m = 16, 64, 3, 40
+    maps = [GaussianRFT(d, s, Context(seed=91), sigma=2.0)]
+    W = rng.standard_normal((s, k)).astype(np.float32)
+    model = HilbertModel(maps, scale_maps=False, num_features=s,
+                         num_outputs=k, coef=jnp.asarray(W),
+                         regression=False)
+    X = jnp.asarray(rng.standard_normal((m, d)), jnp.float32)
+    lab0, dv0 = model.predict(X)
+    model.materialize()
+    assert maps[0]._op_cache is not None
+    lab1, dv1 = model.predict(X)
+    np.testing.assert_array_equal(np.asarray(lab1), np.asarray(lab0))
+    np.testing.assert_allclose(np.asarray(dv1), np.asarray(dv0),
+                               atol=1e-5)
+    model.dematerialize()
+    assert maps[0]._op_cache is None

@@ -320,6 +320,17 @@ class TestMaterialize:
         np.testing.assert_allclose(np.asarray(T.apply(A, ROWWISE)), want,
                                    atol=1e-4, rtol=1e-4)
 
+    def test_wider_dtype_bypasses_cache(self):
+        """An apply in a dtype WIDER than the cache must regenerate, not
+        upcast the truncated cache (f64 parity under jax x64 — QRFT's W
+        is host-f64; upcasting an f32 cache would silently degrade it)."""
+        from libskylark_tpu.sketch import JLT
+
+        T = JLT(128, 16, Context(seed=65)).materialize()  # f32 cache
+        assert T._cached_op(jnp.float32) is not None
+        assert T._cached_op(jnp.float64) is None
+        assert T._cached_op(jnp.bfloat16) is not None  # narrower: cast ok
+
     def test_rft_materialize_matches_virtual(self):
         """RFT pins its frequency matrix W through the same OperatorCache
         protocol; featurized outputs must match the virtual path."""
