@@ -9,8 +9,9 @@ VMEM — exact same bits as :func:`randgen.dense_block`, via the shared
 integer-op Threefry in base/threefry.py — while the MXU contracts the
 previous panels, so generation rides under the matmul.
 
-Rowwise apply only (out = A·Sᵀ, the regime of BASELINE config 1); other
-layouts fall back to the XLA path in sketch/dense.py.
+Rowwise (out = A·Sᵀ, the regime of BASELINE config 1) and columnwise
+(out = S·A) applies, both with optional pipelined generation; inputs the
+kernel can't take fall back to the XLA path in sketch/dense.py.
 """
 
 from __future__ import annotations
@@ -201,24 +202,30 @@ def _apply_epilogue(out_ref, epilogue, k, n_blocks):
 
 
 def _kernel_pipe(dist_kind, s_dim, n_blocks, precision, keys_ref, a_ref,
-                 out_ref, s_buf, *, epilogue=None):
-    """Rowwise kernel with software-pipelined generation: block k+1 is
-    generated into the other half of a double buffer BETWEEN the MXU
-    contraction of block k being issued and its result being consumed —
-    the generation is dataflow-independent of the in-flight matmul, so
-    the scheduler can run the VPU (Threefry + inverse-CDF) under the MXU.
-    At the headline config generation is the dominant non-MXU cost (one
-    full operator regeneration per m-tile sweep), so the overlap bounds
-    the step at max(gen, matmul) instead of their sum. Opt-in via
-    SKYLARK_PALLAS_PIPELINE=1 pending an on-chip A/B (scheduling is the
-    compiler's call; interpret-mode equivalence is exact either way)."""
+                 out_ref, s_buf, *, rowwise=True, epilogue=None):
+    """Kernel with software-pipelined generation: block k+1 is generated
+    into the other half of a double buffer BETWEEN the MXU contraction of
+    block k being issued and its result being consumed — the generation
+    is dataflow-independent of the in-flight matmul, so the scheduler can
+    run the VPU (Threefry + inverse-CDF) under the MXU. At the headline
+    config generation is the dominant non-MXU cost (one full operator
+    regeneration per m-tile sweep), so the overlap bounds the step at
+    max(gen, matmul) instead of their sum. One body serves both
+    orientations (``rowwise``: out += A·S_blkᵀ, else out += S_blk·A).
+    Opt-in via SKYLARK_PALLAS_PIPELINE=1 pending an on-chip A/B
+    (scheduling is the compiler's call; interpret-mode equivalence is
+    exact either way)."""
     k = pl.program_id(1)
 
     @pl.when(k == 0)
     def _first():
         s_buf[0] = _gen_block(dist_kind, s_dim, keys_ref, 0)
 
-    acc = _dot(a_ref[:], s_buf[k % 2], (((1,), (1,)), ((), ())), precision)
+    S_blk = s_buf[k % 2]
+    if rowwise:
+        acc = _dot(a_ref[:], S_blk, (((1,), (1,)), ((), ())), precision)
+    else:
+        acc = _dot(S_blk, a_ref[:], (((1,), (0,)), ((), ())), precision)
 
     @pl.when(k + 1 < n_blocks)
     def _next():
@@ -274,6 +281,13 @@ def _kernel_cw(dist_kind, s_dim, m_tile, precision, keys_ref, a_ref, out_ref,
     _accumulate(out_ref, acc, k)
 
 
+def _kernel_pipe_cw(dist_kind, s_dim, n_blocks, precision, keys_ref,
+                    a_ref, out_ref, s_buf):
+    """Columnwise orientation of :func:`_kernel_pipe`."""
+    _kernel_pipe(dist_kind, s_dim, n_blocks, precision, keys_ref, a_ref,
+                 out_ref, s_buf, rowwise=False)
+
+
 def _scratch(s_dim: int, n: int, m: int, m_tile: int):
     """Scratch shapes for the operator cache, or [] when it doesn't pay
     (single m-tile → no reuse) or doesn't fit the cap / the whole-kernel
@@ -287,6 +301,20 @@ def _scratch(s_dim: int, n: int, m: int, m_tile: int):
     if _vmem_estimate(m_tile, s_dim, scratch_bytes) > _VMEM_BUDGET_BYTES:
         return []
     return [pltpu.VMEM((s_dim, n_blocks * BLOCK_COLS), jnp.float32)]
+
+
+def _select_pipe(kern, pipe_kern, scratch, s_dim: int, m_tile: int):
+    """Swap in the pipelined kernel + generation double buffer when the
+    operator-cache scratch doesn't apply (the big-operator regime),
+    SKYLARK_PALLAS_PIPELINE=1, and the buffer fits the same VMEM budget
+    _qualify planned against — over budget, stay on the plain kernel (no
+    fallback seam exists on the shard_map path)."""
+    pipe_bytes = 2 * s_dim * BLOCK_COLS * 4
+    if (not scratch and pipe_kern is not None and _pipeline_enabled()
+            and _vmem_estimate(m_tile, s_dim, pipe_bytes)
+            <= _VMEM_BUDGET_BYTES):
+        return pipe_kern, [pltpu.VMEM((2, s_dim, BLOCK_COLS), jnp.float32)]
+    return kern, scratch
 
 
 def _grid_params(scratch):
@@ -318,15 +346,7 @@ def _rowwise_pallas_call(A, keys, extra_operands, kern, *, s_dim, m_tile,
     grid = (m // m_tile, n_blocks)
     scratch = _scratch(s_dim, n, m, m_tile)
     grid_params = _grid_params(scratch)
-    pipe_bytes = 2 * s_dim * BLOCK_COLS * 4
-    if (not scratch and pipe_kern is not None and _pipeline_enabled()
-            and _vmem_estimate(m_tile, s_dim, pipe_bytes)
-            <= _VMEM_BUDGET_BYTES):
-        # the double buffer must fit the same budget _qualify planned
-        # against — over budget, stay on the plain kernel (no fallback
-        # seam exists on the shard_map path)
-        kern = pipe_kern
-        scratch = [pltpu.VMEM((2, s_dim, BLOCK_COLS), jnp.float32)]
+    kern, scratch = _select_pipe(kern, pipe_kern, scratch, s_dim, m_tile)
     return pl.pallas_call(
         kern,
         grid=grid,
@@ -403,7 +423,11 @@ def _fused_call_cw(A, keys, *, s_dim, dist_kind, m_tile, precision="f32",
     n_blocks = n // BLOCK_COLS
     grid = (m // m_tile, n_blocks)
     scratch = _scratch(s_dim, n, m, m_tile)
+    grid_params = _grid_params(scratch)
     kern = functools.partial(_kernel_cw, dist_kind, s_dim, m_tile, precision)
+    pipe = functools.partial(_kernel_pipe_cw, dist_kind, s_dim, n_blocks,
+                             precision)
+    kern, scratch = _select_pipe(kern, pipe, scratch, s_dim, m_tile)
     return pl.pallas_call(
         kern,
         grid=grid,
@@ -419,7 +443,7 @@ def _fused_call_cw(A, keys, *, s_dim, dist_kind, m_tile, precision="f32",
         ),
         out_shape=jax.ShapeDtypeStruct((s_dim, m), jnp.float32),
         scratch_shapes=scratch,
-        compiler_params=_grid_params(scratch),
+        compiler_params=grid_params,
         interpret=interpret,
     )(keys, A)
 

@@ -289,6 +289,14 @@ def test_pipelined_variant_matches_plain(monkeypatch):
         T.subkey(0), T.dist, A, s, T.inscale, T.outscale,
         np.asarray(T.row_scales()), np.asarray(T.shifts()),
         precision="f32", interpret=True))
+    A_c = jnp.asarray(
+        np.random.default_rng(10).standard_normal((n, 48)), jnp.float32
+    )
+    # columnwise baseline BEFORE the pipeline env engages (else both
+    # sides would run the pipe kernel and a defect would self-compare)
+    plain_c = np.asarray(pd.columnwise_apply(
+        jlt._alloc.key, jlt.dist, A_c, s, jlt.scale,
+        precision="f32", interpret=True))
 
     monkeypatch.setenv("SKYLARK_PALLAS_PIPELINE", "1")
     # tile smaller than m so the grid really sweeps; cache disabled so
@@ -303,6 +311,10 @@ def test_pipelined_variant_matches_plain(monkeypatch):
         m_tile=16, precision="f32", interpret=True))
     np.testing.assert_array_equal(piped, plain)
     np.testing.assert_array_equal(piped_cos, plain_cos)
+    piped_c = np.asarray(pd.columnwise_apply(
+        jlt._alloc.key, jlt.dist, A_c, s, jlt.scale,
+        m_tile=16, precision="f32", interpret=True))
+    np.testing.assert_array_equal(piped_c, plain_c)
 
 
 @pytest.mark.tpu
