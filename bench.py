@@ -154,16 +154,39 @@ def run(m: int = 8192, n: int = 8192, s: int = 1024, repeats: int = 5,
     return bytes_moved / best / 1e9, best
 
 
+# bf16 MXU peak of the bench chip, for the MFU field. v5e ≈ 197 TFLOP/s;
+# override for other parts via env (the record labels the assumption).
+# Parsed defensively: a malformed or non-positive override must not crash
+# the parent before it can print its one JSON line (the wedge-proofing
+# contract), nor produce Infinity in the record.
+def _peak_bf16_tflops() -> float:
+    try:
+        v = float(os.environ.get("SKYLARK_PEAK_BF16_TFLOPS", "197"))
+    except ValueError:
+        return 197.0
+    return v if v > 0 else 197.0
+
+
+_PEAK_BF16_TFLOPS = _peak_bf16_tflops()
+
+
 def _child() -> None:
     import jax
 
     platform = jax.default_backend()
-    gbps, secs = run(precision="bf16x3")   # the shipping default regime
+    m, n, s = 8192, 8192, 1024
+    gbps, secs = run(m, n, s, precision="bf16x3")  # the shipping default
+    tflops = 2.0 * m * n * s / secs / 1e12
     rec = {
         "platform": platform,
         "value": round(gbps, 3),
         "secs_per_apply": secs,
         "precision": "bf16x3",
+        "tflops": round(tflops, 2),
+        # fraction of single-pass bf16 MXU peak; the bf16x3 regime issues
+        # 3 passes per logical FLOP, so its ceiling is ~1/3
+        "mfu_vs_bf16_peak": round(tflops / _PEAK_BF16_TFLOPS, 4),
+        "peak_bf16_tflops_assumed": _PEAK_BF16_TFLOPS,
     }
     # Print the headline immediately — the informational extras below
     # must not be able to void an already-successful measurement if the
