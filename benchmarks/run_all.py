@@ -320,7 +320,11 @@ def main():
     if args.save is not None:
         out = {"round": args.save, "scale": args.scale,
                "backend": jax.default_backend(), "results": results}
-        path = os.path.join(HERE, f"results_r{args.save:02d}.json")
+        # backend in the filename: a round records the CPU-mesh and the
+        # on-chip suites as separate artifacts (one path per round made
+        # them overwrite each other); _prior_best reads both layouts
+        path = os.path.join(
+            HERE, f"results_r{args.save:02d}_{jax.default_backend()}.json")
         with open(path, "w") as fh:
             json.dump(out, fh, indent=1)
         print(f"# saved {path}", file=sys.stderr)

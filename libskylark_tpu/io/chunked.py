@@ -203,9 +203,13 @@ def read_libsvm_sharded(
     if dims is not None:
         n, d = int(dims[0]), int(dims[1])
         nt = int(dims[2]) if len(dims) > 2 else 1
-        # bound the read at n rows (a stream that has grown since the
-        # scan must not overrun the shard plan) …
-        max_n = n if max_n < 0 else min(max_n, n)
+        # an explicit max_n truncates the plan itself (the path branch
+        # gets this from scan_libsvm_dims, which caps n); then bound the
+        # read at n rows so a stream that has grown since the scan must
+        # not overrun the shard plan
+        if 0 <= max_n < n:
+            n = max_n
+        max_n = n
     elif isinstance(source, (str, bytes)) or hasattr(source, "__fspath__"):
         n, d, nt = scan_libsvm_dims(source, max_n)
     else:

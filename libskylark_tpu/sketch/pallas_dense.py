@@ -141,12 +141,22 @@ def _dot(lhs, rhs, dims, precision):
     )
 
 
+def _env_bytes(name: str, default: int) -> int:
+    """Env-overridable byte count; malformed values fall back (a typo
+    must degrade to the default, not crash every sketch apply — the
+    repo's env-parse convention, cf. params._env_m_tile)."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 # Per-core VMEM budget the kernel plans against. ~16 MiB/core is the
 # common figure across current generations (v4/v5e/v5p; pallas_guide.md
 # memory-hierarchy table) — there is no runtime query API, so the default
 # is conservative and env-overridable for parts that have more.
-_VMEM_BUDGET_BYTES = int(os.environ.get(
-    "SKYLARK_PALLAS_VMEM_BUDGET", 16 * 1024 * 1024))
+_VMEM_BUDGET_BYTES = _env_bytes(
+    "SKYLARK_PALLAS_VMEM_BUDGET", 16 * 1024 * 1024)
 
 # VMEM budget for caching the generated operator across m-tiles. When the
 # full virtual S fits, each block is generated ONCE (first m-tile sweep)
@@ -156,8 +166,8 @@ _VMEM_BUDGET_BYTES = int(os.environ.get(
 # double-buffered A/out tiles inside _VMEM_BUDGET_BYTES (advisor r2
 # medium finding: the old 48 MiB default exceeded whole-VMEM on v5e and
 # could fail Mosaic compilation outright on the shard_map path).
-_SCRATCH_CAP_BYTES = int(os.environ.get(
-    "SKYLARK_PALLAS_SCRATCH_CAP", 8 * 1024 * 1024))
+_SCRATCH_CAP_BYTES = _env_bytes(
+    "SKYLARK_PALLAS_SCRATCH_CAP", 8 * 1024 * 1024)
 
 
 def _vmem_estimate(m_tile: int, s_dim: int, scratch_bytes: int) -> int:

@@ -208,3 +208,17 @@ def test_webhdfs_feeds_the_reader_seam(tmp_path, mesh1d):
         stub.close()
     np.testing.assert_allclose(np.asarray(X), X1, atol=1e-6)
     np.testing.assert_allclose(np.asarray(Y), Y1, atol=1e-6)
+
+
+def test_sharded_read_dims_with_max_n(tmp_path, mesh1d):
+    """dims + an explicit smaller max_n truncates the shard plan itself
+    instead of raising a spurious stream-shrunk error."""
+    p, _, _ = _write_libsvm(tmp_path, n=30, seed=12)
+    with open(p) as fh:
+        lines = fh.readlines()
+    n, d, nt = skio.scan_libsvm_dims(iter(lines))
+    X, Y = skio.read_libsvm_sharded(iter(lines), mesh1d, max_n=10,
+                                    dims=(n, d, nt))
+    X_full, _ = skio.read_libsvm(p)
+    assert X.shape[0] == 10
+    np.testing.assert_allclose(np.asarray(X), X_full[:10], atol=1e-6)
