@@ -14,9 +14,9 @@ across the whole surface:
 
 Usage: python benchmarks/run_all.py [--scale small|full]
                                     [--save N] [--gate]
-``--save N`` writes benchmarks/results_rN.json; with prior
+``--save N`` writes benchmarks/results_rN_<backend>.json; with prior
 results_r*.json present, every metric is printed with its delta vs the
-best prior round, and ``--gate`` exits nonzero when any metric regresses
+best prior round at the same backend+scale, and ``--gate`` exits nonzero when any metric regresses
 by more than 10% (the perf ratchet for later rounds — the phase-timer
 discipline of ref: ml/BlockADMM.hpp:357-365 made enforceable).
 """
@@ -260,7 +260,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", choices=["small", "full"], default="full")
     ap.add_argument("--save", type=int, metavar="ROUND", default=None,
-                    help="persist results as results_rROUND.json")
+                    help="persist results as results_rROUND_<backend>.json")
     ap.add_argument("--gate", action="store_true",
                     help="exit 1 if any metric regresses >10%% vs the "
                          "best prior round")

@@ -20,6 +20,7 @@ sys.path.insert(
     "random_features",
     "kernel_regression",
     "condest_asynch",
+    "streaming_ingest",
 ])
 def test_example_runs(name, capsys):
     mod = importlib.import_module(name)
