@@ -22,8 +22,10 @@ run() {  # run <timeout_s> <label> <cmd...>
 run 90 probe python bench.py --probe || exit 1
 
 # 1. on-chip oracle tests at the CURRENT defaults (bf16x3) — re-certify
-run 300 oracle env SKYLARK_TEST_TPU=1 python -m pytest tests/test_pallas_dense.py -m tpu -rA \
-    2>&1 | tail -8 | tee -a benchmarks/tpu_validation_r03.txt
+#    (5 tests: rowwise f32/bf16x3, columnwise, fused-RFT epilogue,
+#    pipelined; each may cold-compile)
+run 900 oracle env SKYLARK_TEST_TPU=1 python -m pytest tests/test_pallas_dense.py -m tpu -rA \
+    2>&1 | tail -10 | tee -a benchmarks/tpu_validation_r03.txt
 
 # 2. headline measurement (default m-tile, all three regimes measured by
 #    the child) — the driver-compatible JSON line, saved with provenance
