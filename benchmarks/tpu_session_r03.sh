@@ -3,6 +3,11 @@
 # mid-session tunnel wedge still leaves the most valuable artifacts
 # committed. Each step is bounded; artifacts land in benchmarks/.
 #
+# bench.py prints exactly one JSON line on stdout (its status chatter goes
+# to stderr), so each measurement captures stdout straight to a file —
+# piping through the run() wrapper would interleave its own echoes and
+# lose the record (that bug ate the first headline capture of the round).
+#
 # Usage: bash benchmarks/tpu_session_r03.sh
 set -u
 cd "$(dirname "$0")/.."
@@ -18,42 +23,77 @@ run() {  # run <timeout_s> <label> <cmd...>
     return $rc
 }
 
+bench_to() {  # bench_to <timeout_s> <label> <outfile> [env pairs...]
+    local t=$1 label=$2 out=$3; shift 3
+    echo "== $label"
+    timeout "$t" env "$@" python bench.py > "$out" 2>/tmp/bench_"$label".err
+    local rc=$?
+    echo "== $label rc=$rc $(tail -c 400 "$out")"
+    if [ $rc -ne 0 ]; then
+        echo "== $label stderr: $(tail -c 400 /tmp/bench_"$label".err)"
+    fi
+    return $rc
+}
+
+# save_rec <infile> <outfile> [extra-json-fields] — parse the last
+# non-empty line of <infile> as the bench record, stamp capture time.
+# Single-file mode refuses value=null so a wedged rerun never overwrites
+# a good capture; JSONL append mode keeps null rows (they document the
+# failure and cannot destroy prior rows).
+save_rec() {
+    python - "$@" <<'EOF'
+import datetime, json, sys
+inp, out = sys.argv[1], sys.argv[2]
+extras = json.loads(sys.argv[3]) if len(sys.argv) > 3 else None
+lines = [l for l in open(inp) if l.strip()]
+if not lines:
+    sys.exit(f"save_rec: {inp} is empty; not touching {out}")
+rec = json.loads(lines[-1])
+stamp = datetime.datetime.now(datetime.timezone.utc).isoformat()
+if extras is None:
+    if rec.get("value") is None:
+        sys.exit(f"save_rec: {inp} has value=null ({rec.get('error')}); not touching {out}")
+    rec["provenance"] = {"captured": stamp, "by": "benchmarks/tpu_session_r03.sh"}
+    json.dump(rec, open(out, "w"), indent=1)
+else:
+    with open(out, "a") as f:
+        f.write(json.dumps({**extras, "captured": stamp, "rec": rec}) + "\n")
+EOF
+}
+
 # 0. liveness (cheap)
 run 90 probe python bench.py --probe || exit 1
 
 # 1. on-chip oracle tests at the CURRENT defaults (bf16x3) — re-certify
 #    (5 tests: rowwise f32/bf16x3, columnwise, fused-RFT epilogue,
-#    pipelined; each may cold-compile)
+#    pipelined; each may cold-compile). SKYLARK_SKIP_ORACLE=1 resumes a
+#    session whose oracle step already passed and is committed.
+if [ "${SKYLARK_SKIP_ORACLE:-0}" != "1" ]; then
 run 900 oracle env SKYLARK_TEST_TPU=1 python -m pytest tests/test_pallas_dense.py -m tpu -rA \
     2>&1 | tail -10 | tee -a benchmarks/tpu_validation_r03.txt
+fi
 
 # 2. headline measurement (default m-tile, all three regimes measured by
 #    the child) — the driver-compatible JSON line, saved with provenance
-run 480 headline python bench.py 2>&1 | tail -1 | tee /tmp/headline_r03.json
-python - <<'EOF'
-import json, datetime
-rec = json.load(open("/tmp/headline_r03.json"))
-rec["provenance"] = {"captured": datetime.datetime.now(datetime.timezone.utc).isoformat(),
-                     "by": "benchmarks/tpu_session_r03.sh"}
-json.dump(rec, open("benchmarks/results_tpu_r03_headline.json", "w"), indent=1)
-EOF
+bench_to 480 headline /tmp/headline_r03.json SKYLARK_BENCH_DEADLINE=420 && \
+    save_rec /tmp/headline_r03.json benchmarks/results_tpu_r03_headline.json
 
 # 3. m-tile sweep on the headline config (pick the best, record all).
 #    Generation is re-paid per m-tile sweep, so larger tiles cut the
 #    dominant VPU cost; 1024 may exceed the VMEM plan (then _qualify
 #    shrinks it — the record shows which tile actually ran).
 for MT in 256 512 1024; do
-    run 420 "mtile-$MT" env SKYLARK_PALLAS_MTILE=$MT SKYLARK_BENCH_DEADLINE=360 \
-        python bench.py 2>&1 | tail -1 | \
-        sed "s/^/{\"m_tile\": $MT, \"rec\": /; s/\$/}/" \
-        >> benchmarks/results_tpu_r03_mtile_sweep.jsonl
+    bench_to 420 "mtile-$MT" /tmp/mtile_$MT.json \
+        SKYLARK_PALLAS_MTILE=$MT SKYLARK_BENCH_DEADLINE=360 && \
+    save_rec /tmp/mtile_$MT.json benchmarks/results_tpu_r03_mtile_sweep.jsonl \
+        "{\"m_tile\": $MT}"
 done
 
 # 3b. generation-pipelining A/B at the best expected tile
-run 420 pipeline env SKYLARK_PALLAS_PIPELINE=1 SKYLARK_PALLAS_MTILE=512 \
-    SKYLARK_BENCH_DEADLINE=360 python bench.py 2>&1 | tail -1 | \
-    sed 's/^/{"pipeline": 1, "m_tile": 512, "rec": /; s/$/}/' \
-    >> benchmarks/results_tpu_r03_mtile_sweep.jsonl
+bench_to 420 pipeline /tmp/pipeline_512.json \
+    SKYLARK_PALLAS_PIPELINE=1 SKYLARK_PALLAS_MTILE=512 SKYLARK_BENCH_DEADLINE=360 && \
+    save_rec /tmp/pipeline_512.json benchmarks/results_tpu_r03_mtile_sweep.jsonl \
+        '{"pipeline": 1, "m_tile": 512}'
 
 # 4. full bench suite at full scale on chip (all BASELINE configs + FRFT)
 run 1800 run_all python benchmarks/run_all.py --scale full --save 3 \
