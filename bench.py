@@ -146,12 +146,19 @@ def run(m: int = 8192, n: int = 8192, s: int = 1024, repeats: int = 5,
             if trace_dir:  # one traced apply for offline kernel analysis
                 with jax.profiler.trace(trace_dir):
                     float(f2(A))
+
+        # the plan the kernel ACTUALLY ran (tuning knobs can be silently
+        # adjusted: _qualify shrinks over-budget m-tiles, _select_pipe
+        # drops an unfittable pipeline buffer) — recorded so sweep rows
+        # label measurements with the effective config, not the request
+        plan = (pd.effective_plan(jlt.dist, (m, n), A.dtype, s, seq_axis=1)
+                if use_pallas else {"kernel": False})
     finally:
         sketch_params.set_use_pallas(prev_use_pallas)
         sketch_params.set_pallas_precision(prev_precision)
 
     bytes_moved = 4 * (m * n + m * s)
-    return bytes_moved / best / 1e9, best
+    return bytes_moved / best / 1e9, best, plan
 
 
 # bf16 MXU peak of the bench chip, for the MFU field. v5e ≈ 197 TFLOP/s;
@@ -175,13 +182,14 @@ def _child() -> None:
 
     platform = jax.default_backend()
     m, n, s = 8192, 8192, 1024
-    gbps, secs = run(m, n, s, precision="bf16x3")  # the shipping default
+    gbps, secs, plan = run(m, n, s, precision="bf16x3")  # shipping default
     tflops = 2.0 * m * n * s / secs / 1e12
     rec = {
         "platform": platform,
         "value": round(gbps, 3),
         "secs_per_apply": secs,
         "precision": "bf16x3",
+        "plan": plan,
         "tflops": round(tflops, 2),
         # fraction of single-pass bf16 MXU peak; the bf16x3 regime issues
         # 3 passes per logical FLOP, so its ceiling is ~1/3
@@ -195,10 +203,14 @@ def _child() -> None:
     # informational extras: the conservative and throughput-only kernel
     # regimes, plus the plain-XLA one-shot-materialization path at the
     # matched (bf16x3-grade) precision — the regeneration-vs-
-    # materialization A/B
+    # materialization A/B. SKYLARK_BENCH_SKIP_EXTRAS=1 skips them so a
+    # tuning sweep (one point per process) spends a live tunnel window on
+    # sweep points instead of re-measuring the same three extras
+    if os.environ.get("SKYLARK_BENCH_SKIP_EXTRAS") == "1":
+        return
     for regime in ("f32", "bf16", "xla_high"):
         try:
-            gbps_x, _ = run(precision=regime, repeats=3)
+            gbps_x, _, _ = run(precision=regime, repeats=3)
             print("CHILD_EXTRA " + json.dumps(
                 {f"{regime}_GBps": round(gbps_x, 3)}), flush=True)
         except Exception:

@@ -79,12 +79,12 @@ def bench_jlt(scale: str):
     # round-over-round ratchet needs a fixed, labeled regime
     precision = "bf16x3"
     if scale == "full":
-        gbps, secs = bench.run(precision=precision)
+        gbps, secs, plan = bench.run(precision=precision)
     else:
-        gbps, secs = bench.run(m=1024, n=1024, s=128, repeats=2,
-                               precision=precision)
+        gbps, secs, plan = bench.run(m=1024, n=1024, s=128, repeats=2,
+                                     precision=precision)
     return {"metric": "jlt_sketch_apply_GBps", "value": round(gbps, 3),
-            "unit": "GB/s", "precision": precision}
+            "unit": "GB/s", "precision": precision, "plan": plan}
 
 
 def _sparse_input(scale: str):
@@ -232,13 +232,20 @@ def bench_admm(scale: str):
             "unit": "s", "iters": iters}
 
 
-def _prior_best(scale: str, backend: str) -> dict[str, float]:
+def _prior_best(scale: str, backend: str,
+                exclude: str | None = None) -> dict[str, float]:
     """Best prior value per metric across results_r*.json (best respects
     the metric's direction). Only rounds recorded at the SAME scale and
     backend are comparable — a full-scale TPU round must not gate a
-    small-scale CPU run."""
+    small-scale CPU run. ``exclude`` drops the round's OWN save file:
+    on a --resume pass it matches the glob, and comparing a record
+    against itself would overwrite its genuine cross-round ratio with
+    a spurious 1.0."""
     best: dict[str, float] = {}
     for p in glob.glob(os.path.join(HERE, "results_r*.json")):
+        if exclude is not None and os.path.abspath(p) == \
+                os.path.abspath(exclude):
+            continue
         try:
             with open(p) as fh:
                 recs = json.load(fh)
@@ -256,6 +263,30 @@ def _prior_best(scale: str, backend: str) -> dict[str, float]:
     return best
 
 
+def _existing_results(path: str, scale: str, backend: str) -> dict[str, dict]:
+    """Metric → record from a previous (possibly partial) save of the same
+    round at the same scale+backend, for carry-through and ``--resume``.
+    A scale mismatch REFUSES the run outright: backend is in the filename
+    but scale is not, so persisting would silently replace the other
+    scale's round file (e.g. a --scale small spot-check destroying the
+    full-scale TPU evidence captured through tunnel windows)."""
+    try:
+        with open(path) as fh:
+            old = json.load(fh)
+    except FileNotFoundError:
+        return {}
+    except Exception:
+        sys.exit(f"refusing --save: {path} exists but is unreadable; "
+                 "move it aside or pick another round number")
+    if old.get("scale") != scale:
+        sys.exit(f"refusing --save: {path} holds a scale="
+                 f"{old.get('scale')!r} round; this run is scale={scale!r}."
+                 " Pick another round number or move the file aside.")
+    if old.get("backend") != backend:
+        return {}
+    return {r["metric"]: r for r in old.get("results", []) if r.get("metric")}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", choices=["small", "full"], default="full")
@@ -267,10 +298,14 @@ def main():
     ap.add_argument("--only", default=None,
                     help="comma-separated bench-name substrings or exact "
                          "metric names to run")
+    ap.add_argument("--resume", action="store_true",
+                    help="with --save: skip configs whose saved record "
+                         "already has a non-null value (wedge recovery)")
     args = ap.parse_args()
+    if args.resume and args.save is None:
+        sys.exit("--resume requires --save (there is no file to resume "
+                 "from or persist to)")
 
-    prior = _prior_best(args.scale, jax.default_backend())
-    results = []
     regressed = []
     benches = (
         (bench_jlt, "jlt_sketch_apply_GBps"),
@@ -295,13 +330,53 @@ def main():
             sys.exit(f"--only {args.only!r} matched no bench "
                      f"(available: {names})")
         benches = tuple(selected)
+    # backend in the filename: a round records the CPU-mesh and the
+    # on-chip suites as separate artifacts (one path per round made
+    # them overwrite each other); _prior_best reads both layouts
+    save_path = (os.path.join(
+        HERE, f"results_r{args.save:02d}_{jax.default_backend()}.json")
+        if args.save is not None else None)
+    # loaded whenever a save file exists: EVERY existing record is seeded
+    # into the (metric-keyed, insertion-ordered) results map, so a kill
+    # at any point — including mid-config on a wedged TPU — persists a
+    # superset of what the file already held. Selected configs replace
+    # their record in place when their measurement completes; --resume
+    # additionally skips re-measuring selected configs already captured.
+    existing = (_existing_results(save_path, args.scale,
+                                  jax.default_backend())
+                if save_path else {})
+    results: dict[str, dict] = dict(existing)
+    prior = _prior_best(args.scale, jax.default_backend(),
+                        exclude=save_path)
+
+    def _persist():
+        # after EVERY config, atomically: a tunnel wedge mid-suite must
+        # not lose the configs already measured (the r3 wedge pattern —
+        # windows of a few live minutes between multi-hour wedges)
+        out = {"round": args.save, "scale": args.scale,
+               "backend": jax.default_backend(),
+               "results": list(results.values())}
+        tmp = save_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(out, fh, indent=1)
+        os.replace(tmp, save_path)
+
     for fn, metric in benches:
-        try:
-            rec = fn(args.scale)
-        except Exception as e:  # record the failure under its REAL metric
-            rec = {"metric": metric, "value": None,
-                   "error": f"{type(e).__name__}: {e}"}
-        rec["backend"] = jax.default_backend()
+        kept = existing.get(metric) if args.resume else None
+        if kept is not None and kept.get("value") is not None:
+            # resumed records fall through to the gate computation below —
+            # a regression measured just before a wedge must still fail
+            # the --gate run that resumes it (prior takes the BEST across
+            # rounds, so the resumed value cannot mask itself)
+            rec = dict(kept)
+            rec["resumed"] = True
+        else:
+            try:
+                rec = fn(args.scale)
+            except Exception as e:  # record failure under its REAL metric
+                rec = {"metric": metric, "value": None,
+                       "error": f"{type(e).__name__}: {e}"}
+            rec["backend"] = jax.default_backend()
         m, v = rec.get("metric"), rec.get("value")
         if m in DIRECTIONS and m in prior:
             if isinstance(v, (int, float)):
@@ -314,20 +389,22 @@ def main():
                 # a previously-measured config that now crashes is the
                 # worst regression, not a free pass
                 regressed.append((m, 0.0))
-        results.append(rec)
+        held = results.get(metric)
+        if (rec.get("value") is None and held is not None
+                and held.get("value") is not None):
+            # a failed RE-measurement must not destroy captured evidence:
+            # keep the good record, note the failure alongside (the gate
+            # above still saw the crash)
+            err = rec.get("error") or "remeasure failed"
+            rec = dict(held)
+            rec["remeasure_error"] = err
+        results[metric] = rec
         print(json.dumps(rec), flush=True)
+        if save_path is not None:
+            _persist()
 
-    if args.save is not None:
-        out = {"round": args.save, "scale": args.scale,
-               "backend": jax.default_backend(), "results": results}
-        # backend in the filename: a round records the CPU-mesh and the
-        # on-chip suites as separate artifacts (one path per round made
-        # them overwrite each other); _prior_best reads both layouts
-        path = os.path.join(
-            HERE, f"results_r{args.save:02d}_{jax.default_backend()}.json")
-        with open(path, "w") as fh:
-            json.dump(out, fh, indent=1)
-        print(f"# saved {path}", file=sys.stderr)
+    if save_path is not None:
+        print(f"# saved {save_path}", file=sys.stderr)
 
     if args.gate and regressed:
         for m, r in regressed:

@@ -1,0 +1,212 @@
+#!/bin/bash
+# Tunnel-window harvester for the round-3 on-chip evidence package.
+#
+# The r2/r3 tunnel pattern is short live windows (minutes) between
+# multi-hour wedges. This watcher probes cheaply on a loop; the moment a
+# probe answers it runs the REMAINING evidence steps in value-per-second
+# order. Every step is idempotent — it checks its own artifact before
+# running — so the watcher survives any number of wedge/recover cycles
+# and a re-launch never repeats completed work.
+#
+# Steps (priority order; artifacts under benchmarks/):
+#   1. m-tile sweep points + pipelined-generation A/B on the headline
+#      config (results_tpu_r03_mtile_sweep.jsonl) — the ≥100 GB/s hunt
+#   2. full bench suite, all BASELINE configs, incremental + resumable
+#      (results_r03_tpu.json via run_all.py --resume)
+#   3. 32k² rand-SVD north-star rehearsal (results_svd_scale_r03.json)
+#
+# Usage: setsid nohup bash benchmarks/tpu_watch_r03.sh \
+#            > /tmp/tpu_watch_r03.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+END=$(( $(date +%s) + ${SKYLARK_WATCH_HOURS:-10} * 3600 ))
+
+log() { echo "[$(date -u +%H:%M:%S)] $*"; }
+
+# Every backend touch pins JAX_PLATFORMS=tpu: on a wedge between probe
+# and step, JAX would otherwise fall back to CPU — burning the window on
+# a chip-sized problem and saving misleading backend=cpu records. Pinned,
+# a wedged step fails fast instead. The probe also requires the literal
+# "PROBE_OK tpu" (a CPU-fallback PROBE_OK must not count as live).
+probe_ok() {
+    timeout 100 env JAX_PLATFORMS=tpu python bench.py --probe 2>/dev/null \
+        | grep -q "PROBE_OK tpu"
+}
+
+# ---- step predicates: 0 = already captured -------------------------------
+
+have_sweep_point() {  # have_sweep_point <m_tile> <pipeline 0|1>
+    python - "$1" "$2" <<'EOF'
+import json, sys
+mt, pipe = int(sys.argv[1]), int(sys.argv[2])
+try:
+    rows = [json.loads(l)
+            for l in open("benchmarks/results_tpu_r03_mtile_sweep.jsonl")
+            if l.strip()]
+except FileNotFoundError:
+    sys.exit(1)
+ok = any(r.get("m_tile") == mt and int(r.get("pipeline", 0)) == pipe
+         and (r.get("rec") or {}).get("value") is not None for r in rows)
+sys.exit(0 if ok else 1)
+EOF
+}
+
+have_runall() {
+    python - <<'EOF'
+import json, sys
+try:
+    recs = json.load(open("benchmarks/results_r03_tpu.json"))["results"]
+except Exception:
+    sys.exit(1)
+vals = {r["metric"]: r.get("value") for r in recs}
+# all 7 configs measured (value non-null) → done
+sys.exit(0 if len(vals) >= 7 and all(v is not None for v in vals.values())
+         else 1)
+EOF
+}
+
+have_svd_chip() {
+    python - <<'EOF'
+import json, sys
+try:
+    recs = json.load(open("benchmarks/results_svd_scale_r03.json"))
+except Exception:
+    sys.exit(1)
+# gate must have PASSED: a FAILing run writes a record too, and shipping
+# it as "captured" would end the watch with a failing north-star record
+ok = any(r.get("mode") == "chip" and r.get("backend") != "cpu"
+         and r.get("value") is not None
+         and r.get("accuracy_gate") == "pass" for r in recs)
+sys.exit(0 if ok else 1)
+EOF
+}
+
+# ---- steps ----------------------------------------------------------------
+
+sweep_point() {  # sweep_point <m_tile> <pipeline 0|1>
+    local mt=$1 pipe=$2 out=/tmp/sweep_${1}_${2}.json
+    log "sweep m_tile=$mt pipeline=$pipe"
+    # pipeline env passed unconditionally ("0" means disabled), so no
+    # empty-array expansion exists to trip `set -u` on older bash
+    timeout 360 env JAX_PLATFORMS=tpu SKYLARK_PALLAS_MTILE=$mt \
+        SKYLARK_PALLAS_PIPELINE=$pipe \
+        SKYLARK_BENCH_DEADLINE=300 SKYLARK_BENCH_SKIP_EXTRAS=1 \
+        python bench.py > "$out" 2>/tmp/sweep_err.log
+    python - "$out" "$mt" "$pipe" <<'EOF'
+import datetime, json, sys
+out, mt, pipe = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+lines = [l for l in open(out) if l.strip()]
+if not lines:
+    sys.exit(1)
+rec = json.loads(lines[-1])
+if rec.get("value") is None:
+    print("  -> null:", (rec.get("error") or "")[:160])
+    sys.exit(1)
+row = {"m_tile": mt, "pipeline": pipe,
+       "captured": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+       "rec": rec}
+with open("benchmarks/results_tpu_r03_mtile_sweep.jsonl", "a") as f:
+    f.write(json.dumps(row) + "\n")
+print("  -> captured", rec["value"], "GB/s")
+EOF
+}
+
+# One watcher pass: attempt every remaining step while the tunnel lives.
+# After a step fails, a quick re-probe discriminates wedge from
+# deterministic failure: wedged → return to cheap probing (don't burn the
+# remaining steps' timeouts); still live → keep going so one persistently
+# failing step can't starve the steps after it (e.g. a crashing run_all
+# config must not block the svd rehearsal for the whole watch).
+# Deterministic-failure cap: a step that fails twice while the tunnel is
+# LIVE (probe passes right after the failure) is given up for this
+# watcher process — a hopeless config at the head of the list must not
+# burn every few-minute live window for the whole watch. Wedge failures
+# (probe fails after the step) don't count toward the cap.
+declare -A FAILS
+
+give_up() { [ "${FAILS[$1]:-0}" -ge 2 ]; }
+
+note_fail() {  # note_fail <step-key> → rc 1 on wedge (stop this pass)
+    if probe_ok; then
+        FAILS[$1]=$(( ${FAILS[$1]:-0} + 1 ))
+        if give_up "$1"; then
+            log "step $1 failed ${FAILS[$1]}x live — giving up on it"
+        fi
+        return 0
+    fi
+    return 1
+}
+
+# m_tile/pipeline sweep points, priority order — single list shared by
+# attempt_all and all_done (drift between two copies would either stall
+# the watch or end it early)
+SWEEP_SPECS=("1024 0" "1024 1" "512 1" "512 0" "256 0")
+
+attempt_all() {
+    local failed=0
+    for spec in "${SWEEP_SPECS[@]}"; do
+        set -- $spec
+        if ! have_sweep_point "$1" "$2" && ! give_up "sweep_$1_$2"; then
+            if ! sweep_point "$1" "$2"; then
+                failed=1
+                note_fail "sweep_$1_$2" || return 1
+            fi
+        fi
+    done
+    if ! have_runall && ! give_up runall; then
+        log "run_all --scale full --save 3 --resume"
+        timeout 2400 env JAX_PLATFORMS=tpu python benchmarks/run_all.py \
+            --scale full --save 3 --resume 2>&1 | tail -12
+        if ! have_runall; then
+            failed=1
+            note_fail runall || return 1
+        fi
+    fi
+    if ! have_svd_chip && ! give_up svd; then
+        log "svd_scale --mode chip"
+        timeout 900 env JAX_PLATFORMS=tpu \
+            python benchmarks/svd_scale.py --mode chip --save \
+            2>&1 | tail -3
+        if ! have_svd_chip; then
+            failed=1
+            note_fail svd || return 1
+        fi
+    fi
+    return $failed
+}
+
+all_done() {
+    for spec in "${SWEEP_SPECS[@]}"; do
+        set -- $spec
+        have_sweep_point "$1" "$2" || return 1
+    done
+    have_runall && have_svd_chip
+}
+
+log "watch start (deadline $(date -u -d @$END +%H:%M:%S))"
+while [ "$(date +%s)" -lt "$END" ]; do
+    if all_done; then
+        log "ALL STEPS CAPTURED — exiting"
+        exit 0
+    fi
+    if probe_ok; then
+        log "tunnel LIVE — attempting remaining steps"
+        if attempt_all; then
+            if all_done; then
+                log "ALL STEPS CAPTURED — exiting"
+                exit 0
+            fi
+            # exit code distinguishes an incomplete package from success
+            # (0 = all captured, 2 = deadline, 3 = steps given up)
+            log "remaining steps given up after repeated live" \
+                "failures — exiting"
+            exit 3
+        fi
+        log "step failed — back to probing"
+    else
+        log "wedged"
+    fi
+    sleep 150
+done
+log "deadline reached with steps remaining"
+exit 2

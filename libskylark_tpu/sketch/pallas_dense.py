@@ -313,16 +313,24 @@ def _scratch(s_dim: int, n: int, m: int, m_tile: int):
     return [pltpu.VMEM((s_dim, n_blocks * BLOCK_COLS), jnp.float32)]
 
 
-def _select_pipe(kern, pipe_kern, scratch, s_dim: int, m_tile: int):
-    """Swap in the pipelined kernel + generation double buffer when the
-    operator-cache scratch doesn't apply (the big-operator regime),
-    SKYLARK_PALLAS_PIPELINE=1, and the buffer fits the same VMEM budget
-    _qualify planned against — over budget, stay on the plain kernel (no
-    fallback seam exists on the shard_map path)."""
+def _pipe_fits(scratch, s_dim: int, m_tile: int) -> bool:
+    """Pipelined-generation selection predicate — the SINGLE source of
+    truth shared by the kernel call sites (via :func:`_select_pipe`) and
+    :func:`effective_plan`, so the reported plan can't drift from the
+    executed one: engage when the operator-cache scratch doesn't apply
+    (the big-operator regime), SKYLARK_PALLAS_PIPELINE=1, and the double
+    buffer fits the same VMEM budget _qualify planned against."""
     pipe_bytes = 2 * s_dim * BLOCK_COLS * 4
-    if (not scratch and pipe_kern is not None and _pipeline_enabled()
+    return (not scratch and _pipeline_enabled()
             and _vmem_estimate(m_tile, s_dim, pipe_bytes)
-            <= _VMEM_BUDGET_BYTES):
+            <= _VMEM_BUDGET_BYTES)
+
+
+def _select_pipe(kern, pipe_kern, scratch, s_dim: int, m_tile: int):
+    """Swap in the pipelined kernel + generation double buffer when
+    :func:`_pipe_fits` says so — over budget, stay on the plain kernel
+    (no fallback seam exists on the shard_map path)."""
+    if pipe_kern is not None and _pipe_fits(scratch, s_dim, m_tile):
         return pipe_kern, [pltpu.VMEM((2, s_dim, BLOCK_COLS), jnp.float32)]
     return kern, scratch
 
@@ -534,10 +542,19 @@ def _block_keys(key, n: int) -> jnp.ndarray:
     ).astype(jnp.uint32)
 
 
+def _padded_extents(n: int, m: int, mt: int) -> tuple[int, int]:
+    """Padded (seq, other) extents of an apply: seq to a BLOCK_COLS
+    multiple, the other to an mt multiple — shared by :func:`_padded`
+    and :func:`effective_plan` so the plan sees the kernel's real
+    shapes."""
+    return _pad_to(n, BLOCK_COLS), _pad_to(max(m, 8), mt)
+
+
 def _padded(A, seq_axis: int, mt: int):
     """Zero-pad A so seq axis % BLOCK_COLS == 0 and the other % mt == 0."""
     n, m = A.shape[seq_axis], A.shape[1 - seq_axis]
-    pn, pm = _pad_to(n, BLOCK_COLS) - n, _pad_to(max(m, 8), mt) - m
+    n_p, m_p = _padded_extents(n, m, mt)
+    pn, pm = n_p - n, m_p - m
     if pn == 0 and pm == 0:
         return A
     pads = [(0, pn), (0, pm)] if seq_axis == 0 else [(0, pm), (0, pn)]
@@ -686,6 +703,34 @@ def fused_partial(
     if seq_axis == 1:
         return _fused_call(Ap, keys, **kw)[:m]
     return _fused_call_cw(Ap, keys, **kw)[:, :m]
+
+
+def effective_plan(dist, shape, dtype, s_dim: int, seq_axis: int,
+                   m_tile: int | None = None,
+                   interpret: bool = False) -> dict:
+    """The plan a fused apply with these arguments would actually run —
+    WITHOUT running it. Both tuning knobs can be silently adjusted
+    downstream (:func:`_qualify` shrinks an over-budget m-tile;
+    :func:`_select_pipe` drops the pipelined kernel when its buffer
+    doesn't fit), so anything recording a measurement labeled with the
+    REQUESTED knobs must ask for the EFFECTIVE ones or the record lies
+    about what was measured (e.g. the m-tile/pipeline sweep rows in
+    benchmarks/).
+
+    Returns ``{"kernel": False}`` when the apply would take the XLA
+    fallback, else ``kernel/m_tile/operator_cache/pipelined``."""
+    m_tile = m_tile or _DEFAULT_M_TILE()
+    A = jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+    mt = _qualify(dist, A, seq_axis=seq_axis, m_tile=m_tile,
+                  interpret=interpret, s_dim=s_dim)
+    if mt is None:
+        return {"kernel": False}
+    # the same padding/scratch/pipeline helpers the pallas_call sites use
+    n_p, m_p = _padded_extents(shape[seq_axis], shape[1 - seq_axis], mt)
+    scratch = _scratch(s_dim, n_p, m_p, mt)
+    return {"kernel": True, "m_tile": mt,
+            "operator_cache": bool(scratch),
+            "pipelined": _pipe_fits(scratch, s_dim, mt)}
 
 
 def jr_key_data(k):

@@ -407,3 +407,43 @@ def test_fused_on_chip_pipelined(monkeypatch):
         jlt._alloc.key, jlt.dist, A, s, jlt.scale,
         m_tile=32, precision="bf16x3"))
     np.testing.assert_array_equal(piped, plain)
+
+
+def test_effective_plan_reports_actual_config(monkeypatch):
+    """effective_plan must report what the kernel would RUN, not what was
+    requested: _qualify silently shrinks over-budget m-tiles and
+    _select_pipe can drop the pipeline buffer, so sweep records labeled
+    with requested knobs would lie about the measurement (the m-tile
+    sweep in benchmarks/ keys its rows off this)."""
+    dist = randgen.Normal()
+    monkeypatch.delenv("SKYLARK_PALLAS_PIPELINE", raising=False)
+
+    # headline shape, requested tile fits: honored, operator too big to
+    # cache (32 MiB > cap), no pipeline without the env
+    p = pd.effective_plan(dist, (8192, 8192), jnp.float32, 1024,
+                          seq_axis=1, m_tile=1024, interpret=True)
+    assert p == {"kernel": True, "m_tile": 1024, "operator_cache": False,
+                 "pipelined": False}
+
+    # requested tile exceeds the VMEM plan: pre-shrunk, and the plan says
+    # so (this is the silent adjustment the record must surface)
+    p = pd.effective_plan(dist, (8192, 8192), jnp.float32, 1024,
+                          seq_axis=1, m_tile=2048, interpret=True)
+    assert p["m_tile"] < 2048
+
+    # pipeline honored only in the big-operator regime with the env set
+    monkeypatch.setenv("SKYLARK_PALLAS_PIPELINE", "1")
+    p = pd.effective_plan(dist, (8192, 8192), jnp.float32, 1024,
+                          seq_axis=1, m_tile=1024, interpret=True)
+    assert p["pipelined"] is True and p["operator_cache"] is False
+
+    # small operator: VMEM cache engages and suppresses the pipeline
+    # (cache already amortizes generation)
+    p = pd.effective_plan(dist, (1024, 1024), jnp.float32, 128,
+                          seq_axis=1, m_tile=256, interpret=True)
+    assert p["operator_cache"] is True and p["pipelined"] is False
+
+    # unsupported dtype: the apply would take the XLA fallback
+    p = pd.effective_plan(dist, (1024, 1024), jnp.float64, 128,
+                          seq_axis=1, m_tile=256, interpret=True)
+    assert p == {"kernel": False}
