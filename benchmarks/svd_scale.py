@@ -184,8 +184,13 @@ def main():
             with open(path) as fh:
                 recs = json.load(fh)
         recs = [r for r in recs if r.get("mode") != rec["mode"]] + [rec]
-        with open(path, "w") as fh:
+        # atomic: the watcher runs this under `timeout`, and a SIGTERM
+        # between a truncating open and the dump's end would destroy the
+        # other mode's captured record
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
             json.dump(recs, fh, indent=1)
+        os.replace(tmp, path)
     if rec["accuracy_gate"] != "pass":
         sys.exit(1)
 
