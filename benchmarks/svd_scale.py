@@ -181,8 +181,14 @@ def main():
         path = os.path.join(HERE, "results_svd_scale_r03.json")
         recs = []
         if os.path.exists(path):
-            with open(path) as fh:
-                recs = json.load(fh)
+            try:
+                with open(path) as fh:
+                    recs = json.load(fh)
+            except Exception:
+                # a file torn by an earlier SIGTERM mid-write must not
+                # brick every later save — preserve the evidence of the
+                # tear, start the list fresh
+                os.replace(path, path + ".corrupt")
         recs = [r for r in recs if r.get("mode") != rec["mode"]] + [rec]
         # atomic: the watcher runs this under `timeout`, and a SIGTERM
         # between a truncating open and the dump's end would destroy the

@@ -131,3 +131,50 @@ class TestShardedADMM:
         np.testing.assert_allclose(
             np.asarray(sharded.coef), np.asarray(local.coef),
             atol=1e-3, rtol=1e-3)
+
+
+class TestShardedKRRNp7:
+    """np=7: the remaining rank count of the reference's mpirun sweep
+    (ref: tests/unit/CMakeLists.txt:10-46 — np ∈ {1,4,5,7}; 1 and 4 are
+    the local and mesh1d cases above, 5 the ragged class). 252 = 7·36
+    keeps dense shardings divisible."""
+
+    def test_approximate_kernel_ridge_np7_submesh(self, data, devices):
+        X, Y = data
+        X, Y = X[:252], Y[:252]
+        mesh7 = par.make_mesh(devices=devices[:7])
+        k = kernels.Gaussian(X.shape[1], sigma=2.0)
+        fmap_l, w_l = krr.approximate_kernel_ridge(
+            k, jnp.asarray(X), jnp.asarray(Y), 0.01, s=64,
+            context=Context(seed=3))
+        Xs = par.distribute(X, par.row_sharded(mesh7))
+        fmap_s, w_s = krr.approximate_kernel_ridge(
+            k, Xs, jnp.asarray(Y), 0.01, s=64, context=Context(seed=3))
+        np.testing.assert_allclose(np.asarray(w_s), np.asarray(w_l),
+                                   atol=1e-3, rtol=1e-3)
+
+    @pytest.mark.slow
+    def test_admm_np7_submesh_matches_local(self, data, devices):
+        from libskylark_tpu.algorithms.prox import (
+            L2Regularizer,
+            SquaredLoss,
+        )
+        from libskylark_tpu.ml.admm import BlockADMMSolver
+
+        X, Y = data
+        X, Y = X[:252], Y[:252]
+        y = (Y > 0).astype(np.int64)
+        mesh7 = par.make_mesh(devices=devices[:7])
+
+        def train(Xin):
+            s = BlockADMMSolver(SquaredLoss(), L2Regularizer(), 0.01,
+                                X.shape[1], num_partitions=2)
+            s.maxiter = 6
+            s.tol = 0.0
+            return s.train(Xin, y)
+
+        local = train(jnp.asarray(X))
+        sharded = train(par.distribute(X, par.row_sharded(mesh7)))
+        np.testing.assert_allclose(
+            np.asarray(sharded.coef), np.asarray(local.coef),
+            atol=1e-3, rtol=1e-3)
