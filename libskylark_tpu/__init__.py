@@ -16,6 +16,30 @@ data layout (ref: base/randgen.hpp:98-115, base/context.hpp:19-194).
 
 __version__ = "0.1.0"
 
+
+def _honor_platform_env() -> None:
+    """Make an explicit ``JAX_PLATFORMS`` request effective even where a
+    ``sitecustomize`` pre-imported jax with another platform pinned (the
+    axon image does; the env var is only read at first jax import, so a
+    user's ``JAX_PLATFORMS=cpu skylark_ml ...`` would otherwise silently
+    target — and hang on — a wedged TPU tunnel). Same post-import update
+    the test conftest and benchmarks use; no-op when unset."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    try:
+        if jax.config.jax_platforms != want:
+            jax.config.update("jax_platforms", want)
+    except Exception:
+        pass  # never block import over a platform hint
+
+
+_honor_platform_env()
+
 from libskylark_tpu.base.precision import install_default_matmul_precision
 
 # f32 matmuls must actually be f32 on TPU (default lowering is one bf16

@@ -61,6 +61,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--valfile", default="")
     p.add_argument("--testfile", default="")
     p.add_argument("--outputfile", default="")
+    p.add_argument("--checkpoint-dir", default="",
+                   help="persist ADMM state here every "
+                        "--checkpoint-every iterations; rerunning with "
+                        "the same directory resumes (bit-identical to "
+                        "an uninterrupted run)")
+    p.add_argument("--checkpoint-every", type=int, default=10)
     return p
 
 
@@ -176,6 +182,8 @@ def _train(args) -> int:
         Yn, Xv=Xv if Xv is None or not hasattr(Xv, "todense")
         else Xv.todense(),
         Yv=Yv, regression=args.regression, verbose=True,
+        checkpoint=args.checkpoint_dir or None,
+        checkpoint_every=args.checkpoint_every,
     )
     print(f"Training took {time.time() - t0:.2e} sec")
     if classes is not None:

@@ -154,12 +154,28 @@ class BlockADMMSolver:
         regression: bool = False,
         num_targets: Optional[int] = None,
         verbose: bool = False,
+        checkpoint=None,
+        checkpoint_every: int = 10,
     ) -> HilbertModel:
         """Run ADMM (ref: BlockADMM.hpp:291-600). X is (n, d) rows=examples;
         Y is (n,) — real targets for regression, integer class labels
         (0..k−1) for classification. Returns the trained model; if
         (Xv, Yv) is given, validation error/accuracy is reported per
-        iteration through ``verbose``."""
+        iteration through ``verbose``.
+
+        ``checkpoint`` (a directory path or
+        :class:`~libskylark_tpu.utility.TrainCheckpointer`) persists the
+        full consensus carry every ``checkpoint_every`` iterations —
+        asynchronously, so the save streams out while later iterations
+        compute — and a rerun over the same directory resumes from the
+        newest step, bit-identical to the uninterrupted run (the step is
+        deterministic given the data and the maps' (seed, counter)).
+        Resume refuses checkpoints from a different run (data, maps,
+        hyperparameters, or dtype — a fingerprint is validated), and a
+        run that already finished (maxiter reached or tol convergence,
+        recorded in the metadata) is returned as-is rather than trained
+        further. The reference restarts a killed run from zero (no
+        counterpart; its §5 checkpoint row is empty)."""
         X = jnp.asarray(X)
         Y = jnp.asarray(Y).reshape(-1)
         n, d = X.shape
@@ -191,21 +207,6 @@ class BlockADMMSolver:
         # Reset so each train() reports its own run, not cumulative totals.
         timer = get_timer("admm")
         timer.reset()
-
-        # Cached per-block factorizations (ZⱼᵀZⱼ + I)⁻¹ (ref: :435-441 at
-        # iter 1; hoisted here since Zⱼ is deterministic given the maps).
-        caches = []
-        Zs = []
-        for j in range(P):
-            with timer.phase("TRANSFORM"):
-                Z = self._block_features(X, j)
-            sj = self.block_sizes[j]
-            with timer.phase("FACTORIZATION"):
-                caches.append(
-                    jsl.cho_factor(Z.T @ Z + jnp.eye(sj, dtype=dt))
-                )
-            if self.cache_transforms:
-                Zs.append(Z)
 
         loss, reg = self.loss, self.regularizer
         lam, rho = self.lam, self.rho
@@ -275,24 +276,163 @@ class BlockADMMSolver:
             jnp.zeros((k, n), dt),   # del_o
         )
 
-        for it in range(1, self.maxiter + 1):
-            with timer.phase("ITERATIONS"):
-                carry, (objective, reldel) = step_jit(carry)
-                if timers_enabled():
-                    jax.block_until_ready(carry)  # attribute device time here
-            model.coef = carry[0]
-            if verbose:
-                msg = f"iteration {it} objective {float(objective):.6g}"
-                if Xv is not None:
-                    with timer.phase("PREDICTION"):
-                        acc = self._validate(model, Xv, Yv, regression)
-                    msg += f" accuracy {acc:.4g}"
-                print(msg)
-            # Convergence on relative change of the consensus iterate. (The
-            # reference carries TOL but never reads it in the train loop —
-            # here the knob is honored; set tol=0 to force maxiter sweeps.)
-            if self.tol > 0 and it > 1 and float(reldel) <= self.tol:
-                break
+        # Resume identity: a checkpoint is only valid for the SAME
+        # training run — same data, maps, losses, and hyperparameters.
+        # Restoring a carry into a different objective would converge to
+        # something that matches neither run, silently. The fingerprint
+        # covers everything the iteration reads.
+        def _identity() -> str:
+            import hashlib
+
+            h = hashlib.sha256()
+            # loss/reg hashed with their constructor state (two
+            # LogisticLosses with different Newton budgets iterate
+            # different proxes), and the compute dtype (an f32 carry must
+            # not resume into an f64 run)
+            h.update(repr((
+                type(loss).__name__, sorted(vars(loss).items()),
+                type(reg).__name__, sorted(vars(reg).items()),
+                lam, rho, list(self.block_sizes), self.scale_maps,
+                int(D), int(k), int(n), int(d), bool(regression),
+                str(dt),
+            )).encode())
+            for fm in self.feature_maps:
+                h.update(fm.to_json().encode())
+
+            # Data fingerprint: device-side f32 reductions (no host
+            # gather of a possibly huge sharded X), POSITION-WEIGHTED so
+            # a row/column permutation — which would misalign the
+            # restored per-example duals — changes the hash; the plain
+            # sum is included as a second independent statistic. f32
+            # accumulation keeps the value independent of the x64 flag
+            # at restore time.
+            def pos_sum(a):
+                w = jnp.cos(
+                    jnp.arange(a.shape[0], dtype=jnp.float32) * 0.73 + 0.2)
+                if a.ndim == 2:
+                    w2 = jnp.cos(
+                        jnp.arange(a.shape[1], dtype=jnp.float32) * 1.37
+                        + 0.4)
+                    return jnp.sum(a * w[:, None] * w2[None, :],
+                                   dtype=jnp.float32)
+                return jnp.sum(a * w, dtype=jnp.float32)
+
+            for stat in (pos_sum(X), jnp.sum(X, dtype=jnp.float32),
+                         pos_sum(Y), jnp.sum(Y, dtype=jnp.float32)):
+                h.update(np.asarray(stat).tobytes())
+            return h.hexdigest()
+
+        ckpt = None
+        ckpt_owned = False
+        start_it = 1
+        ident = None
+        resume_finished = False
+        if checkpoint is not None:
+            ident = _identity()
+            from libskylark_tpu.utility.checkpoint import (
+                TrainCheckpointer,
+                as_checkpointer,
+                device_state,
+            )
+
+            # a path argument means this train() owns the checkpointer's
+            # lifecycle: it must finalize the async writes before
+            # returning, or a rerun over the directory races the
+            # still-in-flight final save
+            ckpt_owned = not isinstance(checkpoint, TrainCheckpointer)
+            ckpt = as_checkpointer(checkpoint)
+            try:
+                if ckpt.latest_step() is not None:
+                    # metadata first: identity must be validated BEFORE
+                    # state restore (a mismatched state would die inside
+                    # orbax on shapes, not on this friendly error)
+                    step0, meta = ckpt.metadata()
+                    if meta.get("identity") != ident:
+                        raise errors.InvalidParametersError(
+                            f"checkpoint at {checkpoint} belongs to a "
+                            "different training run (data, feature maps "
+                            "or hyperparameters differ) — refusing to "
+                            "resume"
+                        )
+                    if step0 > self.maxiter:
+                        raise errors.InvalidParametersError(
+                            f"checkpoint at {checkpoint} is at iteration "
+                            f"{step0} > maxiter={self.maxiter}; returning "
+                            "it would silently over-train — raise maxiter "
+                            "or point at a fresh directory"
+                        )
+                    # target=the zero carry: restores with the live
+                    # structure/dtypes (and shardings, once jitted)
+                    _, state, _ = ckpt.restore(step0, target=list(carry))
+                    carry = tuple(device_state(state, dt))
+                    start_it = step0 + 1
+                    # a run that stopped on tol convergence is DONE:
+                    # "resuming" it one more iteration per rerun would
+                    # drift from the uninterrupted result
+                    resume_finished = bool(meta.get("converged", False))
+            except BaseException:
+                if ckpt_owned:
+                    ckpt.close()
+                raise
+
+        # Cached per-block factorizations (ZⱼᵀZⱼ + I)⁻¹ (ref: :435-441 at
+        # iter 1; hoisted since Zⱼ is deterministic given the maps) —
+        # built only when iterations will actually run, so resuming a
+        # finished run returns without paying TRANSFORM/FACTORIZATION.
+        caches = []
+        Zs = []
+        if not resume_finished and start_it <= self.maxiter:
+            for j in range(P):
+                with timer.phase("TRANSFORM"):
+                    Z = self._block_features(X, j)
+                sj = self.block_sizes[j]
+                with timer.phase("FACTORIZATION"):
+                    caches.append(
+                        jsl.cho_factor(Z.T @ Z + jnp.eye(sj, dtype=dt))
+                    )
+                if self.cache_transforms:
+                    Zs.append(Z)
+
+        def _save(it, carry, converged=False):
+            with timer.phase("CHECKPOINT"):
+                ckpt.save(it, list(carry),
+                          {"identity": ident, "iteration": int(it),
+                           "converged": bool(converged)})
+
+        it = start_it - 1
+        converged = False
+        try:
+            for it in [] if resume_finished else \
+                    range(start_it, self.maxiter + 1):
+                with timer.phase("ITERATIONS"):
+                    carry, (objective, reldel) = step_jit(carry)
+                    if timers_enabled():
+                        jax.block_until_ready(carry)  # device time here
+                model.coef = carry[0]
+                if verbose:
+                    msg = f"iteration {it} objective {float(objective):.6g}"
+                    if Xv is not None:
+                        with timer.phase("PREDICTION"):
+                            acc = self._validate(model, Xv, Yv, regression)
+                        msg += f" accuracy {acc:.4g}"
+                    print(msg)
+                # Convergence on relative change of the consensus iterate.
+                # (The reference carries TOL but never reads it in the
+                # train loop — here the knob is honored; set tol=0 to
+                # force maxiter sweeps.)
+                if self.tol > 0 and it > 1 and float(reldel) <= self.tol:
+                    converged = True
+                    break
+                if ckpt is not None and checkpoint_every > 0 \
+                        and it % int(checkpoint_every) == 0 \
+                        and it < self.maxiter:
+                    _save(it, carry)
+
+            if ckpt is not None and it >= start_it:
+                _save(it, carry, converged)  # final (post-break/maxiter)
+        finally:
+            if ckpt is not None and ckpt_owned:
+                ckpt.close()
 
         model.coef = carry[0]
         if timers_enabled():

@@ -1,5 +1,7 @@
-"""Utility layer: profiling/timers and observability helpers
-(SURVEY.md §2.6/§5; ref: utility/timer.hpp, utility/external/print.hpp)."""
+"""Utility layer: profiling/timers, observability, and training-state
+checkpointing (SURVEY.md §2.6/§5; ref: utility/timer.hpp,
+utility/external/print.hpp — checkpoint/resume has no reference
+counterpart: the §5 aux-subsystem row is empty there)."""
 
 from libskylark_tpu.utility.timer import (
     PhaseTimer,
@@ -8,4 +10,25 @@ from libskylark_tpu.utility.timer import (
     timers_enabled,
 )
 
-__all__ = ["PhaseTimer", "get_timer", "set_enabled", "timers_enabled"]
+__all__ = [
+    "PhaseTimer",
+    "TrainCheckpointer",
+    "as_checkpointer",
+    "device_state",
+    "get_timer",
+    "set_enabled",
+    "timers_enabled",
+]
+
+_CHECKPOINT_NAMES = ("TrainCheckpointer", "as_checkpointer", "device_state")
+
+
+def __getattr__(name):
+    # PEP 562 lazy re-export: checkpoint.py imports orbax (~seconds of
+    # startup), which must not be paid by every `import libskylark_tpu`
+    # that never checkpoints
+    if name in _CHECKPOINT_NAMES:
+        from libskylark_tpu.utility import checkpoint
+
+        return getattr(checkpoint, name)
+    raise AttributeError(name)

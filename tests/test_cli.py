@@ -255,3 +255,36 @@ class TestLabelCoding:
         skio.write_libsvm(p, X, (X[:, 0] > 0).astype(np.float32))
         rc = skylark_ml.main([str(p)])
         assert rc == 2
+
+
+class TestMLCheckpointResume:
+    def test_train_resume_matches_uninterrupted(self, tmp_path):
+        pytest.importorskip("orbax.checkpoint")
+        """--checkpoint-dir: a killed training run rerun with the same
+        directory must produce the same model as one uninterrupted run
+        (the ADMM carry is persisted and resumed)."""
+        rng = np.random.default_rng(11)
+        X = rng.standard_normal((80, 6)).astype(np.float32)
+        y = X[:, 0].astype(np.float32)
+        p = tmp_path / "reg.libsvm"
+        skio.write_libsvm(p, X, y)
+
+        from libskylark_tpu.ml.model import HilbertModel
+
+        ref_model = str(tmp_path / "ref.json")
+        common = ["-c", "0.001", "-e", "0", "--regression"]
+        assert skylark_ml.main([str(p), ref_model, "-i", "8"] + common) == 0
+
+        ck = str(tmp_path / "ck")
+        part = str(tmp_path / "part.json")
+        assert skylark_ml.main(
+            [str(p), part, "-i", "5", "--checkpoint-dir", ck,
+             "--checkpoint-every", "2"] + common) == 0
+        resumed = str(tmp_path / "resumed.json")
+        assert skylark_ml.main(
+            [str(p), resumed, "-i", "8", "--checkpoint-dir", ck,
+             "--checkpoint-every", "2"] + common) == 0
+
+        np.testing.assert_array_equal(
+            np.asarray(HilbertModel.load(resumed).coef),
+            np.asarray(HilbertModel.load(ref_model).coef))
