@@ -16,29 +16,16 @@ data layout (ref: base/randgen.hpp:98-115, base/context.hpp:19-194).
 
 __version__ = "0.1.0"
 
-
-def _honor_platform_env() -> None:
-    """Make an explicit ``JAX_PLATFORMS`` request effective even where a
-    ``sitecustomize`` pre-imported jax with another platform pinned (the
-    axon image does; the env var is only read at first jax import, so a
-    user's ``JAX_PLATFORMS=cpu skylark_ml ...`` would otherwise silently
-    target — and hang on — a wedged TPU tunnel). Same post-import update
-    the test conftest and benchmarks use; no-op when unset."""
-    import os
-
-    want = os.environ.get("JAX_PLATFORMS")
-    if not want:
-        return
-    import jax
-
-    try:
-        if jax.config.jax_platforms != want:
-            jax.config.update("jax_platforms", want)
-    except Exception:
-        pass  # never block import over a platform hint
-
-
-_honor_platform_env()
+# NOTE on platform selection: the package deliberately does NOT touch
+# ``jax_platforms`` at import. On images whose sitecustomize pre-imports
+# jax with a pinned platform, honoring ``JAX_PLATFORMS`` here would
+# equally clobber a script's deliberate post-import
+# ``jax.config.update("jax_platforms", ...)`` (the ambient environment
+# may export the pinned platform globally, making "the user set the env
+# var" undetectable). The CLI entry points — applications, not library
+# code — honor the env var instead (cli.honor_platform_env), and library
+# scripts use the documented post-import config update (the
+# tests/conftest.py pattern).
 
 from libskylark_tpu.base.precision import install_default_matmul_precision
 

@@ -33,6 +33,38 @@ def read_dataset(path: str, fileformat: int, min_d: int = 0):
     raise SystemExit(f"unknown fileformat {fileformat}")
 
 
+def honor_platform_env() -> None:
+    """Make an explicit ``JAX_PLATFORMS`` effective for a CLI run even
+    where a ``sitecustomize`` pre-imported jax with another platform
+    pinned (the env var is only read at first jax import, so
+    ``JAX_PLATFORMS=cpu skylark_ml ...`` would otherwise silently target
+    — and on a wedged tunnel, hang on — the pinned accelerator).
+
+    Called at the top of every CLI ``main``. Application-level on
+    purpose: the library must not mutate platform config at import (a
+    script's own ``jax.config.update`` would be clobbered — the ambient
+    image exports the pinned platform's env var globally, so "the user
+    set it" is undetectable there). Acts only while jax's backends are
+    still uninitialized: inside a host process that already chose a
+    platform (e.g. the test suite's conftest), it is a no-op."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if getattr(_xb, "_backends", None):
+            return  # backends live — too late, and someone chose already
+        if jax.config.jax_platforms != want:
+            jax.config.update("jax_platforms", want)
+    except Exception:
+        pass  # never block a CLI over a platform hint
+
+
 def write_ascii_matrix(path: str, M, digits: int = 8) -> None:
     """El::Write(..., El::ASCII) equivalent (ref: nla/skylark_svd.cpp:110)."""
     np.savetxt(path, np.asarray(M), fmt=f"%.{digits}g")

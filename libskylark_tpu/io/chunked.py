@@ -302,6 +302,8 @@ def stream_sketch_libsvm(
     batch_rows: int = 4096,
     num_classes: int = 0,
     max_n: int = -1,
+    checkpoint=None,
+    checkpoint_every: int = 0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Sketch a libsvm source down to ``s`` rows in bounded memory:
     chunked parse → :class:`StreamingCWT`. Equals the one-shot
@@ -323,4 +325,6 @@ def stream_sketch_libsvm(
     n, d, _ = scan_libsvm_dims(source, max_n)
     sk = StreamingCWT(n, s, context)
     batches = iter_libsvm_batches(source, batch_rows, d=d, max_n=max_n)
-    return sk.sketch(batches, num_classes=num_classes)
+    return sk.sketch(batches, num_classes=num_classes,
+                     checkpoint=checkpoint,
+                     checkpoint_every=checkpoint_every)

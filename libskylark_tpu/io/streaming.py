@@ -39,47 +39,165 @@ class StreamingCWT:
     def transform(self) -> CWT:
         return self._cwt
 
+    def _identity(self, num_classes: int) -> str:
+        """Resume fingerprint: the sketch configuration. The stream's
+        CONTENT can't be hashed without consuming it; the first batch is
+        verified positionally at resume time instead (see ``sketch``)."""
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(repr((self._n, self._s, int(num_classes))).encode())
+        h.update(self._cwt.to_json().encode())
+        return h.hexdigest()
+
+    @staticmethod
+    def _batch_hash(X) -> float:
+        """Position-weighted f32 statistic of a batch — row/value
+        permutations change it (a global sum would not)."""
+        from libskylark_tpu.utility.checkpoint import (
+            positional_fingerprint,
+        )
+
+        return positional_fingerprint(X)
+
     def sketch(
         self,
         batches: Iterable[Tuple[np.ndarray, np.ndarray]],
         num_classes: int = 0,
+        checkpoint=None,
+        checkpoint_every: int = 0,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Consume ``(X, Y)`` minibatches; return ``(SX, SY)``.
 
         ``num_classes > 2`` dummy-codes labels to ±1 one-vs-all before
         sketching (ref: streaming.py:13-17 + ml/utils dummycode).
-        """
+
+        ``checkpoint`` (directory path or
+        :class:`~libskylark_tpu.utility.TrainCheckpointer`) persists the
+        accumulators every ``checkpoint_every`` batches; a rerun over
+        the same directory fast-forwards the stream past the rows
+        already folded in (re-reading but not re-sketching them) and
+        continues — result identical to the uninterrupted pass (the
+        accumulation is a sum of per-batch scatters; the counter-based
+        hash streams are position-keyed, not order-keyed). Resume
+        validates the sketch configuration AND the first re-read batch
+        against the checkpoint (a different stream must refuse); the
+        batching must be byte-identical across runs (a batch straddling
+        the saved row offset refuses)."""
+        from libskylark_tpu.base import errors
+
         h_all = np.asarray(self._cwt.bucket_indices())
         v_all = np.asarray(self._cwt.values(jnp.float32))
         SX: Optional[jnp.ndarray] = None
         SY: Optional[jnp.ndarray] = None
         row0 = 0
-        for X, Y in batches:
-            X = jnp.asarray(X)
-            Y = np.asarray(Y)
-            nb = X.shape[0]
-            if row0 + nb > self._n:
-                raise ValueError(
-                    f"stream longer than declared n={self._n}")
-            if num_classes > 2:
-                Yb, _ = dummy_coding(
-                    Y.reshape(-1), coding=list(range(num_classes)))
-                Yb = jnp.asarray(Yb)
-            else:
-                Yb = jnp.asarray(Y.astype(np.float32))
-                if Yb.ndim == 1:
-                    Yb = Yb[:, None]
-            h = jnp.asarray(h_all[row0:row0 + nb])
-            v = jnp.asarray(v_all[row0:row0 + nb])
-            SXb = jnp.zeros((self._s, X.shape[1]), X.dtype).at[h].add(
-                v[:, None] * X)
-            SYb = jnp.zeros((self._s, Yb.shape[1]), Yb.dtype).at[h].add(
-                v[:, None] * Yb)
-            SX = SXb if SX is None else SX + SXb
-            SY = SYb if SY is None else SY + SYb
-            row0 += nb
-        if SX is None:
-            raise ValueError("empty stream")
+
+        ckpt = None
+        ckpt_owned = False
+        ident = None
+        resume_rows = 0         # rows already folded into (SX, SY)
+        last_saved = -1         # step of the newest in-loop save
+        saved_b0 = None         # batch-0 hash recorded at first save
+        b0 = None               # batch-0 hash of THIS pass
+        if checkpoint is not None:
+            from libskylark_tpu.utility.checkpoint import (
+                TrainCheckpointer,
+                as_checkpointer,
+            )
+
+            ident = self._identity(num_classes)
+            ckpt_owned = not isinstance(checkpoint, TrainCheckpointer)
+            ckpt = as_checkpointer(checkpoint)
+
+        def _close():
+            if ckpt is not None and ckpt_owned:
+                ckpt.close()
+
+        try:
+            if ckpt is not None and ckpt.latest_step() is not None:
+                step0, meta = ckpt.metadata()
+                if meta.get("identity") != ident:
+                    raise errors.InvalidParametersError(
+                        "checkpoint belongs to a different streaming "
+                        "sketch (n/s/context/num_classes differ) — "
+                        "refusing to resume")
+                resume_rows = int(meta["rows"])
+                saved_b0 = meta.get("batch0_hash")
+                _, state, _ = ckpt.restore(step0)
+                SX = jnp.asarray(state["SX"])
+                SY = jnp.asarray(state["SY"])
+                if resume_rows >= self._n:
+                    # finished stream: return without re-reading it
+                    return self._finish(SX, SY)
+            row0 = resume_rows
+
+            batches_seen = 0
+            rows_scanned = 0
+            for X, Y in batches:
+                nb = np.asarray(X).shape[0]
+                if rows_scanned == 0 and (ckpt is not None):
+                    b0 = self._batch_hash(X)
+                    if saved_b0 is not None and b0 != saved_b0:
+                        raise errors.InvalidParametersError(
+                            "checkpoint belongs to a different stream "
+                            "(first batch differs) — refusing to resume")
+                rows_scanned += nb
+                if rows_scanned <= resume_rows:
+                    continue        # fast-forward past folded-in rows
+                if rows_scanned - nb < resume_rows:
+                    raise errors.InvalidParametersError(
+                        f"stream batching changed across runs: a batch "
+                        f"straddles the checkpointed row offset "
+                        f"{resume_rows} — refusing to resume")
+
+                X = jnp.asarray(X)
+                Y = np.asarray(Y)
+                if row0 + nb > self._n:
+                    raise ValueError(
+                        f"stream longer than declared n={self._n}")
+                if num_classes > 2:
+                    Yb, _ = dummy_coding(
+                        Y.reshape(-1), coding=list(range(num_classes)))
+                    Yb = jnp.asarray(Yb)
+                else:
+                    Yb = jnp.asarray(Y.astype(np.float32))
+                    if Yb.ndim == 1:
+                        Yb = Yb[:, None]
+                h = jnp.asarray(h_all[row0:row0 + nb])
+                v = jnp.asarray(v_all[row0:row0 + nb])
+                SXb = jnp.zeros((self._s, X.shape[1]), X.dtype).at[h].add(
+                    v[:, None] * X)
+                SYb = jnp.zeros((self._s, Yb.shape[1]), Yb.dtype).at[h].add(
+                    v[:, None] * Yb)
+                SX = SXb if SX is None else SX + SXb
+                SY = SYb if SY is None else SY + SYb
+                row0 += nb
+                batches_seen += 1
+                if ckpt is not None and checkpoint_every > 0 \
+                        and batches_seen % int(checkpoint_every) == 0 \
+                        and row0 < self._n:
+                    self._save(ckpt, ident, row0, SX, SY, b0)
+                    last_saved = row0
+            if SX is None:
+                raise ValueError("empty stream")
+            if ckpt is not None and row0 > resume_rows \
+                    and row0 != last_saved:
+                # guard against re-saving the in-loop step: orbax's
+                # behavior on an existing step is version-dependent
+                # (silent no-op here, StepAlreadyExistsError elsewhere)
+                self._save(ckpt, ident, row0, SX, SY, b0)
+            return self._finish(SX, SY)
+        finally:
+            _close()
+
+    @staticmethod
+    def _save(ckpt, ident, rows, SX, SY, b0) -> None:
+        ckpt.save(int(rows), {"SX": SX, "SY": SY},
+                  {"identity": ident, "rows": int(rows),
+                   "batch0_hash": b0})
+
+    @staticmethod
+    def _finish(SX, SY):
         if SY.shape[1] == 1:
             SY = SY[:, 0]
         return SX, SY

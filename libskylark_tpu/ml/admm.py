@@ -284,6 +284,10 @@ class BlockADMMSolver:
         def _identity() -> str:
             import hashlib
 
+            from libskylark_tpu.utility.checkpoint import (
+                positional_fingerprint,
+            )
+
             h = hashlib.sha256()
             # loss/reg hashed with their constructor state (two
             # LogisticLosses with different Newton budgets iterate
@@ -298,28 +302,14 @@ class BlockADMMSolver:
             )).encode())
             for fm in self.feature_maps:
                 h.update(fm.to_json().encode())
-
-            # Data fingerprint: device-side f32 reductions (no host
-            # gather of a possibly huge sharded X), POSITION-WEIGHTED so
-            # a row/column permutation — which would misalign the
-            # restored per-example duals — changes the hash; the plain
-            # sum is included as a second independent statistic. f32
-            # accumulation keeps the value independent of the x64 flag
-            # at restore time.
-            def pos_sum(a):
-                w = jnp.cos(
-                    jnp.arange(a.shape[0], dtype=jnp.float32) * 0.73 + 0.2)
-                if a.ndim == 2:
-                    w2 = jnp.cos(
-                        jnp.arange(a.shape[1], dtype=jnp.float32) * 1.37
-                        + 0.4)
-                    return jnp.sum(a * w[:, None] * w2[None, :],
-                                   dtype=jnp.float32)
-                return jnp.sum(a * w, dtype=jnp.float32)
-
-            for stat in (pos_sum(X), jnp.sum(X, dtype=jnp.float32),
-                         pos_sum(Y), jnp.sum(Y, dtype=jnp.float32)):
-                h.update(np.asarray(stat).tobytes())
+            # data fingerprint: position-weighted (a permutation that
+            # would misalign the restored per-example duals refuses) +
+            # the plain sum as a second independent statistic
+            for stat in (positional_fingerprint(X),
+                         float(jnp.sum(X, dtype=jnp.float32)),
+                         positional_fingerprint(Y),
+                         float(jnp.sum(Y, dtype=jnp.float32))):
+                h.update(repr(stat).encode())
             return h.hexdigest()
 
         ckpt = None
@@ -368,8 +358,21 @@ class BlockADMMSolver:
                     start_it = step0 + 1
                     # a run that stopped on tol convergence is DONE:
                     # "resuming" it one more iteration per rerun would
-                    # drift from the uninterrupted result
+                    # drift from the uninterrupted result. But a rerun
+                    # with a DIFFERENT tol (e.g. tol=0, the documented
+                    # force-maxiter knob) is asking for different
+                    # stopping behavior — silently returning the
+                    # converged model would ignore it; refuse instead.
                     resume_finished = bool(meta.get("converged", False))
+                    if resume_finished and meta.get("tol") != self.tol:
+                        raise errors.InvalidParametersError(
+                            f"checkpoint at {checkpoint} finished by "
+                            f"converging at tol={meta.get('tol')}; this "
+                            f"run requests tol={self.tol}. Refusing to "
+                            "return the converged model as-is — use a "
+                            "fresh checkpoint directory to re-train "
+                            "with the new tolerance"
+                        )
             except BaseException:
                 if ckpt_owned:
                     ckpt.close()
@@ -397,7 +400,8 @@ class BlockADMMSolver:
             with timer.phase("CHECKPOINT"):
                 ckpt.save(it, list(carry),
                           {"identity": ident, "iteration": int(it),
-                           "converged": bool(converged)})
+                           "converged": bool(converged),
+                           "tol": self.tol})
 
         it = start_it - 1
         converged = False

@@ -52,6 +52,13 @@ class TrainCheckpointer:
         if not _HAVE_ORBAX:  # pragma: no cover
             raise errors.UnsupportedError(
                 "orbax-checkpoint is required for TrainCheckpointer")
+        # Initialize the CONFIGURED default backend before orbax's
+        # manager construction touches jax: its process/distributed
+        # detection can otherwise trigger backend discovery that
+        # initializes a non-default platform plugin (observed on the
+        # axon image: a cpu-configured process hung initializing the
+        # wedged TPU tunnel inside CheckpointManager.__init__).
+        jax.devices()
         self._dir = os.path.abspath(str(directory))
         os.makedirs(self._dir, exist_ok=True)
         self._mngr = ocp.CheckpointManager(
@@ -143,6 +150,24 @@ def as_checkpointer(obj) -> TrainCheckpointer:
     if isinstance(obj, TrainCheckpointer):
         return obj
     return TrainCheckpointer(str(obj))
+
+
+def positional_fingerprint(a) -> float:
+    """Position-weighted f32 reduction of an array — the data statistic
+    for resume-identity checks (ADMM data, streaming batch 0). Computed
+    on device (no host gather of a possibly huge sharded operand) and
+    POSITION-sensitive: a row/column permutation — which would misalign
+    restored per-example state — changes the value, unlike a plain sum.
+    f32 accumulation keeps it independent of the x64 flag at restore
+    time."""
+    a = jnp.asarray(a)
+    w = jnp.cos(jnp.arange(a.shape[0], dtype=jnp.float32) * 0.73 + 0.2)
+    if a.ndim == 2:
+        w2 = jnp.cos(jnp.arange(a.shape[1], dtype=jnp.float32) * 1.37
+                     + 0.4)
+        return float(jnp.sum(a * w[:, None] * w2[None, :],
+                             dtype=jnp.float32))
+    return float(jnp.sum(a * w, dtype=jnp.float32))
 
 
 def device_state(state, dtype=None):

@@ -190,3 +190,115 @@ class TestADMMResume:
         with pytest.raises(errors.InvalidParametersError):
             _solver(4).train(X[perm], Y[perm], regression=True,
                              checkpoint=ckdir)
+
+
+class TestStreamingResume:
+    """Checkpointable streaming sketch (io/streaming.py): a killed
+    ingestion job resumes past the rows already folded in."""
+
+    def _batches(self, X, Y, bs):
+        for i in range(0, len(Y), bs):
+            yield X[i:i + bs], Y[i:i + bs]
+
+    @pytest.fixture
+    def stream_data(self):
+        rng = np.random.default_rng(7)
+        X = rng.standard_normal((64, 5)).astype(np.float32)
+        Y = rng.standard_normal(64).astype(np.float32)
+        return X, Y
+
+    def test_resume_equals_one_shot(self, stream_data, tmp_path):
+        from libskylark_tpu.base.context import Context
+        from libskylark_tpu.io.streaming import StreamingCWT
+
+        X, Y = stream_data
+        ref_SX, ref_SY = StreamingCWT(64, 16, Context(seed=5)).sketch(
+            self._batches(X, Y, 8))
+
+        ckdir = tmp_path / "stream"
+        # partial pass: only the first 3 batches (24 rows), then "dies"
+        part = StreamingCWT(64, 16, Context(seed=5))
+        part.sketch(self._batches(X[:24], Y[:24], 8),
+                    checkpoint=ckdir, checkpoint_every=1)
+        # the partial pass declared n=64 but the stream ended at 24 —
+        # its accumulators for rows 0..23 are checkpointed
+        full = StreamingCWT(64, 16, Context(seed=5))
+        SX, SY = full.sketch(self._batches(X, Y, 8), checkpoint=ckdir,
+                             checkpoint_every=1)
+        np.testing.assert_array_equal(np.asarray(SX), np.asarray(ref_SX))
+        np.testing.assert_array_equal(np.asarray(SY), np.asarray(ref_SY))
+
+    def test_different_stream_refuses(self, stream_data, tmp_path):
+        from libskylark_tpu.base.context import Context
+        from libskylark_tpu.io.streaming import StreamingCWT
+
+        X, Y = stream_data
+        ckdir = tmp_path / "stream"
+        StreamingCWT(64, 16, Context(seed=5)).sketch(
+            self._batches(X[:24], Y[:24], 8), checkpoint=ckdir,
+            checkpoint_every=1)
+        other = X.copy()
+        other[0, 0] += 1.0  # different first batch, same config
+        with pytest.raises(errors.InvalidParametersError):
+            StreamingCWT(64, 16, Context(seed=5)).sketch(
+                self._batches(other, Y, 8), checkpoint=ckdir)
+
+    def test_changed_batching_refuses(self, stream_data, tmp_path):
+        from libskylark_tpu.base.context import Context
+        from libskylark_tpu.io.streaming import StreamingCWT
+
+        X, Y = stream_data
+        ckdir = tmp_path / "stream"
+        StreamingCWT(64, 16, Context(seed=5)).sketch(
+            self._batches(X[:24], Y[:24], 8), checkpoint=ckdir,
+            checkpoint_every=1)
+
+        def odd_batches():
+            # batch 0 identical (passes the content check), later
+            # batching shifted so one batch straddles the saved offset 24
+            yield X[:8], Y[:8]
+            yield X[8:18], Y[8:18]
+            yield X[18:28], Y[18:28]   # straddles 24
+
+        with pytest.raises(errors.InvalidParametersError,
+                           match="straddles"):
+            StreamingCWT(64, 16, Context(seed=5)).sketch(
+                odd_batches(), checkpoint=ckdir)
+
+    def test_finished_stream_rerun_skips_read(self, stream_data, tmp_path):
+        from libskylark_tpu.base.context import Context
+        from libskylark_tpu.io.streaming import StreamingCWT
+
+        X, Y = stream_data
+        ckdir = tmp_path / "stream"
+        SX1, SY1 = StreamingCWT(64, 16, Context(seed=5)).sketch(
+            self._batches(X, Y, 8), checkpoint=ckdir, checkpoint_every=2)
+
+        def exploding():
+            raise AssertionError("finished rerun must not read stream")
+            yield  # pragma: no cover
+
+        SX2, SY2 = StreamingCWT(64, 16, Context(seed=5)).sketch(
+            exploding(), checkpoint=ckdir)
+        np.testing.assert_array_equal(np.asarray(SX2), np.asarray(SX1))
+        np.testing.assert_array_equal(np.asarray(SY2), np.asarray(SY1))
+
+
+    def test_converged_resume_with_different_tol_refuses(self, data,
+                                                         tmp_path):
+        """tol=0 is the documented force-maxiter knob; a converged
+        checkpoint must not silently satisfy a rerun that asks for
+        different stopping behavior."""
+        X, Y = data
+        ckdir = tmp_path / "admm"
+        s1 = BlockADMMSolver(SquaredLoss(), L2Regularizer(), 0.01, 12,
+                             num_partitions=2)
+        s1.maxiter = 200
+        s1.tol = 1e-3
+        s1.train(X, Y, regression=True, checkpoint=ckdir)
+        s2 = BlockADMMSolver(SquaredLoss(), L2Regularizer(), 0.01, 12,
+                             num_partitions=2)
+        s2.maxiter = 200
+        s2.tol = 0.0
+        with pytest.raises(errors.InvalidParametersError, match="tol"):
+            s2.train(X, Y, regression=True, checkpoint=ckdir)

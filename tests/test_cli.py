@@ -259,10 +259,10 @@ class TestLabelCoding:
 
 class TestMLCheckpointResume:
     def test_train_resume_matches_uninterrupted(self, tmp_path):
-        pytest.importorskip("orbax.checkpoint")
         """--checkpoint-dir: a killed training run rerun with the same
         directory must produce the same model as one uninterrupted run
         (the ADMM carry is persisted and resumed)."""
+        pytest.importorskip("orbax.checkpoint")
         rng = np.random.default_rng(11)
         X = rng.standard_normal((80, 6)).astype(np.float32)
         y = X[:, 0].astype(np.float32)
