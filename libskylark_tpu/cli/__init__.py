@@ -55,10 +55,17 @@ def honor_platform_env() -> None:
     import jax
 
     try:
+        # private-API probe in its own guard: if a jax upgrade moves it,
+        # "backends state unknown" must still proceed to the update —
+        # skipping it would silently disable the exact protection this
+        # function exists for
         from jax._src import xla_bridge as _xb
 
         if getattr(_xb, "_backends", None):
             return  # backends live — too late, and someone chose already
+    except Exception:
+        pass
+    try:
         if jax.config.jax_platforms != want:
             jax.config.update("jax_platforms", want)
     except Exception:

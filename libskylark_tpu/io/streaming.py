@@ -137,7 +137,11 @@ class StreamingCWT:
                 nb = np.asarray(X).shape[0]
                 if rows_scanned == 0 and (ckpt is not None):
                     b0 = self._batch_hash(X)
-                    if saved_b0 is not None and b0 != saved_b0:
+                    # NaN-safe comparison: a NaN in batch 0 (missing
+                    # values in ingested data) must compare equal to
+                    # itself across runs, not refuse forever
+                    if saved_b0 is not None and b0 != saved_b0 \
+                            and not (b0 != b0 and saved_b0 != saved_b0):
                         raise errors.InvalidParametersError(
                             "checkpoint belongs to a different stream "
                             "(first batch differs) — refusing to resume")

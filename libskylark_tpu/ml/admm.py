@@ -364,10 +364,12 @@ class BlockADMMSolver:
                     # stopping behavior — silently returning the
                     # converged model would ignore it; refuse instead.
                     resume_finished = bool(meta.get("converged", False))
-                    if resume_finished and meta.get("tol") != self.tol:
+                    saved_tol = meta.get("tol")
+                    if resume_finished and saved_tol is not None \
+                            and saved_tol != float(self.tol):
                         raise errors.InvalidParametersError(
                             f"checkpoint at {checkpoint} finished by "
-                            f"converging at tol={meta.get('tol')}; this "
+                            f"converging at tol={saved_tol}; this "
                             f"run requests tol={self.tol}. Refusing to "
                             "return the converged model as-is — use a "
                             "fresh checkpoint directory to re-train "
@@ -401,7 +403,7 @@ class BlockADMMSolver:
                 ckpt.save(it, list(carry),
                           {"identity": ident, "iteration": int(it),
                            "converged": bool(converged),
-                           "tol": self.tol})
+                           "tol": float(self.tol)})
 
         it = start_it - 1
         converged = False

@@ -1,0 +1,93 @@
+"""Worker for tests/test_multihost.py: one simulated HOST process.
+
+Run as ``python multihost_worker.py <pid> <nprocs> <port>``. Joins the
+pool through the framework's own bootstrap
+(``parallel.multihost.initialize_distributed`` — the MPI_Init analog,
+ref: ml/skylark_ml.cpp:17-20), builds a mesh spanning every process's
+devices, and checks the framework oracle ACROSS HOSTS: a sketch applied
+to a row-sharded global array equals the local same-seed apply; a
+cross-host psum reduction agrees with the analytic value. Prints
+``MULTIHOST_OK`` on success — the parent test asserts it from every
+process."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# 4 virtual devices per process → the mesh crosses hosts AND has
+# intra-host device parallelism (2 hosts × 4 devices = 8)
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main() -> None:
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    from libskylark_tpu.parallel import multihost
+
+    multihost.initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    assert multihost.process_count() == nprocs
+    assert multihost.process_index() == pid
+    assert multihost.is_root() == (pid == 0)
+
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from libskylark_tpu.base.context import Context
+    from libskylark_tpu.sketch import COLUMNWISE, CWT, JLT
+
+    devs = jax.devices()
+    n_dev = len(devs)
+    assert n_dev == nprocs * 4, f"expected {nprocs * 4} devices, {n_dev}"
+    mesh = Mesh(np.array(devs), ("d",))
+
+    # Global problem, identical in every process (same seed); each
+    # process contributes only its local row shards.
+    n, d, s = 64 * n_dev, 16, 32
+    rng = np.random.default_rng(42)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    sharding = NamedSharding(mesh, P("d"))
+    Xs = jax.make_array_from_callback(
+        (n, d), sharding, lambda idx: X[idx])
+
+    for name, T in (("CWT", CWT(n, s, Context(seed=3))),
+                    ("JLT", JLT(n, s, Context(seed=4)))):
+        want = np.asarray(T.apply(jnp.asarray(X), COLUMNWISE))
+        got = multihost_utils.process_allgather(
+            T.apply(Xs, COLUMNWISE), tiled=True)
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   atol=1e-4, rtol=1e-4)
+        print(f"proc {pid}: {name} cross-host oracle ok", flush=True)
+
+    # raw cross-host collective sanity: psum over the host-spanning axis
+    from jax.experimental.shard_map import shard_map
+
+    gx = jax.make_array_from_callback(
+        (n_dev,), sharding,
+        lambda idx: np.full(1, float(pid + 1), np.float32))
+    out = jax.jit(shard_map(lambda v: jax.lax.psum(v, "d"), mesh=mesh,
+                            in_specs=P("d"), out_specs=P("d")))(gx)
+    # each process holds 4 shards of value pid+1 → psum = 4*1 + 4*2
+    expect = 4.0 * sum(range(1, nprocs + 1))
+    got = float(np.asarray(out.addressable_shards[0].data)[0])
+    assert got == expect, (got, expect)
+    print(f"proc {pid}: psum across hosts = {got} MULTIHOST_OK",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

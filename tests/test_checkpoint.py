@@ -139,6 +139,25 @@ class TestADMMResume:
         with pytest.raises(errors.InvalidParametersError):
             _solver(5).train(X, Y, regression=True, checkpoint=ckdir)
 
+    def test_converged_resume_with_different_tol_refuses(self, data,
+                                                         tmp_path):
+        """tol=0 is the documented force-maxiter knob; a converged
+        checkpoint must not silently satisfy a rerun that asks for
+        different stopping behavior."""
+        X, Y = data
+        ckdir = tmp_path / "admm"
+        s1 = BlockADMMSolver(SquaredLoss(), L2Regularizer(), 0.01, 12,
+                             num_partitions=2)
+        s1.maxiter = 200
+        s1.tol = 1e-3
+        s1.train(X, Y, regression=True, checkpoint=ckdir)
+        s2 = BlockADMMSolver(SquaredLoss(), L2Regularizer(), 0.01, 12,
+                             num_partitions=2)
+        s2.maxiter = 200
+        s2.tol = 0.0
+        with pytest.raises(errors.InvalidParametersError, match="tol"):
+            s2.train(X, Y, regression=True, checkpoint=ckdir)
+
     def test_resume_with_sharded_data(self, data, tmp_path, mesh1d):
         """The preemption scenario the feature exists for: training on a
         mesh, killed, resumed — the restored carry re-shards through jit
@@ -283,22 +302,3 @@ class TestStreamingResume:
         np.testing.assert_array_equal(np.asarray(SX2), np.asarray(SX1))
         np.testing.assert_array_equal(np.asarray(SY2), np.asarray(SY1))
 
-
-    def test_converged_resume_with_different_tol_refuses(self, data,
-                                                         tmp_path):
-        """tol=0 is the documented force-maxiter knob; a converged
-        checkpoint must not silently satisfy a rerun that asks for
-        different stopping behavior."""
-        X, Y = data
-        ckdir = tmp_path / "admm"
-        s1 = BlockADMMSolver(SquaredLoss(), L2Regularizer(), 0.01, 12,
-                             num_partitions=2)
-        s1.maxiter = 200
-        s1.tol = 1e-3
-        s1.train(X, Y, regression=True, checkpoint=ckdir)
-        s2 = BlockADMMSolver(SquaredLoss(), L2Regularizer(), 0.01, 12,
-                             num_partitions=2)
-        s2.maxiter = 200
-        s2.tol = 0.0
-        with pytest.raises(errors.InvalidParametersError, match="tol"):
-            s2.train(X, Y, regression=True, checkpoint=ckdir)
