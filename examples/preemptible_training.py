@@ -1,0 +1,89 @@
+"""Preemption-resilient training — checkpoint/resume for long solver runs.
+
+The reference restarts a killed run from zero (its §5 aux-subsystem
+survey has no checkpoint row; models serialize, solver state does not —
+ref: ml/skylark_ml.cpp:15-172 holds everything in process memory). On
+TPU, long solves on preemptible capacity are the norm, so this framework
+persists LIVE solver state: the ADMM consensus carry and the streaming
+sketch accumulators survive a SIGKILL and resume bit-identical to an
+uninterrupted run.
+
+This example simulates two preemptions:
+
+1. A Block-ADMM training run "dies" after 4 of 12 iterations; a second
+   invocation over the same checkpoint directory resumes at iteration 5
+   and finishes — coefficients equal the never-interrupted run exactly.
+2. A streaming ingestion+sketch job dies mid-stream; the rerun
+   fast-forwards past the rows already folded in (re-reading but not
+   re-sketching them) and completes to the same sketch.
+"""
+
+import tempfile
+
+import numpy as np
+
+from libskylark_tpu import Context
+from libskylark_tpu.algorithms.prox import L2Regularizer, SquaredLoss
+from libskylark_tpu.io.streaming import StreamingCWT
+from libskylark_tpu.ml.admm import BlockADMMSolver
+
+
+def _solver(maxiter: int) -> BlockADMMSolver:
+    s = BlockADMMSolver(SquaredLoss(), L2Regularizer(), 0.01,
+                        num_features=16, num_partitions=2)
+    s.maxiter = maxiter
+    s.tol = 0.0
+    return s
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((200, 16)).astype(np.float32)
+    Y = np.sin(X[:, 0]).astype(np.float32)
+
+    # -- 1. ADMM: preempted at iteration 4, resumed to 12 ----------------
+    ref = _solver(12).train(X, Y, regression=True)
+
+    with tempfile.TemporaryDirectory() as ck:
+        # "preempted": the process reached only iteration 4 before dying
+        # (maxiter=4 stands in for the kill; a real SIGKILL behaves the
+        # same — orbax commits steps atomically, in-flight saves vanish)
+        _solver(4).train(X, Y, regression=True,
+                         checkpoint=ck, checkpoint_every=2)
+        # rerun of the FULL job over the same directory: resumes at 5
+        resumed = _solver(12).train(X, Y, regression=True,
+                                    checkpoint=ck, checkpoint_every=2)
+
+    drift = np.abs(np.asarray(resumed.coef) - np.asarray(ref.coef)).max()
+    print(f"ADMM resume vs uninterrupted: max |diff| = {drift}")
+    assert drift == 0.0, "resume must be bit-identical"
+
+    # -- 2. streaming sketch: preempted mid-stream -----------------------
+    n, d, s_dim, bs = 512, 8, 64, 64
+    Xs = rng.standard_normal((n, d)).astype(np.float32)
+    Ys = rng.standard_normal(n).astype(np.float32)
+
+    def batches(upto: int):
+        for i in range(0, upto, bs):
+            yield Xs[i:i + bs], Ys[i:i + bs]
+
+    one_shot, _ = StreamingCWT(n, s_dim, Context(seed=3)).sketch(
+        batches(n))
+
+    with tempfile.TemporaryDirectory() as ck:
+        # ingestion job dies after 4 of 8 batches
+        StreamingCWT(n, s_dim, Context(seed=3)).sketch(
+            batches(n // 2), checkpoint=ck, checkpoint_every=1)
+        # rerun: fast-forwards 256 rows, sketches the rest
+        SX, _ = StreamingCWT(n, s_dim, Context(seed=3)).sketch(
+            batches(n), checkpoint=ck, checkpoint_every=1)
+
+    drift = np.abs(np.asarray(SX) - np.asarray(one_shot)).max()
+    print(f"streaming resume vs one-shot sketch: max |diff| = {drift}")
+    assert drift == 0.0, "streamed resume must equal the one-shot sketch"
+
+    print("preemptible training: both resume paths bit-identical")
+
+
+if __name__ == "__main__":
+    main()

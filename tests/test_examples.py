@@ -21,6 +21,7 @@ sys.path.insert(
     "kernel_regression",
     "condest_asynch",
     "streaming_ingest",
+    "preemptible_training",
 ])
 def test_example_runs(name, capsys):
     mod = importlib.import_module(name)
