@@ -128,6 +128,7 @@ class DenseTransform(OperatorCache, SketchTransform):
         return 0
 
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        self._note_eager_apply(A)
         S = self._cached_op(A.dtype)
         if S is not None:
             return S @ A
@@ -141,6 +142,7 @@ class DenseTransform(OperatorCache, SketchTransform):
         return S @ A
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        self._note_eager_apply(A)
         S = self._cached_op(A.dtype)
         if S is not None:
             return A @ S.T

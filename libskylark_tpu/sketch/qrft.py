@@ -86,10 +86,12 @@ class QRFT(OperatorCache, SketchTransform):
         return self._op_or(dtype, self.w_matrix)
 
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        self._note_eager_apply(A)
         W = self._device_W(A.dtype)
         return self.outscale * jnp.cos(W @ A + self.shifts(A.dtype)[:, None])
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        self._note_eager_apply(A)
         W = self._device_W(A.dtype)
         return self.outscale * jnp.cos(A @ W.T + self.shifts(A.dtype)[None, :])
 
@@ -182,10 +184,12 @@ class ExpSemigroupQRLT(QRFT):
         return math.sqrt(1.0 / self._S)
 
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        self._note_eager_apply(A)
         W = self._device_W(A.dtype)
         return self.outscale * jnp.exp(-(W @ A))
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        self._note_eager_apply(A)
         W = self._device_W(A.dtype)
         return self.outscale * jnp.exp(-(A @ W.T))
 

@@ -112,9 +112,11 @@ class RFT(OperatorCache, SketchTransform):
         return A @ self.w_panel(0, self._N, A.dtype).T
 
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        self._note_eager_apply(A)
         return self._featurize(self._project_columnwise(A), feature_axis=0)
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        self._note_eager_apply(A)
         if self._op_cache is None:
             out = self._try_fused_rowwise(A)
             if out is not None:

@@ -64,6 +64,7 @@ class OperatorCache:
     (seed, counter)-based)."""
 
     _op_cache = None
+    _eager_applies = 0
 
     def _full_operator(self, dtype) -> jnp.ndarray:
         raise NotImplementedError
@@ -75,9 +76,44 @@ class OperatorCache:
         return self
 
     def dematerialize(self):
-        """Drop the pinned operator."""
+        """Drop the pinned operator (and the auto-dispatch apply count —
+        an explicit drop means 'stop amortizing', not 'repin at once')."""
         self._op_cache = None
+        self._eager_applies = 0
         return self
+
+    def _op_bytes(self, dtype) -> int:
+        """Pinned-operator size for the auto-materialize budget; the
+        cached operator is (sketch_dim × N) for every current user."""
+        return int(self._S) * int(self._N) * jnp.dtype(dtype).itemsize
+
+    def _note_eager_apply(self, A) -> None:
+        """Auto-materialize dispatch (see sketch/params.py): the Nth
+        EAGER dense apply of this instance pins the operator when it
+        fits the budget. Applies under a jit trace never count — the
+        trace runs once, and materializing inside it would pin a tracer.
+        Steady-state reuse (a serving predict path, a feature map inside
+        an eager solver loop) thus amortizes generation to zero without
+        anyone calling :meth:`materialize`."""
+        dtype = A.dtype
+        if self._op_cache is not None and \
+                jnp.dtype(dtype).itemsize <= self._op_cache.dtype.itemsize:
+            return
+        # (a cache NARROWER than this request doesn't serve it —
+        # _cached_op refuses to upcast — so wide applies keep counting
+        # and re-pin at the wider dtype rather than regenerate forever)
+        if isinstance(A, jax.core.Tracer):
+            return
+        from libskylark_tpu.sketch import params as sketch_params
+
+        if not sketch_params.get_auto_materialize():
+            return
+        self._eager_applies += 1
+        if self._eager_applies < sketch_params.get_auto_materialize_after():
+            return
+        if self._op_bytes(dtype) > sketch_params.get_auto_materialize_bytes():
+            return
+        self.materialize(dtype)
 
     def _cached_op(self, dtype):
         """The pinned operator, cast to the apply dtype if needed (the
