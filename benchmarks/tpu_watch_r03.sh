@@ -166,8 +166,38 @@ note_fail() {  # note_fail <step-key> → rc 1 on wedge (stop this pass)
 # the watch or end it early)
 SWEEP_SPECS=("1024 0" "1024 1" "512 1" "512 0" "256 0")
 
+have_oracle_recert() {
+    [ -f benchmarks/.tpu_oracle_recert_r03 ]
+}
+
 attempt_all() {
     local failed=0
+    # step 0: re-certify the on-chip oracle battery at the CURRENT code
+    # (the kernel plumbing was refactored after the last certification;
+    # measurements taken on a silently-broken kernel would mislabel the
+    # XLA fallback as kernel numbers)
+    if ! have_oracle_recert && ! give_up oracle; then
+        log "on-chip oracle re-certification"
+        timeout 900 env JAX_PLATFORMS=tpu SKYLARK_TEST_TPU=1 \
+            python -m pytest tests/test_pallas_dense.py -m tpu -rA -q \
+            > /tmp/oracle_recert.log 2>&1
+        local rc=$?
+        {
+            echo "# re-certification $(date -u +%Y-%m-%dT%H:%M:%SZ) rc=$rc"
+            tail -10 /tmp/oracle_recert.log
+        } >> benchmarks/tpu_validation_r03.txt
+        if [ $rc -eq 0 ]; then   # pytest 0 = every selected test passed
+            date -u +%Y-%m-%dT%H:%M:%SZ > benchmarks/.tpu_oracle_recert_r03
+        else
+            # rc=5 means ZERO tests were selected (the -m tpu battery
+            # didn't even run — a conftest/gating problem, not a kernel
+            # failure); either way nothing was certified, so no stamp.
+            # The give_up cap bounds retries at 2 live failures.
+            [ $rc -eq 5 ] && log "oracle recert selected no tests (rc=5)"
+            failed=1
+            note_fail oracle || return 1
+        fi
+    fi
     for spec in "${SWEEP_SPECS[@]}"; do
         set -- $spec
         if ! have_sweep_point "$1" "$2" && ! give_up "sweep_$1_$2"; then
@@ -210,6 +240,7 @@ attempt_all() {
 }
 
 all_done() {
+    have_oracle_recert || return 1
     for spec in "${SWEEP_SPECS[@]}"; do
         set -- $spec
         have_sweep_point "$1" "$2" || return 1
