@@ -176,7 +176,13 @@ attempt_all() {
     # (the kernel plumbing was refactored after the last certification;
     # measurements taken on a silently-broken kernel would mislabel the
     # XLA fallback as kernel numbers)
-    if ! have_oracle_recert && ! give_up oracle; then
+    if ! have_oracle_recert; then
+        # HARD GATE, not just a priority: measurements taken on an
+        # uncertified kernel would permanently capture XLA-fallback
+        # numbers labeled as kernel performance (have_* predicates never
+        # re-measure). No certification stamp → no captures this pass,
+        # and a given-up recert means the watch captures nothing.
+        give_up oracle && return 1
         log "on-chip oracle re-certification"
         timeout 900 env JAX_PLATFORMS=tpu SKYLARK_TEST_TPU=1 \
             python -m pytest tests/test_pallas_dense.py -m tpu -rA -q \
@@ -192,10 +198,9 @@ attempt_all() {
             # rc=5 means ZERO tests were selected (the -m tpu battery
             # didn't even run — a conftest/gating problem, not a kernel
             # failure); either way nothing was certified, so no stamp.
-            # The give_up cap bounds retries at 2 live failures.
             [ $rc -eq 5 ] && log "oracle recert selected no tests (rc=5)"
-            failed=1
-            note_fail oracle || return 1
+            note_fail oracle
+            return 1
         fi
     fi
     for spec in "${SWEEP_SPECS[@]}"; do

@@ -182,8 +182,11 @@ class BlockADMMSolver:
         if regression:
             k = 1
         else:
-            Yh = np.asarray(Y)
-            if Yh.min() < 0:
+            # label stats via device reductions, not np.asarray(Y): on a
+            # multi-host mesh Y spans non-addressable devices and cannot
+            # be fetched to one host — the reductions come back as
+            # replicated scalars, which every process can read
+            if int(jnp.min(Y)) < 0:
                 raise errors.InvalidParametersError(
                     "classification labels must be integers in 0..k-1 "
                     "(recode ±1 labels to 0/1)"
@@ -191,7 +194,7 @@ class BlockADMMSolver:
             k = (
                 int(num_targets)
                 if num_targets is not None
-                else int(Yh.max()) + 1
+                else int(jnp.max(Y)) + 1
             )
         D = self.num_features
         P = len(self.block_sizes)  # feature-partition consensus count
@@ -212,7 +215,13 @@ class BlockADMMSolver:
         lam, rho = self.lam, self.rho
         starts, sizes = self.starts, self.block_sizes
 
-        def step(carry):
+        # X/Y and every array derived from them (the cached block
+        # factorizations, optionally the cached Zⱼ) are jit ARGUMENTS,
+        # not closures: on a multi-host mesh they span non-addressable
+        # devices, and jax forbids closing over such arrays (each would
+        # be baked into the executable as a constant). Static flags
+        # (cho lowers) stay in the closure.
+        def step(carry, X, Y, cache_mats, Zs):
             Wbar, O, Obar, nu, mu, mu_ij, ZtObar_ij, del_o = carry
 
             mu_ij = mu_ij - Wbar                     # ref: :378-380
@@ -236,7 +245,8 @@ class BlockADMMSolver:
                 Z = Zs[j] if self.cache_transforms else self._block_features(X, j)
                 wbar_output = wbar_output + (Z @ Wbar[sl]).T
                 rhs = Wbar[sl] - mu_ij[sl] + ZtObar_ij[sl] + Z.T @ dsum
-                Wi_J = jsl.cho_solve(caches[j], rhs)  # ref: :475-476
+                Wi_J = jsl.cho_solve(
+                    (cache_mats[j], cache_lowers[j]), rhs)  # ref: :475-476
                 o = (Z @ Wi_J).T                     # (k, n); ref: :478-480
                 new_mu_ij = new_mu_ij.at[sl].add(Wi_J)
                 new_ZtObar = new_ZtObar.at[sl].set(Z.T @ o.T)
@@ -265,7 +275,25 @@ class BlockADMMSolver:
 
         step_jit = jax.jit(step)
 
-        carry = (
+        def _on_data_devices(arrs):
+            """Replicate the consensus state onto X's device set (the
+            project's `[*,*]` vocabulary, parallel/mesh.py). With X
+            passed as a jit ARGUMENT (multi-host requirement above), a
+            default-device carry would conflict with a sharded X —
+            explicit arguments must agree on their device set, unlike
+            the closed-over constants they replaced."""
+            from jax.sharding import NamedSharding
+
+            sh = getattr(X, "sharding", None)
+            if (isinstance(sh, NamedSharding)
+                    and len(sh.device_set) > 1):
+                from libskylark_tpu.parallel import distribute, replicated
+
+                rep = replicated(sh.mesh)
+                return tuple(distribute(a, rep) for a in arrs)
+            return tuple(arrs)
+
+        carry = _on_data_devices((
             jnp.zeros((D, k), dt),   # Wbar
             jnp.zeros((k, n), dt),   # O
             jnp.zeros((k, n), dt),   # Obar
@@ -274,7 +302,7 @@ class BlockADMMSolver:
             jnp.zeros((D, k), dt),   # mu_ij
             jnp.zeros((D, k), dt),   # ZtObar_ij
             jnp.zeros((k, n), dt),   # del_o
-        )
+        ))
 
         # Resume identity: a checkpoint is only valid for the SAME
         # training run — same data, maps, losses, and hyperparameters.
@@ -354,7 +382,7 @@ class BlockADMMSolver:
                     # target=the zero carry: restores with the live
                     # structure/dtypes (and shardings, once jitted)
                     _, state, _ = ckpt.restore(step0, target=list(carry))
-                    carry = tuple(device_state(state, dt))
+                    carry = _on_data_devices(device_state(state, dt))
                     start_it = step0 + 1
                     # a run that stopped on tol convergence is DONE:
                     # "resuming" it one more iteration per rerun would
@@ -384,7 +412,11 @@ class BlockADMMSolver:
         # iter 1; hoisted since Zⱼ is deterministic given the maps) —
         # built only when iterations will actually run, so resuming a
         # finished run returns without paying TRANSFORM/FACTORIZATION.
-        caches = []
+        # Factor arrays are threaded through step() as jit arguments
+        # (multi-host: they span processes); the static lower flags bind
+        # into the closure.
+        cache_mats = []
+        cache_lowers = []
         Zs = []
         if not resume_finished and start_it <= self.maxiter:
             for j in range(P):
@@ -392,11 +424,13 @@ class BlockADMMSolver:
                     Z = self._block_features(X, j)
                 sj = self.block_sizes[j]
                 with timer.phase("FACTORIZATION"):
-                    caches.append(
-                        jsl.cho_factor(Z.T @ Z + jnp.eye(sj, dtype=dt))
-                    )
+                    c, low = jsl.cho_factor(
+                        Z.T @ Z + jnp.eye(sj, dtype=dt))
+                    cache_mats.append(c)
+                    cache_lowers.append(bool(low))
                 if self.cache_transforms:
                     Zs.append(Z)
+        cache_lowers = tuple(cache_lowers)
 
         def _save(it, carry, converged=False):
             with timer.phase("CHECKPOINT"):
@@ -411,7 +445,8 @@ class BlockADMMSolver:
             for it in [] if resume_finished else \
                     range(start_it, self.maxiter + 1):
                 with timer.phase("ITERATIONS"):
-                    carry, (objective, reldel) = step_jit(carry)
+                    carry, (objective, reldel) = step_jit(
+                        carry, X, Y, cache_mats, Zs)
                     if timers_enabled():
                         jax.block_until_ready(carry)  # device time here
                 model.coef = carry[0]

@@ -73,6 +73,36 @@ def main() -> None:
                                    atol=1e-4, rtol=1e-4)
         print(f"proc {pid}: {name} cross-host oracle ok", flush=True)
 
+    # the ml/ layer across hosts: Block-ADMM training on host-spanning
+    # data must match the local same-seed oracle (P7 at process level;
+    # regression guard for the jitted step closing over global arrays —
+    # multi-process jax forbids that, so X/Y/factorizations are jit
+    # arguments)
+    from libskylark_tpu.algorithms.prox import L2Regularizer, SquaredLoss
+    from libskylark_tpu.ml.admm import BlockADMMSolver
+
+    def make_solver():
+        sol = BlockADMMSolver(SquaredLoss(), L2Regularizer(), 0.01,
+                              num_features=d, num_partitions=2)
+        sol.maxiter = 6
+        sol.tol = 0.0
+        return sol
+
+    # classification labels: the 0..k-1 validation and k inference run
+    # as device reductions (np.asarray of a host-spanning Y is
+    # impossible), so this also guards the label path cross-host
+    Yv = (X[:, 0] > 0).astype(np.int32)
+    Ys = jax.make_array_from_callback(
+        (n,), NamedSharding(mesh, P()), lambda idx: Yv[idx])
+    model = make_solver().train(Xs, Ys, regression=False)
+    assert model.coef.is_fully_replicated
+    local = make_solver().train(jnp.asarray(X), jnp.asarray(Yv),
+                                regression=False)
+    np.testing.assert_allclose(np.asarray(model.coef),
+                               np.asarray(local.coef),
+                               atol=1e-3, rtol=1e-3)
+    print(f"proc {pid}: ADMM cross-host oracle ok", flush=True)
+
     # raw cross-host collective sanity: psum over the host-spanning axis
     from jax.experimental.shard_map import shard_map
 

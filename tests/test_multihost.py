@@ -59,3 +59,4 @@ def test_two_process_mesh_runs_sketch_oracle():
         assert "MULTIHOST_OK" in out, f"proc {pid} no OK:\n{out[-2000:]}"
         assert "CWT cross-host oracle ok" in out
         assert "JLT cross-host oracle ok" in out
+        assert "ADMM cross-host oracle ok" in out
