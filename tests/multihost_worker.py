@@ -103,6 +103,30 @@ def main() -> None:
                                atol=1e-3, rtol=1e-3)
     print(f"proc {pid}: ADMM cross-host oracle ok", flush=True)
 
+    # the nla/algorithms layers across hosts: Krylov LSQR and randomized
+    # SVD on host-spanning operands vs the local same-seed oracles
+    # (eager ops and lax.while_loop take spanning operands as arguments
+    # naturally — unlike a jitted closure — but only a process-level run
+    # proves it)
+    from libskylark_tpu.algorithms.krylov import KrylovParams, lsqr
+    from libskylark_tpu.nla.svd import approximate_svd
+
+    bvec = (X @ np.arange(d, dtype=np.float32))
+    bs = jax.make_array_from_callback(
+        (n,), sharding, lambda idx: bvec[idx])
+    xg, _ = lsqr(Xs, bs, KrylovParams(iter_lim=30))
+    xl, _ = lsqr(jnp.asarray(X), jnp.asarray(bvec),
+                 KrylovParams(iter_lim=30))
+    np.testing.assert_allclose(np.asarray(xg), np.asarray(xl),
+                               atol=1e-3, rtol=1e-3)
+    print(f"proc {pid}: LSQR cross-host oracle ok", flush=True)
+
+    _, S_g, _ = approximate_svd(Xs, 4, Context(seed=7))
+    _, S_l, _ = approximate_svd(jnp.asarray(X), 4, Context(seed=7))
+    np.testing.assert_allclose(np.asarray(S_g), np.asarray(S_l),
+                               atol=1e-3, rtol=1e-3)
+    print(f"proc {pid}: randSVD cross-host oracle ok", flush=True)
+
     # raw cross-host collective sanity: psum over the host-spanning axis
     from jax.experimental.shard_map import shard_map
 

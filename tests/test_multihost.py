@@ -60,3 +60,5 @@ def test_two_process_mesh_runs_sketch_oracle():
         assert "CWT cross-host oracle ok" in out
         assert "JLT cross-host oracle ok" in out
         assert "ADMM cross-host oracle ok" in out
+        assert "LSQR cross-host oracle ok" in out
+        assert "randSVD cross-host oracle ok" in out
