@@ -98,6 +98,15 @@ def run(m: int = 8192, n: int = 8192, s: int = 1024, repeats: int = 5,
         A = jax.device_put(jnp.asarray(
             rng.standard_normal((m, n), dtype=np.float32)))
 
+        if use_pallas:
+            # runtime verification, not just planning: a Mosaic compile
+            # failure makes rowwise_apply return None (XLA fallback), and
+            # a record labeled with the planned kernel config while
+            # timing the fallback would be a lie
+            use_pallas = pd.rowwise_apply(
+                key, jlt.dist, A, s, jlt.scale, precision=precision
+            ) is not None
+
         def one_apply(X):
             if use_pallas:
                 out = pd.rowwise_apply(key, jlt.dist, X, s, jlt.scale,
@@ -151,7 +160,8 @@ def run(m: int = 8192, n: int = 8192, s: int = 1024, repeats: int = 5,
         # adjusted: _qualify shrinks over-budget m-tiles, _select_pipe
         # drops an unfittable pipeline buffer) — recorded so sweep rows
         # label measurements with the effective config, not the request
-        plan = (pd.effective_plan(jlt.dist, (m, n), A.dtype, s, seq_axis=1)
+        plan = (dict(pd.effective_plan(jlt.dist, (m, n), A.dtype, s,
+                                       seq_axis=1), runtime_verified=True)
                 if use_pallas else {"kernel": False})
     finally:
         sketch_params.set_use_pallas(prev_use_pallas)
