@@ -44,6 +44,13 @@ def lobpcg_rand_evd(
     s = 4 * n if s is None else int(s)
     if s >= m:
         raise errors.InvalidParametersError(f"sketch size {s} >= rows {m}")
+    if s < n:
+        # the preconditioner solves against R from qr(SA): R is square
+        # only when the sketch keeps at least n rows (otherwise
+        # solve_triangular fails with an opaque shape error deep inside)
+        raise errors.InvalidParametersError(
+            f"sketch size {s} < cols {n}; need s >= n for the "
+            "(R'R)^-1 preconditioner")
 
     sketches = {"cwt": sk.CWT, "jlt": sk.JLT, "fjlt": sk.FJLT}
     if sketch not in sketches:
