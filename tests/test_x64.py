@@ -76,3 +76,33 @@ def test_sparse_f64_products(x64):
     np.testing.assert_allclose(
         np.asarray(out), A.toarray() @ B, atol=1e-12
     )
+
+
+def test_checkpoint_resume_f64(x64, tmp_path):
+    """Resume bit-identity must hold at f64 too (the identity
+    fingerprint hashes the dtype, so an f32 checkpoint cannot silently
+    resume into this run)."""
+    pytest.importorskip("orbax.checkpoint")
+    from libskylark_tpu.algorithms.prox import L2Regularizer, SquaredLoss
+    from libskylark_tpu.ml.admm import BlockADMMSolver
+
+    rng = np.random.default_rng(9)
+    X = rng.standard_normal((64, 8))          # float64 under x64
+    Y = np.sin(X[:, 0])
+
+    def solver(mi):
+        s = BlockADMMSolver(SquaredLoss(), L2Regularizer(), 0.01, 8,
+                            num_partitions=2)
+        s.maxiter = mi
+        s.tol = 0.0
+        return s
+
+    ref = solver(6).train(X, Y, regression=True)
+    assert np.asarray(ref.coef).dtype == np.float64
+    ck = tmp_path / "admm64"
+    solver(3).train(X, Y, regression=True, checkpoint=ck,
+                    checkpoint_every=1)
+    resumed = solver(6).train(X, Y, regression=True, checkpoint=ck,
+                              checkpoint_every=1)
+    np.testing.assert_array_equal(np.asarray(resumed.coef),
+                                  np.asarray(ref.coef))
