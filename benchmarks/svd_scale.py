@@ -24,7 +24,7 @@ recovered top-k singular values to ``--sv-rtol`` AND the projection
 captures the dominant subspace (relative residual of A·V − U·S).
 
 Writes one JSON record per mode; ``--save`` appends to
-benchmarks/results_svd_scale_r03.json.
+benchmarks/results_svd_scale_r{NN}.json (``--round``, default 4).
 """
 
 from __future__ import annotations
@@ -167,7 +167,9 @@ def main():
     ap.add_argument("--sv-rtol", type=float, default=1e-2)
     ap.add_argument("--res-gate", type=float, default=1e-3)
     ap.add_argument("--save", action="store_true",
-                    help="append to results_svd_scale_r03.json")
+                    help="append to results_svd_scale_r{round}.json")
+    ap.add_argument("--round", type=int, default=4,
+                    help="round number for the --save filename")
     args = ap.parse_args()
 
     if args.mode == "chip":
@@ -178,7 +180,7 @@ def main():
                        args.res_gate)
     print(json.dumps(rec), flush=True)
     if args.save:
-        path = os.path.join(HERE, "results_svd_scale_r03.json")
+        path = os.path.join(HERE, f"results_svd_scale_r{args.round:02d}.json")
         recs = []
         if os.path.exists(path):
             try:
