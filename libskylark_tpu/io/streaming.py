@@ -51,14 +51,14 @@ class StreamingCWT:
         return h.hexdigest()
 
     @staticmethod
-    def _batch_hash(X) -> float:
-        """Position-weighted f32 statistic of a batch — row/value
-        permutations change it (a global sum would not)."""
-        from libskylark_tpu.utility.checkpoint import (
-            positional_fingerprint,
-        )
+    def _batch_hash(X) -> str:
+        """Exact byte digest of a bounded batch prefix — platform- and
+        JAX-version-independent (a float device statistic could
+        spuriously refuse a TPU-saved/CPU-resumed stream, or collide;
+        r3 advisor)."""
+        from libskylark_tpu.utility.checkpoint import sample_digest
 
-        return positional_fingerprint(X)
+        return sample_digest(X)
 
     def sketch(
         self,
@@ -123,6 +123,14 @@ class StreamingCWT:
                         "refusing to resume")
                 resume_rows = int(meta["rows"])
                 saved_b0 = meta.get("batch0_hash")
+                if saved_b0 is not None and not isinstance(saved_b0, str):
+                    # pre-digest checkpoints stored a float fingerprint;
+                    # comparing it to the sha256 digest would always
+                    # mismatch and misdiagnose as "different stream"
+                    raise errors.InvalidParametersError(
+                        "checkpoint was written by an older build "
+                        "(float batch-0 fingerprint); stream identity "
+                        "cannot be verified — re-ingest from scratch")
                 _, state, _ = ckpt.restore(step0)
                 SX = jnp.asarray(state["SX"])
                 SY = jnp.asarray(state["SY"])
@@ -137,11 +145,9 @@ class StreamingCWT:
                 nb = np.asarray(X).shape[0]
                 if rows_scanned == 0 and (ckpt is not None):
                     b0 = self._batch_hash(X)
-                    # NaN-safe comparison: a NaN in batch 0 (missing
-                    # values in ingested data) must compare equal to
-                    # itself across runs, not refuse forever
-                    if saved_b0 is not None and b0 != saved_b0 \
-                            and not (b0 != b0 and saved_b0 != saved_b0):
+                    # exact digest equality (NaN bytes compare like any
+                    # bytes, so NaN-laden batches round-trip fine)
+                    if saved_b0 is not None and b0 != saved_b0:
                         raise errors.InvalidParametersError(
                             "checkpoint belongs to a different stream "
                             "(first batch differs) — refusing to resume")
@@ -182,6 +188,22 @@ class StreamingCWT:
                         and row0 < self._n:
                     self._save(ckpt, ident, row0, SX, SY, b0)
                     last_saved = row0
+            if rows_scanned < resume_rows:
+                # the re-supplied stream ended DURING fast-forward
+                # (shorter than the checkpointed offset, or empty):
+                # returning the restored partial accumulators would pass
+                # off a truncated/different stream as the final sketch
+                # (r3 advisor). Strictly '<' on purpose: a stream ending
+                # EXACTLY at the offset is consistent with the
+                # checkpoint (batch 0 verified, every folded row
+                # re-supplied, nothing new) — a no-progress rerun
+                # returning the same partial state, the same contract as
+                # the partial pass that wrote the checkpoint. Partial
+                # vs finished is distinguished by rows < n, not here.
+                raise errors.InvalidParametersError(
+                    f"stream ended at {rows_scanned} rows, before the "
+                    f"checkpointed offset {resume_rows} — truncated or "
+                    "different stream; refusing to resume")
             if SX is None:
                 raise ValueError("empty stream")
             if ckpt is not None and row0 > resume_rows \

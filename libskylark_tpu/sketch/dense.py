@@ -61,6 +61,23 @@ def pallas_ambient_ok(A) -> bool:
     return False
 
 
+def pallas_serves_eager(A, dist) -> bool:
+    """True when an eager dense apply of ``A`` would route through the
+    fused Mosaic kernel — whose contraction numerics (bf16x3 split,
+    accumulation order) differ from a materialized XLA gemm. Used to
+    veto auto-materialize on that path: the Nth eager apply must not
+    silently change numerics vs the first (cross-call reproducibility).
+    Mirrors the dispatch's own qualification (``supported``): applies
+    the kernel declines (f64/bf16 inputs, shifted distributions) run
+    the plain XLA contraction and must keep auto-amortizing."""
+    if not pallas_ambient_ok(A):
+        return False
+    from libskylark_tpu.sketch import pallas_dense
+
+    return pallas_dense.available() and pallas_dense.supported(
+        dist, A.dtype)
+
+
 def try_pallas_apply(key, dist, A, s_dim: int, scale: float, which: str):
     """Fused generation+matmul TPU kernel (sketch/pallas_dense.py) for any
     virtual operator in the dense-block stream format — the dense
@@ -103,6 +120,9 @@ class DenseTransform(OperatorCache, SketchTransform):
 
     def _full_operator(self, dtype) -> jnp.ndarray:
         return self.s_panel(0, self._N, dtype)
+
+    def _materialize_changes_numerics(self, A) -> bool:
+        return pallas_serves_eager(A, self.dist)
 
     # -- apply --
 

@@ -136,12 +136,14 @@ def set_pallas_m_tile(t: int) -> None:
 # virtual-operator default: one-shot sketches keep paying zero HBM,
 # repeated applies amortize generation to zero automatically. Bounded by
 # ``auto_materialize_bytes`` so huge operators (which the blocked apply
-# exists for) never pin. On the XLA path the materialized apply is the
-# same contraction as the unblocked virtual one (bit-identical); on the
-# TPU fused-kernel path it switches bf16x3 regeneration for a
-# full-precision gemm — a ≤1e-4 (oracle-grade) numerics improvement.
-# Disable for strict bitwise reproducibility across apply counts, or
-# via SKYLARK_AUTO_MATERIALIZE=0.
+# exists for) never pin. Auto-pinning only ever happens where the
+# materialized apply is the SAME contraction as the virtual one (the
+# plain XLA path); applies that route through the fused TPU kernel are
+# never auto-switched — the kernel's bf16x3/accumulation-order numerics
+# differ from a cached gemm, and the Nth eager apply must not silently
+# change results vs the first (OperatorCache._materialize_changes_numerics;
+# explicit materialize() remains the visible way to choose the cached
+# regime on TPU). SKYLARK_AUTO_MATERIALIZE=0 disables the dispatch.
 def _env_flag(name: str, default: bool) -> bool:
     import os
 

@@ -34,6 +34,11 @@ class RFT(OperatorCache, SketchTransform):
     def _full_operator(self, dtype) -> jnp.ndarray:
         return self.w_panel(0, self._N, dtype)
 
+    def _materialize_changes_numerics(self, A) -> bool:
+        from libskylark_tpu.sketch.dense import pallas_serves_eager
+
+        return pallas_serves_eager(A, self.dist)
+
     sketch_type = "RFT"
     dist: randgen.Distribution = randgen.Normal()
 

@@ -108,12 +108,27 @@ class OperatorCache:
 
         if not sketch_params.get_auto_materialize():
             return
+        if self._materialize_changes_numerics(A):
+            # never auto-switch a path whose numerics differ from the
+            # cached gemm (the fused TPU kernel's bf16x3/accumulation
+            # order): two identical eager applies must not differ by
+            # prior call count. Explicit materialize() remains available
+            # — an explicit call is a visible regime choice.
+            return
         self._eager_applies += 1
         if self._eager_applies < sketch_params.get_auto_materialize_after():
             return
         if self._op_bytes(dtype) > sketch_params.get_auto_materialize_bytes():
             return
         self.materialize(dtype)
+
+    def _materialize_changes_numerics(self, A) -> bool:
+        """True when auto-pinning would CHANGE the numerics of later
+        eager applies (e.g. the apply currently routes through the fused
+        Pallas kernel, whose contraction regime differs from the
+        materialized XLA gemm). Default False: on the plain XLA path the
+        materialized contraction is the same computation."""
+        return False
 
     def _cached_op(self, dtype):
         """The pinned operator, cast to the apply dtype if needed (the

@@ -152,29 +152,79 @@ def as_checkpointer(obj) -> TrainCheckpointer:
     return TrainCheckpointer(str(obj))
 
 
-def positional_fingerprint(a) -> float:
-    """Position-weighted f32 reduction of an array — the data statistic
-    for resume-identity checks (ADMM data, streaming batch 0). Computed
-    on device (no host gather of a possibly huge sharded operand) and
-    POSITION-sensitive: a row/column permutation — which would misalign
-    restored per-example state — changes the value, unlike a plain sum.
-    f32 accumulation keeps it independent of the x64 flag at restore
-    time."""
-    a = jnp.asarray(a)
-    w = jnp.cos(jnp.arange(a.shape[0], dtype=jnp.float32) * 0.73 + 0.2)
-    if a.ndim == 2:
-        w2 = jnp.cos(jnp.arange(a.shape[1], dtype=jnp.float32) * 1.37
-                     + 0.4)
-        return float(jnp.sum(a * w[:, None] * w2[None, :],
-                             dtype=jnp.float32))
-    return float(jnp.sum(a * w, dtype=jnp.float32))
+def _fully_addressable(a) -> bool:
+    """Whether every shard of ``a`` is host-readable (host arrays: yes;
+    jax.Arrays spanning other processes' devices: no). Seam for tests —
+    multi-host topologies can't be constructed in a unit process."""
+    if isinstance(a, jax.Array):
+        return a.is_fully_addressable
+    return True
+
+
+def sample_digest(a, rows: int = 16) -> str:
+    """Exact, platform-independent data identity for resume checks
+    (ADMM data, streaming batch 0): sha256 over the f32 BYTES of a
+    bounded, deterministic sample of leading-axis slices (first, last,
+    and evenly strided rows in between) plus the full shape.
+
+    Replaces the r3 float device-reduction statistic, which was pinned
+    to one platform/JAX version (reduction order) and could collide
+    (r3 advisor findings): byte equality is exact and identical across
+    TPU/CPU and JAX versions. Bounded: at most ``rows`` slices are
+    gathered to host, so huge sharded operands stay cheap. Coverage
+    limit (documented trade): content changes confined to unsampled
+    rows are not caught; shape changes and any change touching a
+    sampled row (including permutations that move sampled rows) are."""
+    import hashlib
+
+    import numpy as np
+
+    if not _fully_addressable(a):
+        # Multi-host-sharded operand: a host gather of even a few rows
+        # would raise (spans non-addressable devices). Fall back to a
+        # device-side global f32 reduction — identical across the
+        # processes of one run, but pinned to the platform/JAX version
+        # (reduction order), so multi-host checkpoints resume only on
+        # the topology they were saved under. Single-host keeps the
+        # portable byte digest below.
+        w = jnp.cos(jnp.arange(a.shape[0], dtype=jnp.float32) * 0.73
+                    + 0.2)
+        if a.ndim == 2:
+            # position-weighted along BOTH axes: a row or column
+            # permutation (which would misalign restored state) changes
+            # the statistic; a plain sum would not
+            w = w[:, None] * jnp.cos(
+                jnp.arange(a.shape[1], dtype=jnp.float32) * 1.37 + 0.4
+            )[None, :]
+        stat = float(jnp.sum(a * w, dtype=jnp.float32))
+        return hashlib.sha256(
+            repr((tuple(a.shape), "device_stat", stat)).encode()
+        ).hexdigest()
+
+    n = int(a.shape[0]) if getattr(a, "ndim", 0) else 1
+    idx = sorted(set(
+        int(i) for i in np.linspace(0, max(n - 1, 0), num=min(rows, n))))
+    idx_arr = np.asarray(idx, dtype=np.intp)  # empty axis: valid no-op
+    sample = np.ascontiguousarray(
+        np.asarray(a[idx_arr] if getattr(a, "ndim", 0) else a,
+                   np.float32))
+    h = hashlib.sha256()
+    h.update(repr((tuple(getattr(a, "shape", ())), idx)).encode())
+    h.update(sample.tobytes())
+    return h.hexdigest()
 
 
 def device_state(state, dtype=None):
-    """Restore helper: a pytree of host arrays → device arrays (at
-    ``dtype`` when given), leaving non-arrays untouched."""
+    """Restore helper: a pytree of host arrays → device arrays,
+    floating-point leaves cast to ``dtype`` when given. Integer/bool
+    leaves keep their stored dtype — a step counter or index array in a
+    general training state must not be silently cast to the float
+    compute dtype (r3 advisor)."""
     def put(x):
-        if hasattr(x, "shape"):
+        if not hasattr(x, "shape"):
+            return x
+        if dtype is not None and jnp.issubdtype(
+                getattr(x, "dtype", jnp.float32), jnp.floating):
             return jnp.asarray(x, dtype)
-        return x
+        return jnp.asarray(x)
     return jax.tree_util.tree_map(put, state)

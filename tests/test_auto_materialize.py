@@ -102,6 +102,55 @@ def test_feature_maps_auto_pin_within_oracle(A, make):
     np.testing.assert_allclose(out, fresh, atol=1e-4, rtol=1e-4)
 
 
+def test_no_auto_pin_on_fused_kernel_path(A, monkeypatch):
+    """When the eager apply routes through the fused Pallas kernel,
+    auto-materialize must NOT fire: pinning would silently switch the
+    Nth apply from bf16x3 kernel numerics to a full-precision cached
+    gemm — a cross-call reproducibility break (r3 advisor, medium).
+    Simulated off-chip by forcing the veto predicate on (the real
+    kernel cannot compile on CPU); what's under test is the dispatch
+    wiring: would-serve -> never auto-pin."""
+    from libskylark_tpu.sketch import dense as dense_mod
+
+    monkeypatch.setattr(dense_mod, "pallas_serves_eager",
+                        lambda A, dist: True)
+    sketch_params.set_auto_materialize_after(1)
+    T = JLT(256, 16, Context(seed=1))
+    for _ in range(3):
+        T.apply(A, ROWWISE)
+    assert T._op_cache is None  # veto: no silent regime switch
+    # explicit materialize() remains the visible opt-in
+    T.materialize()
+    assert T._op_cache is not None
+
+    # RFT shares the veto through the same dispatch
+    R = GaussianRFT(256, 24, Context(seed=2), sigma=2.0)
+    for _ in range(3):
+        R.apply(A, ROWWISE)
+    assert R._op_cache is None
+
+
+def test_unsupported_kernel_inputs_still_auto_pin(A, monkeypatch):
+    """pallas_serves_eager mirrors the kernel's own qualification: an
+    apply the kernel would DECLINE (f64 input — supported() is
+    f32-only) runs the plain XLA contraction, so auto-materialize must
+    keep amortizing it even in a pallas-ambient context (review
+    finding: the veto must not permanently disable amortization for
+    XLA-path applies on TPU)."""
+    from libskylark_tpu.sketch import dense as dense_mod
+    from libskylark_tpu.sketch import pallas_dense
+
+    monkeypatch.setattr(pallas_dense, "available", lambda: True)
+    monkeypatch.setattr(dense_mod, "pallas_ambient_ok", lambda A: True)
+    sketch_params.set_auto_materialize_after(2)
+    T = JLT(256, 16, Context(seed=1))
+    Ab = A.astype(jnp.bfloat16)  # supported() is f32-only -> XLA path
+    assert not dense_mod.pallas_serves_eager(Ab, T.dist)
+    T.apply(Ab, ROWWISE)
+    T.apply(Ab, ROWWISE)
+    assert T._op_cache is not None  # amortization kept
+
+
 def test_wider_dtype_request_repins(A):
     """A narrow pin must not permanently block amortization for wider
     dtypes: _cached_op refuses to upcast, so wide applies keep counting

@@ -284,6 +284,129 @@ class TestStreamingResume:
             StreamingCWT(64, 16, Context(seed=5)).sketch(
                 odd_batches(), checkpoint=ckdir)
 
+    def test_truncated_resume_stream_refuses(self, stream_data, tmp_path):
+        """A re-supplied stream that ends DURING fast-forward (shorter
+        than the checkpointed offset, or empty) must refuse instead of
+        returning the restored partial accumulators as final (r3
+        advisor)."""
+        from libskylark_tpu.base.context import Context
+        from libskylark_tpu.io.streaming import StreamingCWT
+
+        X, Y = stream_data
+        ckdir = tmp_path / "stream"
+        StreamingCWT(64, 16, Context(seed=5)).sketch(
+            self._batches(X[:24], Y[:24], 8), checkpoint=ckdir,
+            checkpoint_every=1)
+        with pytest.raises(errors.InvalidParametersError,
+                           match="ended at 16 rows"):
+            StreamingCWT(64, 16, Context(seed=5)).sketch(
+                self._batches(X[:16], Y[:16], 8), checkpoint=ckdir)
+        with pytest.raises(errors.InvalidParametersError,
+                           match="ended at 0 rows"):
+            StreamingCWT(64, 16, Context(seed=5)).sketch(
+                iter(()), checkpoint=ckdir)
+
+    def test_cross_dtype_leaves_preserved(self, tmp_path):
+        """device_state casts only floating leaves: an int step counter
+        or index array must keep its dtype (r3 advisor)."""
+        from libskylark_tpu.utility.checkpoint import device_state
+
+        state = {"w": np.ones(3, np.float64),
+                 "step": np.asarray(7, np.int64),
+                 "idx": np.arange(4, dtype=np.int32),
+                 "flag": np.asarray(True)}
+        out = device_state(state, dtype=jnp.float32)
+        assert out["w"].dtype == jnp.float32
+        assert jnp.issubdtype(out["step"].dtype, jnp.integer)
+        assert jnp.issubdtype(out["idx"].dtype, jnp.integer)
+        assert out["flag"].dtype == jnp.bool_
+        assert int(out["step"]) == 7
+
+    def test_sample_digest_platform_independent_identity(self):
+        """sample_digest: exact on content, shape-sensitive, bounded,
+        identical for host and device arrays of the same bytes."""
+        from libskylark_tpu.utility.checkpoint import sample_digest
+
+        rng = np.random.default_rng(0)
+        A = rng.standard_normal((1000, 4)).astype(np.float32)
+        assert sample_digest(A) == sample_digest(jnp.asarray(A))
+        B = A.copy()
+        B[0, 0] += 1.0                      # sampled row change: caught
+        assert sample_digest(B) != sample_digest(A)
+        assert sample_digest(A[:999]) != sample_digest(A)  # shape change
+        nanA = A.copy()
+        nanA[0, 1] = np.nan                 # NaN round-trips exactly
+        assert sample_digest(nanA) == sample_digest(nanA.copy())
+        # empty leading axis: valid digest, not an IndexError (review
+        # finding — positional_fingerprint handled empties)
+        assert isinstance(sample_digest(np.zeros((0, 4), np.float32)),
+                          str)
+        assert (sample_digest(np.zeros((0, 4), np.float32))
+                != sample_digest(np.zeros((0, 5), np.float32)))
+
+    def test_sample_digest_nonaddressable_fallback(self, monkeypatch):
+        """Multi-host-sharded operands (not host-readable) fall back to
+        a device-side position-weighted statistic instead of crashing
+        on the host gather (review finding). Row AND column
+        permutations must change it."""
+        import libskylark_tpu.utility.checkpoint as ckpt_mod
+        from libskylark_tpu.utility.checkpoint import sample_digest
+
+        monkeypatch.setattr(ckpt_mod, "_fully_addressable",
+                            lambda a: False)
+        A = jnp.asarray(
+            np.random.default_rng(3).standard_normal((32, 6)),
+            jnp.float32)
+        d = sample_digest(A)
+        assert isinstance(d, str) and d == sample_digest(A)
+        assert sample_digest(A[::-1]) != d          # row permutation
+        assert sample_digest(A[:, ::-1]) != d       # column permutation
+
+    def test_legacy_float_batch0_hash_diagnosed_as_format(
+            self, stream_data, tmp_path):
+        """A checkpoint whose batch0_hash is the pre-digest float must
+        refuse with a format-incompatibility message, not the
+        misleading 'first batch differs' (review finding)."""
+        from libskylark_tpu.base.context import Context
+        from libskylark_tpu.io.streaming import StreamingCWT
+        from libskylark_tpu.utility.checkpoint import TrainCheckpointer
+
+        X, Y = stream_data
+        ckdir = tmp_path / "stream"
+        s = StreamingCWT(64, 16, Context(seed=5))
+        s.sketch(self._batches(X[:24], Y[:24], 8), checkpoint=ckdir,
+                 checkpoint_every=1)
+        with TrainCheckpointer(str(ckdir)) as ck:
+            step, meta = ck.metadata()
+            _, state, _ = ck.restore(step)
+            meta = dict(meta)
+            meta["batch0_hash"] = 1.2345  # simulate the old format
+            ck.save(step + 1, state, meta)
+        with pytest.raises(errors.InvalidParametersError,
+                           match="older build"):
+            StreamingCWT(64, 16, Context(seed=5)).sketch(
+                self._batches(X, Y, 8), checkpoint=ckdir)
+
+    def test_exact_offset_rerun_is_consistent_noop(self, stream_data,
+                                                   tmp_path):
+        """A re-supplied stream ending EXACTLY at the checkpointed
+        offset re-verifies batch 0, folds nothing new, and returns the
+        same partial state as the pass that wrote the checkpoint — the
+        partial-pass contract, not a truncation refusal (boundary
+        documented at the guard)."""
+        from libskylark_tpu.base.context import Context
+        from libskylark_tpu.io.streaming import StreamingCWT
+
+        X, Y = stream_data
+        ckdir = tmp_path / "stream"
+        SX1, SY1 = StreamingCWT(64, 16, Context(seed=5)).sketch(
+            self._batches(X[:24], Y[:24], 8), checkpoint=ckdir,
+            checkpoint_every=1)
+        SX2, SY2 = StreamingCWT(64, 16, Context(seed=5)).sketch(
+            self._batches(X[:24], Y[:24], 8), checkpoint=ckdir)
+        np.testing.assert_array_equal(np.asarray(SX2), np.asarray(SX1))
+        np.testing.assert_array_equal(np.asarray(SY2), np.asarray(SY1))
+
     def test_finished_stream_rerun_skips_read(self, stream_data, tmp_path):
         from libskylark_tpu.base.context import Context
         from libskylark_tpu.io.streaming import StreamingCWT
