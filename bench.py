@@ -283,6 +283,44 @@ def _previous_value() -> float | None:
     return max(rounds)[1] if rounds else None
 
 
+def _verify_committed(here: str, path: str, raw: str, rec: dict,
+                      rnd: int) -> dict:
+    """Validate the newest committed on-chip headline record so a wedged
+    driver run reports a VERIFIED artifact instead of a bare null:
+    sha256 of the record bytes (ties the reported number to one exact
+    committed file), its provenance stamp, and whether the on-chip
+    oracle certification stamp is (a) present for the same round and
+    (b) FRESHER than the kernel source it certifies — a stale stamp
+    means the kernel changed after certification and the number can't
+    be tied to certified numerics."""
+    import hashlib
+
+    out = {
+        "value": rec.get("value"),
+        "unit": "GB/s",
+        "file": os.path.relpath(path, here),
+        "sha256": hashlib.sha256(raw.encode()).hexdigest(),
+        "captured": (rec.get("provenance") or {}).get("captured"),
+        "cold_start_wall_s": rec.get("cold_start_wall_s"),
+    }
+    stamp = os.path.join(here, "benchmarks",
+                         f".tpu_oracle_recert_r{rnd:02d}")
+    if os.path.exists(stamp):
+        try:
+            with open(stamp) as fh:
+                out["oracle_stamp"] = fh.read().strip()
+            kern = os.path.join(here, "libskylark_tpu", "sketch",
+                                "pallas_dense.py")
+            out["oracle_fresh"] = (os.path.getmtime(stamp)
+                                   >= os.path.getmtime(kern))
+        except Exception:
+            out["oracle_fresh"] = False
+    else:
+        out["oracle_stamp"] = None
+        out["oracle_fresh"] = False
+    return out
+
+
 def _emit(value, extra):
     prev = _previous_value()
     if value is None:
@@ -353,7 +391,13 @@ def main() -> None:
     extra = {"error": " | ".join(e.replace("\n", " ") for e in errors)
              or "deadline exhausted before any attempt"}
     # Surface the most recent committed on-chip measurement so a wedged
-    # tunnel doesn't erase the round's evidence (provenance in the file).
+    # tunnel doesn't erase the round's evidence — as a STRUCTURED
+    # verified-artifact block, not a bare null: the parent re-hashes the
+    # committed record, carries its provenance timestamps, and checks the
+    # on-chip oracle stamp is fresher than the kernel source it certifies
+    # (the r3 verdict's verified-committed protocol for rounds whose
+    # ~5-min live windows can't fit this script's cold start; the
+    # watcher-measured cold-start wall time is in the record itself).
     try:
         here = os.path.dirname(os.path.abspath(__file__))
         cands = []
@@ -363,11 +407,14 @@ def main() -> None:
             if mm:
                 cands.append((int(mm.group(1)), pth))
         if cands:
-            path = max(cands)[1]
+            rnd, path = max(cands)
             with open(path) as fh:
-                rec = json.load(fh)
+                raw = fh.read()
+            rec = json.loads(raw)
             extra["last_measured_GBps"] = rec.get("value")
             extra["last_measured_file"] = os.path.basename(path)
+            extra["verified_committed"] = _verify_committed(
+                here, path, raw, rec, rnd)
         # the m-tile sweep may hold a BETTER committed measurement than
         # the defaults headline — surface the best row alongside
         best = None

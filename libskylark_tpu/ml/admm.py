@@ -41,6 +41,13 @@ from libskylark_tpu.ml.model import HilbertModel
 from libskylark_tpu.sketch import ROWWISE, SketchTransform
 from libskylark_tpu.utility.timer import get_timer, timers_enabled
 
+# Resume-identity scheme version: bumped whenever the _identity() hash
+# inputs change (scheme 2 = sample_digest byte digests; scheme 1, never
+# written under this field, hashed float device statistics). A
+# checkpoint from another scheme refuses with a format diagnosis rather
+# than a misleading "different training run".
+_IDENTITY_SCHEME = 2
+
 
 def _partition(num_features: int, num_partitions: int) -> list[int]:
     """Equal split with remainder spread forward (ref: BlockADMM.hpp:145-153)."""
@@ -364,6 +371,15 @@ class BlockADMMSolver:
                     # state restore (a mismatched state would die inside
                     # orbax on shapes, not on this friendly error)
                     step0, meta = ckpt.metadata()
+                    if meta.get("identity_scheme") != _IDENTITY_SCHEME:
+                        # pre-digest checkpoints hashed float statistics
+                        # into the identity; comparing across schemes
+                        # would always mismatch and misdiagnose as
+                        # changed data/hyperparameters (review finding)
+                        raise errors.InvalidParametersError(
+                            f"checkpoint at {checkpoint} was written by "
+                            "an older build (incompatible resume-"
+                            "identity scheme) — retrain from scratch")
                     if meta.get("identity") != ident:
                         raise errors.InvalidParametersError(
                             f"checkpoint at {checkpoint} belongs to a "
@@ -434,7 +450,9 @@ class BlockADMMSolver:
         def _save(it, carry, converged=False):
             with timer.phase("CHECKPOINT"):
                 ckpt.save(it, list(carry),
-                          {"identity": ident, "iteration": int(it),
+                          {"identity": ident,
+                           "identity_scheme": _IDENTITY_SCHEME,
+                           "iteration": int(it),
                            "converged": bool(converged),
                            "tol": float(self.tol)})
 

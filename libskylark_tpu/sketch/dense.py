@@ -61,21 +61,28 @@ def pallas_ambient_ok(A) -> bool:
     return False
 
 
-def pallas_serves_eager(A, dist) -> bool:
+def pallas_serves_eager(A, dist, s_dim: int,
+                        seq_axis: int | None) -> bool:
     """True when an eager dense apply of ``A`` would route through the
     fused Mosaic kernel — whose contraction numerics (bf16x3 split,
     accumulation order) differ from a materialized XLA gemm. Used to
     veto auto-materialize on that path: the Nth eager apply must not
     silently change numerics vs the first (cross-call reproducibility).
-    Mirrors the dispatch's own qualification (``supported``): applies
-    the kernel declines (f64/bf16 inputs, shifted distributions) run
-    the plain XLA contraction and must keep auto-amortizing."""
+    Mirrors the dispatch's FULL qualification via ``effective_plan``
+    (distribution/dtype support, pallas importability, VMEM/tile
+    budget): any apply the kernel would decline runs the plain XLA
+    contraction and must keep auto-amortizing."""
     if not pallas_ambient_ok(A):
         return False
     from libskylark_tpu.sketch import pallas_dense
 
-    return pallas_dense.available() and pallas_dense.supported(
-        dist, A.dtype)
+    if not pallas_dense.available():
+        return False
+    if seq_axis is None or getattr(A, "ndim", 0) != 2:
+        # orientation unknown: conservative veto on basic support
+        return pallas_dense.supported(dist, A.dtype)
+    return bool(pallas_dense.effective_plan(
+        dist, A.shape, A.dtype, s_dim, seq_axis).get("kernel"))
 
 
 def try_pallas_apply(key, dist, A, s_dim: int, scale: float, which: str):
@@ -121,8 +128,8 @@ class DenseTransform(OperatorCache, SketchTransform):
     def _full_operator(self, dtype) -> jnp.ndarray:
         return self.s_panel(0, self._N, dtype)
 
-    def _materialize_changes_numerics(self, A) -> bool:
-        return pallas_serves_eager(A, self.dist)
+    def _materialize_changes_numerics(self, A, seq_axis=None) -> bool:
+        return pallas_serves_eager(A, self.dist, self._S, seq_axis)
 
     # -- apply --
 
@@ -148,7 +155,7 @@ class DenseTransform(OperatorCache, SketchTransform):
         return 0
 
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        self._note_eager_apply(A)
+        self._note_eager_apply(A, seq_axis=0)
         S = self._cached_op(A.dtype)
         if S is not None:
             return S @ A
@@ -162,7 +169,7 @@ class DenseTransform(OperatorCache, SketchTransform):
         return S @ A
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        self._note_eager_apply(A)
+        self._note_eager_apply(A, seq_axis=1)
         S = self._cached_op(A.dtype)
         if S is not None:
             return A @ S.T

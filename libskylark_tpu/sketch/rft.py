@@ -34,10 +34,10 @@ class RFT(OperatorCache, SketchTransform):
     def _full_operator(self, dtype) -> jnp.ndarray:
         return self.w_panel(0, self._N, dtype)
 
-    def _materialize_changes_numerics(self, A) -> bool:
+    def _materialize_changes_numerics(self, A, seq_axis=None) -> bool:
         from libskylark_tpu.sketch.dense import pallas_serves_eager
 
-        return pallas_serves_eager(A, self.dist)
+        return pallas_serves_eager(A, self.dist, self._S, seq_axis)
 
     sketch_type = "RFT"
     dist: randgen.Distribution = randgen.Normal()
@@ -117,11 +117,11 @@ class RFT(OperatorCache, SketchTransform):
         return A @ self.w_panel(0, self._N, A.dtype).T
 
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        self._note_eager_apply(A)
+        self._note_eager_apply(A, seq_axis=0)
         return self._featurize(self._project_columnwise(A), feature_axis=0)
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        self._note_eager_apply(A)
+        self._note_eager_apply(A, seq_axis=1)
         if self._op_cache is None:
             out = self._try_fused_rowwise(A)
             if out is not None:

@@ -87,7 +87,7 @@ class OperatorCache:
         cached operator is (sketch_dim × N) for every current user."""
         return int(self._S) * int(self._N) * jnp.dtype(dtype).itemsize
 
-    def _note_eager_apply(self, A) -> None:
+    def _note_eager_apply(self, A, seq_axis: int | None = None) -> None:
         """Auto-materialize dispatch (see sketch/params.py): the Nth
         EAGER dense apply of this instance pins the operator when it
         fits the budget. Applies under a jit trace never count — the
@@ -108,7 +108,7 @@ class OperatorCache:
 
         if not sketch_params.get_auto_materialize():
             return
-        if self._materialize_changes_numerics(A):
+        if self._materialize_changes_numerics(A, seq_axis):
             # never auto-switch a path whose numerics differ from the
             # cached gemm (the fused TPU kernel's bf16x3/accumulation
             # order): two identical eager applies must not differ by
@@ -122,12 +122,15 @@ class OperatorCache:
             return
         self.materialize(dtype)
 
-    def _materialize_changes_numerics(self, A) -> bool:
+    def _materialize_changes_numerics(self, A, seq_axis=None) -> bool:
         """True when auto-pinning would CHANGE the numerics of later
         eager applies (e.g. the apply currently routes through the fused
         Pallas kernel, whose contraction regime differs from the
-        materialized XLA gemm). Default False: on the plain XLA path the
-        materialized contraction is the same computation."""
+        materialized XLA gemm). ``seq_axis`` is the apply orientation
+        (0 columnwise, 1 rowwise, None unknown) so overrides can ask the
+        kernel dispatch for its real decision. Default False: on the
+        plain XLA path the materialized contraction is the same
+        computation."""
         return False
 
     def _cached_op(self, dtype):

@@ -113,7 +113,7 @@ def test_no_auto_pin_on_fused_kernel_path(A, monkeypatch):
     from libskylark_tpu.sketch import dense as dense_mod
 
     monkeypatch.setattr(dense_mod, "pallas_serves_eager",
-                        lambda A, dist: True)
+                        lambda *a: True)
     sketch_params.set_auto_materialize_after(1)
     T = JLT(256, 16, Context(seed=1))
     for _ in range(3):
@@ -145,10 +145,17 @@ def test_unsupported_kernel_inputs_still_auto_pin(A, monkeypatch):
     sketch_params.set_auto_materialize_after(2)
     T = JLT(256, 16, Context(seed=1))
     Ab = A.astype(jnp.bfloat16)  # supported() is f32-only -> XLA path
-    assert not dense_mod.pallas_serves_eager(Ab, T.dist)
+    assert not dense_mod.pallas_serves_eager(Ab, T.dist, 16, 1)
     T.apply(Ab, ROWWISE)
     T.apply(Ab, ROWWISE)
     assert T._op_cache is not None  # amortization kept
+
+    # VMEM/tile decline (review finding): an f32 apply whose s_dim
+    # exceeds every valid tile's VMEM budget falls back to XLA too —
+    # the veto must mirror that via effective_plan, not just supported()
+    assert not dense_mod.pallas_serves_eager(A, T.dist, 1 << 16, 1)
+    # while a plannable config (small s_dim) IS vetoed
+    assert dense_mod.pallas_serves_eager(A, T.dist, 16, 1)
 
 
 def test_wider_dtype_request_repins(A):

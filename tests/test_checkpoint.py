@@ -198,6 +198,27 @@ class TestADMMResume:
         np.testing.assert_array_equal(np.asarray(second.coef),
                                       np.asarray(first.coef))
 
+    def test_legacy_identity_scheme_diagnosed_as_format(self, data,
+                                                        tmp_path):
+        """A checkpoint written under a different resume-identity
+        scheme (e.g. the pre-digest float-statistic hash) must refuse
+        with a format diagnosis, not 'different training run' (review
+        finding)."""
+        from libskylark_tpu.utility.checkpoint import TrainCheckpointer
+
+        X, Y = data
+        ckdir = tmp_path / "admm"
+        _solver(2).train(X, Y, regression=True, checkpoint=ckdir)
+        with TrainCheckpointer(str(ckdir)) as ck:
+            step, meta = ck.metadata()
+            _, state, _ = ck.restore(step)
+            meta = dict(meta)
+            meta.pop("identity_scheme")  # simulate an older build
+            ck.save(step + 1, state, meta)
+        with pytest.raises(errors.InvalidParametersError,
+                           match="older build"):
+            _solver(4).train(X, Y, regression=True, checkpoint=ckdir)
+
     def test_permuted_rows_refuse(self, data, tmp_path):
         """Row-permuted data has the same global sum but misaligns the
         per-example duals — the position-weighted fingerprint must
