@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from libskylark_tpu.base import errors
@@ -146,26 +147,41 @@ def approximate_svd(
         return U, S, V
 
     from libskylark_tpu import sketch as sk
+    from libskylark_tpu.utility.timer import get_timer, timers_enabled
+
+    # Phase profile (SKYLARK_TPU_PROFILE=1): the reference profiles its
+    # solvers per phase (ref: ml/BlockADMM.hpp:357-365); the north-star
+    # extrapolation (BASELINE.md) needs sketch / power-iteration /
+    # Rayleigh-Ritz wall-clock splits. Async dispatch means each phase
+    # must sync its outputs to attribute device time — only done when
+    # profiling, so the untimed path keeps the overlapped pipeline.
+    timer = get_timer("svd")
+    _sync = jax.block_until_ready if timers_enabled() else (lambda x: x)
 
     # Range sketch: Y = A·Sᵀ via a rowwise JLT on the n-dimension
     # (ref: nla/svd.hpp:259-261).
-    T = sk.JLT(n, kp, context)
-    Q = T.apply(A, sk.ROWWISE)  # (m, kp)
-    if not params.skip_qr:
-        Q = _orthonormalize(Q, params.ortho)
-    Q = power_iteration(A, Q, params.num_iterations,
-                        orthogonalize=not params.skip_qr,
-                        ortho=params.ortho)
-    if params.skip_qr:
-        # One final orthogonalization is always required before projection.
-        Q = _orthonormalize(Q, params.ortho)
+    with timer.phase("SKETCH"):
+        T = sk.JLT(n, kp, context)
+        Q = _sync(T.apply(A, sk.ROWWISE))  # (m, kp)
+    with timer.phase("POWER_ITERATION"):
+        if not params.skip_qr:
+            Q = _orthonormalize(Q, params.ortho)
+        Q = power_iteration(A, Q, params.num_iterations,
+                            orthogonalize=not params.skip_qr,
+                            ortho=params.ortho)
+        if params.skip_qr:
+            # One final orthogonalization is always required before
+            # projection.
+            Q = _orthonormalize(Q, params.ortho)
+        Q = _sync(Q)
 
     # Rayleigh-Ritz on the range: B = Qᵀ·A = (Aᵀ·Q)ᵀ, small SVD, rotate
     # back (ref: nla/svd.hpp:283-290).
-    B = rmv(Q).T  # (kp, n)
-    Ub, S, Vt = jnp.linalg.svd(B, full_matrices=False)
-    U = Q @ Ub[:, :k]
-    return U, S[:k], Vt[:k, :].T
+    with timer.phase("RAYLEIGH_RITZ"):
+        B = rmv(Q).T  # (kp, n)
+        Ub, S, Vt = jnp.linalg.svd(B, full_matrices=False)
+        U, S, V = _sync((Q @ Ub[:, :k], S[:k], Vt[:k, :].T))
+    return U, S, V
 
 
 @with_solver_precision

@@ -59,6 +59,22 @@ class FastRFT(SketchTransform):
         self._numblks = 1 + (self._S - 1) // self._NB
         self._fut = make_fut(self._fut_name, self._NB)
 
+    def _fut_apply(self, W):
+        """The FUT along the contiguous feature axis. The WHT core opts
+        into Precision.HIGH (TPU: 3-pass bf16 — near-lossless for ±1
+        Hadamard factors, ~2× the full-f32 MXU rate; analysis at
+        fut._wht_matmul) UNLESS the user pinned an explicit library-wide
+        policy via SKYLARK_MATMUL_PRECISION, which then governs here
+        too. Runtime tuning only — never serialized, like the pallas
+        regime knobs."""
+        if self._fut_name != "wht":
+            return self._fut.apply(W, axis=-1)
+        import os
+
+        prec = (None if os.environ.get("SKYLARK_MATMUL_PRECISION")
+                else jax.lax.Precision.HIGH)
+        return self._fut.apply(W, axis=-1, precision=prec)
+
     @property
     def scale(self) -> float:
         return math.sqrt(2.0 / self._S)
@@ -92,29 +108,42 @@ class FastRFT(SketchTransform):
         (ref: FRFT_data.hpp:118 — base fills 1)."""
         return jnp.ones((self._numblks * self._NB,), dtype)
 
-    def _features(self, A: jnp.ndarray) -> jnp.ndarray:
-        """Compute the (S, m) pre-cosine features for columnwise input A (N, m)."""
-        dt = A.dtype
-        m = A.shape[1]
+    def _features_rows(self, At: jnp.ndarray) -> jnp.ndarray:
+        """The (m, S) feature map for ROW-major input At (m, N).
+
+        Laid out for HBM economy (the r3 on-CPU finding was Fastfood
+        losing to the dense gemm on data movement, not FLOPs): the whole
+        SHGΠHB chain runs in (blocks, rows, NB) layout with the
+        transform length CONTIGUOUS, so the kron-factored WHT's two
+        batched matmuls (fut._wht_matmul) touch no transposes, the
+        permutation gathers along the minor axis, and the diagonals
+        (B, G, Sm) fuse into the adjacent contractions. The rowwise
+        apply — the ML feature-map case — moves no axis at all for a
+        single block (numblks == 1 whenever S <= NB): input is consumed
+        and features are produced in their natural layouts."""
+        dt = At.dtype
         NB, nb = self._NB, self._numblks
         pad = NB - self._N
-        Ap = jnp.pad(A, ((0, pad), (0, 0))) if pad else A
+        Ap = jnp.pad(At, ((0, 0), (0, pad))) if pad else At
         scal = math.sqrt(NB) * self._fut.scale()
 
-        W = self._B(dt)[:, :, None] * Ap[None, :, :]          # (nb, NB, m)
-        W = self._fut.apply(W, axis=1)
-        W = jnp.take_along_axis(W, self._perms()[:, :, None], axis=1)
-        W = (scal * self._G(dt))[:, :, None] * W
-        W = self._fut.apply(W, axis=1)
-        W = (scal * self._Sm(dt).reshape(nb, NB))[:, :, None] * W
-        W = W.reshape(nb * NB, m)[: self._S, :]
-        return self.scale * jnp.cos(W + self.shifts(dt)[:, None])
+        W = self._B(dt)[:, None, :] * Ap[None, :, :]          # (nb, m, NB)
+        W = self._fut_apply(W)
+        W = jnp.take_along_axis(W, self._perms()[:, None, :], axis=-1)
+        W = (scal * self._G(dt))[:, None, :] * W
+        W = self._fut_apply(W)
+        W = (scal * self._Sm(dt).reshape(nb, 1, NB)) * W
+        # block-major feature order (matches the serialized definition);
+        # for nb == 1 the moveaxis is a free squeeze
+        W = jnp.moveaxis(W, 0, 1).reshape(Ap.shape[0], nb * NB)
+        W = W[:, : self._S]
+        return self.scale * jnp.cos(W + self.shifts(dt)[None, :])
 
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        return self._features(A)
+        return self._features_rows(A.T).T
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        return self._features(A.T).T
+        return self._features_rows(A)
 
     def _extra_params(self) -> dict[str, Any]:
         return {"fut": self._fut_name}

@@ -94,15 +94,24 @@ def _hadamard_np(n: int):
     return H
 
 
-@functools.partial(jax.jit, static_argnames="axis")
-def _wht_matmul(A: jnp.ndarray, axis: int) -> jnp.ndarray:
+@functools.partial(jax.jit, static_argnames=("axis", "precision"))
+def _wht_matmul(A: jnp.ndarray, axis: int, precision=None) -> jnp.ndarray:
     """WHT along ``axis`` as H_a · X · H_b over the (a, b)-folded axis.
 
     Sylvester ordering is kron-associative (H_{2^k} = H_2^{⊗k}), so for
     any split a·b = N, row-major folding x[p·b+q] = X[p, q] gives
     (H_a ⊗ H_b)x = vec(H_a X H_bᵀ); H is symmetric, hence H_a X H_b.
     Jitted so the Hadamard factors are baked into the program as
-    constants."""
+    constants.
+
+    ``precision`` threads to the contractions; None inherits the
+    ambient policy (the library-wide HIGHEST default / the
+    SKYLARK_MATMUL_PRECISION knob / any ``default_matmul_precision``
+    context). ``Precision.HIGH`` (TPU: 3-pass bf16) is a near-lossless
+    speed regime HERE because every Hadamard entry is ±1 — exactly
+    representable in bfloat16 — so the only term the 3-pass split drops
+    is the X-residual×H product at ~2⁻¹⁶ relative; FastRFT opts in by
+    default (see frft.py)."""
     x = jnp.moveaxis(A, axis, -1)
     n = x.shape[-1]
     k = n.bit_length() - 1
@@ -111,7 +120,7 @@ def _wht_matmul(A: jnp.ndarray, axis: int) -> jnp.ndarray:
     Ha = jnp.asarray(_hadamard_np(a), x.dtype)
     Hb = jnp.asarray(_hadamard_np(b), x.dtype)
     X = x.reshape(x.shape[:-1] + (a, b))
-    Y = jnp.einsum("ia,...ab,bj->...ij", Ha, X, Hb)
+    Y = jnp.einsum("ia,...ab,bj->...ij", Ha, X, Hb, precision=precision)
     return jnp.moveaxis(Y.reshape(x.shape), -1, axis)
 
 
@@ -131,16 +140,17 @@ def _wht_butterfly(A: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
     return x.reshape(orig_shape)
 
 
-def wht(A: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+def wht(A: jnp.ndarray, axis: int = 0, precision=None) -> jnp.ndarray:
     """Fast Walsh-Hadamard transform (natural/Hadamard ordering), N = 2^k
     (SpiralWHT analog, ref: sketch/FUT.hpp:225-347). Unnormalized,
     self-inverse up to N. Large lengths take the MXU matmul formulation
-    (:func:`_wht_matmul`); small ones the VPU butterfly."""
+    (:func:`_wht_matmul`, ``precision`` threads to its contractions);
+    small ones the VPU butterfly (exact adds; precision n/a)."""
     n = A.shape[axis]
     if n & (n - 1):
         raise ValueError(f"WHT requires power-of-2 length, got {n}")
     if n >= _MATMUL_MIN_N:
-        return _wht_matmul(A, axis)
+        return _wht_matmul(A, axis, precision)
     return _wht_butterfly(A, axis)
 
 
@@ -197,8 +207,8 @@ class WHT(FUT):
     def scale(self) -> float:
         return 1.0 / math.sqrt(self.n)
 
-    def apply(self, A, axis=0):
-        return wht(A, axis)
+    def apply(self, A, axis=0, precision=None):
+        return wht(A, axis, precision)
 
     apply_inverse = apply
 

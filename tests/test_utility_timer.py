@@ -77,3 +77,30 @@ class TestADMMInstrumentation:
         assert "TRANSFORM" in t.totals or "FACTORIZATION" in t.totals
         out = capsys.readouterr().out
         assert "phase timings" in out
+
+
+class TestSVDInstrumentation:
+    def test_svd_phase_breakdown(self):
+        """approximate_svd records the sketch / power-iteration /
+        Rayleigh-Ritz split when profiling is on (the north-star
+        extrapolation data; exercises the synced profiled path, which
+        the untimed default skips entirely)."""
+        import jax.numpy as jnp
+
+        from libskylark_tpu.base.context import Context
+        from libskylark_tpu.nla.svd import approximate_svd
+
+        tmod.set_enabled(True)
+        try:
+            t = get_timer("svd")
+            t.reset()
+            A = jnp.asarray(
+                np.random.default_rng(1).standard_normal((96, 48)),
+                jnp.float32)
+            U, S, V = approximate_svd(A, 4, Context(seed=2))
+            assert S.shape == (4,)
+            for ph in ("SKETCH", "POWER_ITERATION", "RAYLEIGH_RITZ"):
+                assert ph in t.totals and t.counts[ph] == 1
+        finally:
+            tmod.set_enabled(False)
+            get_timer("svd").reset()
