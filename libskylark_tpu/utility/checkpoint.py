@@ -152,6 +152,18 @@ def as_checkpointer(obj) -> TrainCheckpointer:
     return TrainCheckpointer(str(obj))
 
 
+@jax.jit
+def _spanning_stat(a):
+    """Position-weighted f32 reduction, jit-compiled so host-spanning
+    operands are legal; the scalar result is replicated everywhere."""
+    w = jnp.cos(jnp.arange(a.shape[0], dtype=jnp.float32) * 0.73 + 0.2)
+    if a.ndim == 2:
+        w = w[:, None] * jnp.cos(
+            jnp.arange(a.shape[1], dtype=jnp.float32) * 1.37 + 0.4
+        )[None, :]
+    return jnp.sum(a * w, dtype=jnp.float32)
+
+
 def _fully_addressable(a) -> bool:
     """Whether every shard of ``a`` is host-readable (host arrays: yes;
     jax.Arrays spanning other processes' devices: no). Seam for tests —
@@ -181,22 +193,17 @@ def sample_digest(a, rows: int = 16) -> str:
 
     if not _fully_addressable(a):
         # Multi-host-sharded operand: a host gather of even a few rows
-        # would raise (spans non-addressable devices). Fall back to a
-        # device-side global f32 reduction — identical across the
-        # processes of one run, but pinned to the platform/JAX version
-        # (reduction order), so multi-host checkpoints resume only on
-        # the topology they were saved under. Single-host keeps the
+        # would raise (spans non-addressable devices), and so would any
+        # EAGER op — multi-process arrays compute only under jit. Fall
+        # back to a jitted device-side global f32 reduction whose scalar
+        # output is fully replicated (hence host-readable on every
+        # process, and identical across them). Position-weighted along
+        # both axes so a row/column permutation — which would misalign
+        # restored state — changes it. Pinned to the platform/JAX
+        # version (reduction order): multi-host checkpoints resume only
+        # on the topology they were saved under. Single-host keeps the
         # portable byte digest below.
-        w = jnp.cos(jnp.arange(a.shape[0], dtype=jnp.float32) * 0.73
-                    + 0.2)
-        if a.ndim == 2:
-            # position-weighted along BOTH axes: a row or column
-            # permutation (which would misalign restored state) changes
-            # the statistic; a plain sum would not
-            w = w[:, None] * jnp.cos(
-                jnp.arange(a.shape[1], dtype=jnp.float32) * 1.37 + 0.4
-            )[None, :]
-        stat = float(jnp.sum(a * w, dtype=jnp.float32))
+        stat = float(_spanning_stat(a))
         return hashlib.sha256(
             repr((tuple(a.shape), "device_stat", stat)).encode()
         ).hexdigest()

@@ -103,6 +103,26 @@ def main() -> None:
                                atol=1e-3, rtol=1e-3)
     print(f"proc {pid}: ADMM cross-host oracle ok", flush=True)
 
+    # checkpoint/resume ACROSS HOSTS: a partial run checkpoints
+    # host-spanning state (orbax multiprocess save under
+    # jax.distributed), the rerun validates the resume identity — whose
+    # data fingerprint takes the jitted spanning-stat path, since X/Y
+    # span non-addressable devices here — and must finish bit-identical
+    # to the uninterrupted run in EVERY process
+    ck_root = os.environ.get("SKYLARK_MH_TMP")
+    if ck_root:
+        ckdir = os.path.join(ck_root, "admm_ck")
+        part = make_solver()
+        part.maxiter = 3
+        part.train(Xs, Ys, regression=False, checkpoint=ckdir)
+        full = make_solver()
+        full.maxiter = 6
+        resumed = full.train(Xs, Ys, regression=False, checkpoint=ckdir)
+        np.testing.assert_array_equal(np.asarray(resumed.coef),
+                                      np.asarray(model.coef))
+        print(f"proc {pid}: ADMM cross-host checkpoint resume ok",
+              flush=True)
+
     # the nla/algorithms layers across hosts: Krylov LSQR and randomized
     # SVD on host-spanning operands vs the local same-seed oracles
     # (eager ops and lax.while_loop take spanning operands as arguments

@@ -31,10 +31,14 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_mesh_runs_sketch_oracle():
+def test_two_process_mesh_runs_sketch_oracle(tmp_path):
     port = _free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # shared checkpoint root for the cross-host resume step (both
+    # simulated hosts see one filesystem, as a pod's workers would a
+    # shared store)
+    env["SKYLARK_MH_TMP"] = str(tmp_path)
     # the workers set their own device-count XLA flag
     env.pop("XLA_FLAGS", None)
     procs = [
@@ -60,5 +64,6 @@ def test_two_process_mesh_runs_sketch_oracle():
         assert "CWT cross-host oracle ok" in out
         assert "JLT cross-host oracle ok" in out
         assert "ADMM cross-host oracle ok" in out
+        assert "ADMM cross-host checkpoint resume ok" in out
         assert "LSQR cross-host oracle ok" in out
         assert "randSVD cross-host oracle ok" in out
