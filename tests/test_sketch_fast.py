@@ -161,6 +161,39 @@ class TestFastfood:
         Z = np.asarray(T.apply(jnp.asarray(_rand(N, m)), sk.COLUMNWISE))
         assert Z.shape == (S, m) and np.isfinite(Z).all()
 
+    def test_explicit_operator_multiblock(self):
+        """Exact oracle: features equal the host-assembled
+        Sm·H·G·P·H·B chain, per block, in block-major order — pins
+        VALUES and feature ORDER (kernel-approximation checks are
+        permutation-invariant, so a layout/interleave bug in the
+        batched apply would pass them; this doesn't)."""
+        N, S, m = 8, 20, 5  # NB=8 -> 3 blocks, last truncated
+        T = sk.FastGaussianRFT(N, S, Context(seed=29), sigma=1.3)
+        NB, nb = T._NB, T._numblks
+        assert NB == 8 and nb == 3
+        H = scipy.linalg.hadamard(NB).astype(np.float64)
+        B = np.asarray(T._B(jnp.float32), np.float64)
+        G = np.asarray(T._G(jnp.float32), np.float64)
+        Sm = np.asarray(T._Sm(jnp.float32), np.float64).reshape(nb, NB)
+        perms = np.asarray(T._perms())
+        scal = np.sqrt(NB) * T._fut.scale()  # == 1 for WHT
+        rows = []
+        for i in range(nb):
+            P = np.zeros((NB, NB))
+            P[np.arange(NB), perms[i]] = 1.0  # out[j] = in[perm[j]]
+            V = (np.diag(Sm[i] * scal) @ H @ np.diag(G[i] * scal)
+                 @ P @ H @ np.diag(B[i]))
+            rows.append(V)
+        V_full = np.vstack(rows)[:S]
+        A = _rand(N, m, seed=31)
+        shifts = np.asarray(T.shifts(), np.float64)
+        want = T.scale * np.cos(V_full @ A + shifts[:S, None])
+        got = np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+        # rowwise agrees with columnwise transposed (same operator)
+        got_r = np.asarray(T.apply(jnp.asarray(A.T.copy()), sk.ROWWISE))
+        np.testing.assert_allclose(got_r, got.T, atol=1e-6, rtol=1e-6)
+
     def test_kernel_approximation(self):
         """Fastfood features approximate the Gaussian kernel — the defining
         property (Le-Sarlos-Smola; ref: examples/random_features.cpp)."""
