@@ -1,18 +1,19 @@
-# Round-4 harvest steps. SOURCED by tpu_watch_r05.sh on every loop
+# Round-5 harvest steps. SOURCED by tpu_watch_r05.sh on every loop
 # cycle, so edits here take effect on the next probe without restarting
 # the watcher. Defines: SWEEP_SPECS, have_* predicates, attempt_all,
 # all_done. The watcher provides: log, probe_ok, give_up, note_fail,
 # FAILS, commit_artifacts.
 #
-# Value-per-second order (VERDICT.md r3 "Next round" #1):
+# Window budget order (VERDICT.md r4 "Next round" #1):
 #   0. on-chip oracle re-certification — HARD GATE before any number
-#   1. m-tile x pipelined-generation A/B sweep (the >=100 GB/s hunt);
-#      each row records its cold-process wall_s (VERDICT #6: measure the
-#      true bench.py cold-start on a live tunnel)
-#   2. headline capture with extras -> results_tpu_r05_headline.json
-#   3. run_all full suite, resumable -> results_r05_tpu.json (includes
-#      the FRFT-vs-RFT on-chip config, VERDICT #3)
-#   4. cross-layer on-chip battery (tests/test_tpu_battery.py, VERDICT #4)
+#   1. cross-layer on-chip battery (tests/test_tpu_battery.py): its
+#      test_jlt_xla_path_vs_host_gemm is the dense/eager-dispatch oracle
+#      covering the r4-changed XLA paths (dense.py veto, frft/fut layout)
+#   2. m-tile x pipelined-generation A/B sweep (the >=100 GB/s hunt);
+#      each row records its cold-process wall_s
+#   3. headline capture with extras -> results_tpu_r05_headline.json
+#   4. run_all full suite, resumable -> results_r05_tpu.json (includes
+#      the FRFT-vs-RFT on-chip config, VERDICT #4)
 #   5. 32k^2 rand-SVD north-star chip mode (VERDICT #5)
 
 SWEEP_SPECS=("512 1" "512 0" "1024 1" "1024 0" "256 0")
@@ -190,6 +191,25 @@ attempt_all() {
             return 1
         fi
     fi
+    if [ -f tests/test_tpu_battery.py ] && ! have_battery \
+            && ! give_up battery; then
+        log "cross-layer on-chip battery"
+        timeout 1200 env JAX_PLATFORMS=tpu SKYLARK_TEST_TPU=1 \
+            python -m pytest tests/test_tpu_battery.py -m tpu -rA -q \
+            > /tmp/tpu_battery_r05.log 2>&1
+        local rc=$?
+        {
+            echo "# r05 cross-layer battery $(date -u +%Y-%m-%dT%H:%M:%SZ) rc=$rc"
+            tail -25 /tmp/tpu_battery_r05.log
+        } >> benchmarks/tpu_validation_r05.txt
+        if [ $rc -eq 0 ]; then
+            date -u +%Y-%m-%dT%H:%M:%SZ > benchmarks/.tpu_battery_r05
+            commit_artifacts "r05 cross-layer on-chip battery passed"
+        else
+            failed=1
+            note_fail battery || return 1
+        fi
+    fi
     for spec in "${SWEEP_SPECS[@]}"; do
         set -- $spec
         if ! have_sweep_point "$1" "$2" && ! give_up "sweep_$1_$2"; then
@@ -227,25 +247,6 @@ attempt_all() {
             else
                 note_fail runall || return 1
             fi
-        fi
-    fi
-    if [ -f tests/test_tpu_battery.py ] && ! have_battery \
-            && ! give_up battery; then
-        log "cross-layer on-chip battery"
-        timeout 1200 env JAX_PLATFORMS=tpu SKYLARK_TEST_TPU=1 \
-            python -m pytest tests/test_tpu_battery.py -m tpu -rA -q \
-            > /tmp/tpu_battery_r05.log 2>&1
-        local rc=$?
-        {
-            echo "# r05 cross-layer battery $(date -u +%Y-%m-%dT%H:%M:%SZ) rc=$rc"
-            tail -25 /tmp/tpu_battery_r05.log
-        } >> benchmarks/tpu_validation_r05.txt
-        if [ $rc -eq 0 ]; then
-            date -u +%Y-%m-%dT%H:%M:%SZ > benchmarks/.tpu_battery_r05
-            commit_artifacts "r05 cross-layer on-chip battery passed"
-        else
-            failed=1
-            note_fail battery || return 1
         fi
     fi
     if ! have_svd_chip && ! give_up svd; then
