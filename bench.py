@@ -311,8 +311,19 @@ def _verify_committed(here: str, path: str, raw: str, rec: dict,
                 out["oracle_stamp"] = fh.read().strip()
             kern = os.path.join(here, "libskylark_tpu", "sketch",
                                 "pallas_dense.py")
-            out["oracle_fresh"] = (os.path.getmtime(stamp)
-                                   >= os.path.getmtime(kern))
+            m = re.search(r"kernel_sha256=([0-9a-f]{64})",
+                          out["oracle_stamp"])
+            if m:
+                # content identity: the stamp records the sha256 of the
+                # kernel file it certified (r4 advisor — mtimes are not
+                # preserved by git checkouts, so mtime freshness is
+                # meaningless on a fresh working copy)
+                with open(kern, "rb") as fh:
+                    cur = hashlib.sha256(fh.read()).hexdigest()
+                out["oracle_fresh"] = m.group(1) == cur
+            else:  # pre-r5 stamp format: best-effort mtime comparison
+                out["oracle_fresh"] = (os.path.getmtime(stamp)
+                                       >= os.path.getmtime(kern))
         except Exception:
             out["oracle_fresh"] = False
     else:

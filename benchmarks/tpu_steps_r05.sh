@@ -183,7 +183,10 @@ attempt_all() {
             tail -10 /tmp/oracle_recert_r05.log
         } >> benchmarks/tpu_validation_r05.txt
         if [ $rc -eq 0 ]; then
-            date -u +%Y-%m-%dT%H:%M:%SZ > benchmarks/.tpu_oracle_recert_r05
+            # stamp carries the certified kernel's content hash so
+            # bench.py's oracle_fresh survives git checkouts (no mtimes)
+            echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) kernel_sha256=$(sha256sum libskylark_tpu/sketch/pallas_dense.py | cut -d' ' -f1)" \
+                > benchmarks/.tpu_oracle_recert_r05
             commit_artifacts "r05 on-chip oracle re-certification"
         else
             [ $rc -eq 5 ] && log "oracle recert selected no tests (rc=5)"

@@ -23,6 +23,34 @@ import jax
 
 _SOLVER_PRECISION = "highest"
 
+# What install_default_matmul_precision actually installed (None when the
+# user opted out with SKYLARK_MATMUL_PRECISION=default): the baseline for
+# telling "ambient is just the library default" apart from "the user
+# explicitly pinned a policy" (r4 advisor — throughput paths that opt into
+# their own regime must yield to an explicit user policy, context included).
+_INSTALLED_AMBIENT: str | None = None
+
+
+def ambient_matmul_precision() -> str | None:
+    """The effective ambient matmul precision, context-aware: inside a
+    user's ``jax.default_matmul_precision(...)`` block this reads the
+    context value, not just the global config."""
+    try:
+        from jax._src.config import default_matmul_precision
+
+        return default_matmul_precision.value
+    except Exception:  # private State API moved — fall back to the global
+        return jax.config.jax_default_matmul_precision
+
+
+def ambient_precision_pinned_by_user() -> bool:
+    """True when the effective ambient precision differs from what the
+    package installed at import — i.e. the user pinned a policy via
+    ``jax.default_matmul_precision(...)`` or ``jax.config.update``.
+    Throughput paths with their own preferred regime (fut WHT bf16x3)
+    check this before overriding the ambient setting."""
+    return ambient_matmul_precision() != _INSTALLED_AMBIENT
+
 
 def install_default_matmul_precision() -> None:
     """Raise jax's *global* default matmul precision to full float32.
@@ -36,11 +64,13 @@ def install_default_matmul_precision() -> None:
     wrong, not fast. Opt out (or pick another regime) with
     ``SKYLARK_MATMUL_PRECISION`` ∈ {default, high, highest, ...jax names};
     throughput paths opt into bf16 explicitly via sketch/params.py."""
+    global _INSTALLED_AMBIENT
     value = os.environ.get("SKYLARK_MATMUL_PRECISION", "highest")
     if value == "default":
         return
     try:
         jax.config.update("jax_default_matmul_precision", value)
+        _INSTALLED_AMBIENT = value
     except Exception:
         if "SKYLARK_MATMUL_PRECISION" in os.environ:
             # a typo must not silently leave the bf16 factory lowering in
@@ -52,6 +82,7 @@ def install_default_matmul_precision() -> None:
                 "matmul precision; falling back to 'highest'"
             )
             jax.config.update("jax_default_matmul_precision", "highest")
+            _INSTALLED_AMBIENT = "highest"
 
 
 def set_solver_precision(value: str) -> None:

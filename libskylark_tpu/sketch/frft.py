@@ -63,15 +63,19 @@ class FastRFT(SketchTransform):
         """The FUT along the contiguous feature axis. The WHT core opts
         into Precision.HIGH (TPU: 3-pass bf16 — near-lossless for ±1
         Hadamard factors, ~2× the full-f32 MXU rate; analysis at
-        fut._wht_matmul) UNLESS the user pinned an explicit library-wide
-        policy via SKYLARK_MATMUL_PRECISION, which then governs here
-        too. Runtime tuning only — never serialized, like the pallas
-        regime knobs."""
+        fut._wht_matmul) UNLESS the user pinned an explicit policy —
+        via SKYLARK_MATMUL_PRECISION, jax.config.update, or an active
+        jax.default_matmul_precision(...) context (r4 advisor) — which
+        then governs here too. Runtime tuning only — never serialized,
+        like the pallas regime knobs."""
         if self._fut_name != "wht":
             return self._fut.apply(W, axis=-1)
         import os
 
+        from libskylark_tpu.base import precision as bprec
+
         prec = (None if os.environ.get("SKYLARK_MATMUL_PRECISION")
+                or bprec.ambient_precision_pinned_by_user()
                 else jax.lax.Precision.HIGH)
         return self._fut.apply(W, axis=-1, precision=prec)
 

@@ -290,3 +290,43 @@ class TestSequenceParallelApply:
                                    mesh1d)
         with pytest.raises(errors.SketchError):
             shard_apply.rowwise(T, np.zeros((4, 2048), np.float32), mesh1d)
+
+
+class TestPrecisionPolicy:
+    def test_ambient_pin_detection_and_frft_yield(self):
+        """r4 advisor: an explicit jax.default_matmul_precision(...)
+        context must govern the FRFT WHT path (which otherwise opts into
+        Precision.HIGH); the library's own installed default must NOT
+        count as a user pin."""
+        import jax
+
+        from libskylark_tpu.base import precision as bprec
+        from libskylark_tpu.sketch.frft import FastGaussianRFT
+        from libskylark_tpu.base.context import Context
+
+        assert not bprec.ambient_precision_pinned_by_user()
+        with jax.default_matmul_precision("tensorfloat32"):
+            assert bprec.ambient_precision_pinned_by_user()
+        assert not bprec.ambient_precision_pinned_by_user()
+
+        T = FastGaussianRFT(64, 128, Context(seed=5), sigma=2.0)
+        seen = []
+        fut = T._fut
+        orig = fut.apply
+
+        def spy(W, axis=-1, precision="MISSING"):
+            seen.append(precision)
+            return orig(W, axis=axis)
+
+        T._fut = type("Spy", (), {"apply": staticmethod(spy),
+                                  "scale": staticmethod(fut.scale)})()
+        import jax.numpy as jnp
+        import numpy as np
+        X = jnp.asarray(
+            np.random.default_rng(0).standard_normal((4, 64)), jnp.float32)
+        from libskylark_tpu.sketch import ROWWISE
+        T.apply(X, ROWWISE)                      # library default ambient
+        with jax.default_matmul_precision("tensorfloat32"):
+            T.apply(X, ROWWISE)                  # user-pinned ambient
+        assert seen[0] is jax.lax.Precision.HIGH  # opt-in active
+        assert seen[2] is None                    # user pin honored

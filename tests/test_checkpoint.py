@@ -365,6 +365,26 @@ class TestStreamingResume:
         assert (sample_digest(np.zeros((0, 4), np.float32))
                 != sample_digest(np.zeros((0, 5), np.float32)))
 
+    def test_sample_digest_full_coverage_under_byte_budget(self):
+        """r4 advisor (medium): a one-row edit in a large-n operand must
+        change the digest whenever the f32 view fits the byte budget —
+        the old fixed 16-row sample missed it ~(1 - 16/n) of the time."""
+        from libskylark_tpu.utility.checkpoint import sample_digest
+
+        rng = np.random.default_rng(1)
+        A = rng.standard_normal((100_000, 8)).astype(np.float32)  # 3.2 MB
+        B = A.copy()
+        B[54_321, 3] += 1.0                 # arbitrary interior row
+        assert sample_digest(B) != sample_digest(A)
+        # above the budget, sampling kicks in but stays >= 1024 rows and
+        # still covers far more than the old 16 (deterministic + bounded)
+        d1 = sample_digest(A, byte_budget=1 << 16)
+        assert d1 == sample_digest(A, byte_budget=1 << 16)
+        assert d1 != sample_digest(A)  # different idx set → different tag
+        # explicit rows= override keeps the bounded-caller contract
+        assert (sample_digest(A, rows=16)
+                == sample_digest(A.copy(), rows=16))
+
     def test_sample_digest_nonaddressable_fallback(self, monkeypatch):
         """Multi-host-sharded operands (not host-readable) fall back to
         a device-side position-weighted statistic instead of crashing
