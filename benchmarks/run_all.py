@@ -19,6 +19,13 @@ results_r*.json present, every metric is printed with its delta vs the
 best prior round at the same backend+scale, and ``--gate`` exits nonzero when any metric regresses
 by more than 10% (the perf ratchet for later rounds — the phase-timer
 discipline of ref: ml/BlockADMM.hpp:357-365 made enforceable).
+
+Every run also times a fixed pure-numpy CANARY kernel
+(:func:`canary_seconds`) and records ``canary_normalized`` per metric:
+the VM's host speed drifts ~1.5× across days (EVIDENCE_r04.md), so on
+the CPU backend the gate compares canary-normalized ratios — a uniform
+host-speed change cancels out and only genuine code/XLA-path
+regressions trip it. On-chip ratios stay raw.
 """
 
 from __future__ import annotations
@@ -57,6 +64,36 @@ DIRECTIONS = {
     "nla_wallclock_s": -1,
     "admm_train_wallclock_s": -1,
 }
+
+
+def canary_seconds(reps: int = 7) -> float:
+    """Best-of-``reps`` wall time of a FIXED pure-numpy compute kernel
+    (deterministic shapes/seed; one 768³ f64 gemm + an elementwise
+    chain). The VM's effective CPU speed drifts ~1.5× across days
+    (EVIDENCE_r04.md host-speed drift study), so raw CPU-mesh ratios are
+    not a valid cross-round signal; dividing/multiplying each metric by
+    the same round's canary time cancels the host-speed factor for
+    compute-bound workloads. On-chip numbers are NOT normalized — chip
+    throughput doesn't ride the host clock (the canary is still
+    recorded for provenance)."""
+    rng = np.random.default_rng(12345)
+    a = rng.standard_normal((768, 768))
+    b = rng.standard_normal((768, 768))
+    best = float("inf")
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        c = a @ b
+        c = np.tanh(c) + np.sqrt(np.abs(c) + 1.0)
+        float(c.sum())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _canary_norm(value: float, direction: int, canary_s: float) -> float:
+    """Drift-normalized form of a metric value: throughput × canary_s
+    (work per canary-unit of host time), wall-clock ÷ canary_s (walls in
+    canary units). Both are invariant under a uniform host-speed change."""
+    return value * canary_s if direction > 0 else value / canary_s
 
 
 def _time_scalar(fn, *args, reps: int | None = None) -> float:
@@ -272,6 +309,38 @@ def _prior_best(scale: str, backend: str,
     return best
 
 
+def _prior_best_norm(scale: str, backend: str,
+                     exclude: str | None = None) -> dict[str, float]:
+    """Best prior CANARY-NORMALIZED value per metric across rounds whose
+    save file recorded a ``canary_s`` (r5+). Same direction conventions
+    as :func:`_prior_best`; rounds without a canary can't be normalized
+    and are skipped here (the raw ratchet still sees them)."""
+    best: dict[str, float] = {}
+    for p in glob.glob(os.path.join(HERE, "results_r*.json")):
+        if exclude is not None and os.path.abspath(p) == \
+                os.path.abspath(exclude):
+            continue
+        try:
+            with open(p) as fh:
+                recs = json.load(fh)
+        except Exception:
+            continue
+        if recs.get("scale") != scale or recs.get("backend") != backend:
+            continue
+        canary = recs.get("canary_s")
+        if not isinstance(canary, (int, float)) or canary <= 0:
+            continue
+        for rec in recs.get("results", []):
+            m, v = rec.get("metric"), rec.get("value")
+            if m not in DIRECTIONS or not isinstance(v, (int, float)):
+                continue
+            d = DIRECTIONS[m]
+            nv = _canary_norm(v, d, canary)
+            if m not in best or (nv - best[m]) * d > 0:
+                best[m] = nv
+    return best
+
+
 def _existing_results(path: str, scale: str, backend: str) -> dict[str, dict]:
     """Metric → record from a previous (possibly partial) save of the same
     round at the same scale+backend, for carry-through and ``--resume``.
@@ -357,6 +426,11 @@ def main():
     results: dict[str, dict] = dict(existing)
     prior = _prior_best(args.scale, jax.default_backend(),
                         exclude=save_path)
+    prior_norm = _prior_best_norm(args.scale, jax.default_backend(),
+                                  exclude=save_path)
+    canary_s = round(canary_seconds(), 6)
+    on_cpu = jax.default_backend() == "cpu"
+    print(f"# canary_s={canary_s}", file=sys.stderr)
 
     def _persist():
         # after EVERY config, atomically: a tunnel wedge mid-suite must
@@ -364,6 +438,7 @@ def main():
         # windows of a few live minutes between multi-hour wedges)
         out = {"round": args.save, "scale": args.scale,
                "backend": jax.default_backend(),
+               "canary_s": canary_s,
                "results": list(results.values())}
         tmp = save_path + ".tmp"
         with open(tmp, "w") as fh:
@@ -387,13 +462,29 @@ def main():
                        "error": f"{type(e).__name__}: {e}"}
             rec["backend"] = jax.default_backend()
         m, v = rec.get("metric"), rec.get("value")
-        if m in DIRECTIONS and m in prior:
+        if m in DIRECTIONS and isinstance(v, (int, float)):
+            rec["canary_normalized"] = round(
+                _canary_norm(v, DIRECTIONS[m], canary_s), 6)
+        if m in DIRECTIONS and (m in prior or m in prior_norm):
             if isinstance(v, (int, float)):
                 d = DIRECTIONS[m]
-                ratio = (v / prior[m]) if d > 0 else (prior[m] / v)
-                rec["vs_best_prior"] = round(ratio, 4)
-                if ratio < 0.9:
-                    regressed.append((m, ratio))
+                gate_ratio = None
+                if m in prior:
+                    ratio = (v / prior[m]) if d > 0 else (prior[m] / v)
+                    rec["vs_best_prior"] = round(ratio, 4)
+                    gate_ratio = ratio
+                if m in prior_norm:
+                    nv = _canary_norm(v, d, canary_s)
+                    nratio = ((nv / prior_norm[m]) if d > 0
+                              else (prior_norm[m] / nv))
+                    rec["vs_best_prior_canary_norm"] = round(nratio, 4)
+                    if on_cpu:
+                        # on the CPU mesh the raw ratio confounds code
+                        # changes with host-speed drift (r4 EVIDENCE);
+                        # the normalized ratio is the gated signal there
+                        gate_ratio = nratio
+                if gate_ratio is not None and gate_ratio < 0.9:
+                    regressed.append((m, gate_ratio))
             else:
                 # a previously-measured config that now crashes is the
                 # worst regression, not a free pass

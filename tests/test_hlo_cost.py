@@ -1,0 +1,95 @@
+"""Gate mechanics of benchmarks/hlo_cost.py (stubbed configs — the
+real lowering runs in script/ci; these tests exercise the ratchet
+logic: growth fails, shrink/equal passes, vanished config fails,
+jax-version mismatch demotes failures to informational)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks"))
+
+import hlo_cost  # noqa: E402
+
+
+def _cfg(name, flops, bytes_accessed, temp=100):
+    def fn():
+        return {"config": name, "flops": float(flops),
+                "bytes_accessed": float(bytes_accessed),
+                "argument_bytes": 1, "output_bytes": 1,
+                "temp_bytes": temp}
+    fn.__name__ = f"cfg_{name}"
+    return fn
+
+
+@pytest.fixture
+def harness(monkeypatch, tmp_path):
+    import jax
+
+    monkeypatch.setattr(hlo_cost, "HERE", str(tmp_path))
+
+    def write_prior(rnd, rows, jax_version=None):
+        (tmp_path / f"hlo_cost_r{rnd:02d}.json").write_text(json.dumps(
+            {"backend": "cpu",
+             "jax_version": jax_version or jax.__version__,
+             "results": rows}))
+
+    def run(argv, configs):
+        monkeypatch.setattr(hlo_cost, "CONFIGS", tuple(configs))
+        monkeypatch.setattr(sys, "argv", ["hlo_cost.py"] + argv)
+        try:
+            hlo_cost.main()
+        except SystemExit as e:
+            return e.code if isinstance(e.code, int) else 1
+        return 0
+
+    return write_prior, run, tmp_path
+
+
+def test_gate_passes_at_parity_and_fails_on_growth(harness):
+    write_prior, run, _ = harness
+    write_prior(4, [_cfg("a", 1000, 5000)()])
+    assert run(["--gate"], [_cfg("a", 1000, 5000)]) == 0
+    assert run(["--gate"], [_cfg("a", 1000, 4000)]) == 0   # shrink ok
+    assert run(["--gate"], [_cfg("a", 1200, 5000)]) == 1   # flops +20%
+    assert run(["--gate"], [_cfg("a", 1000, 6000)]) == 1   # bytes +20%
+    assert run(["--gate"], [_cfg("a", 1000, 5000, temp=200)]) == 1
+
+
+def test_gate_fails_on_vanished_config_and_frees_new(harness):
+    write_prior, run, _ = harness
+    write_prior(4, [_cfg("a", 1000, 5000)()])
+    # a new config is free; the vanished one fails
+    assert run(["--gate"], [_cfg("b", 9e9, 9e9)]) == 1
+    assert run(["--gate"], [_cfg("a", 1000, 5000),
+                            _cfg("b", 9e9, 9e9)]) == 0
+
+
+def test_only_scopes_the_gate(harness):
+    write_prior, run, _ = harness
+    write_prior(4, [_cfg("a", 1000, 5000)(), _cfg("b", 1000, 5000)()])
+    # scoped run must not judge the unran config as vanished
+    assert run(["--gate", "--only", "a"], [_cfg("a", 1000, 5000),
+                                           _cfg("b", 1000, 5000)]) == 0
+
+
+def test_jax_version_mismatch_is_informational(harness):
+    write_prior, run, _ = harness
+    write_prior(4, [_cfg("a", 1000, 5000)()], jax_version="0.0.1")
+    assert run(["--gate"], [_cfg("a", 5000, 5000)]) == 0
+
+
+def test_save_writes_artifact_and_excludes_self_from_prior(harness):
+    write_prior, run, tmp = harness
+    write_prior(4, [_cfg("a", 1000, 5000)()])
+    assert run(["--save", "90", "--gate"], [_cfg("a", 1000, 5000)]) == 0
+    doc = json.loads((tmp / "hlo_cost_r90.json").read_text())
+    assert doc["results"][0]["flops"] == 1000.0
+    # now regress: the prior must be r4 (not the just-saved r90 clone)
+    assert run(["--save", "91", "--gate"], [_cfg("a", 2000, 5000)]) == 1

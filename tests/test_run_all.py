@@ -173,3 +173,51 @@ def test_gate_fails_on_resumed_regression(harness):
     code = runner(["--scale", "small", "--save", "90", "--resume",
                    "--gate", "--only", "bench_jlt"], [_stub(M_A, 9.9)])
     assert code == 1  # the resumed regression still fails the gate
+
+
+def _write_prior_with_canary(tmp, value, canary_s):
+    import jax
+
+    backend = jax.default_backend()
+    (tmp / f"results_r89_{backend}.json").write_text(json.dumps(
+        {"round": 89, "scale": "small", "backend": backend,
+         "canary_s": canary_s,
+         "results": [{"metric": M_A, "value": value}]}))
+
+
+def test_gate_normalizes_host_speed_drift(harness, monkeypatch):
+    """r4 verdict #2: on the CPU backend a uniform host-speed change
+    must NOT trip the gate (the canary cancels it), while a genuine
+    same-host regression still must."""
+    runner, saved, tmp = harness
+    _write_prior_with_canary(tmp, 10.0, canary_s=0.1)
+
+    # today's host is 2x slower: canary doubles, throughput halves.
+    # Raw ratio 0.55 would trip the 0.9 gate; normalized is 1.1.
+    monkeypatch.setattr(run_all, "canary_seconds", lambda: 0.2)
+    code = runner(["--scale", "small", "--save", "90", "--gate",
+                   "--only", "bench_jlt"], [_stub(M_A, 5.5)])
+    assert code == 0
+    rec = _rows(saved(90))[M_A]
+    assert rec["vs_best_prior"] == 0.55          # raw ratio still shown
+    assert rec["vs_best_prior_canary_norm"] == 1.1
+    assert rec["canary_normalized"] == 1.1
+
+    # same host speed as the prior, value genuinely down 50%: trips
+    monkeypatch.setattr(run_all, "canary_seconds", lambda: 0.1)
+    code = runner(["--scale", "small", "--save", "91", "--gate",
+                   "--only", "bench_jlt"], [_stub(M_A, 5.0)])
+    assert code == 1
+
+
+def test_prior_without_canary_still_gates_raw(harness):
+    """Pre-r5 rounds have no canary_s: the raw ratchet must keep
+    working against them."""
+    runner, saved, tmp = harness
+    _write_prior(tmp, 10.0)
+    code = runner(["--scale", "small", "--save", "90", "--gate",
+                   "--only", "bench_jlt"], [_stub(M_A, 5.0)])
+    assert code == 1
+    rec = _rows(saved(90))[M_A]
+    assert rec["vs_best_prior"] == 0.5
+    assert "vs_best_prior_canary_norm" not in rec
