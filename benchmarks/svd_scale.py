@@ -66,14 +66,16 @@ def _make_problem(n: int, r0: int, key, dtype):
 
 
 def _timed_svd(A, rank):
-    """approximate_svd twice: an UNPROFILED run whose wall time is the
-    headline (same overlapped-dispatch pipeline every prior round
-    measured — profiling inserts per-phase sync barriers and would make
-    the record slower-by-construction), then a PROFILED pass (warm
-    compile cache) for the sketch / power-iteration / Rayleigh-Ritz
-    split the north-star extrapolation needs (BASELINE.md; r3 verdict
-    #5). Timer state is restored whatever happens, so a crashed config
-    can't leave the process-wide profiler on for later configs."""
+    """approximate_svd three ways: a COLD run (pays XLA compilation —
+    recorded separately; the r4 profile's "~1.9s unattributed" at 8192²
+    was exactly the cold wall minus the warm phases), a WARM unprofiled
+    run whose wall is the headline (the overlapped-dispatch pipeline,
+    compile cache hot — the number comparable to the reference's
+    steady-state wall), then a PROFILED pass for the sketch /
+    power-iteration / Rayleigh-Ritz split the north-star extrapolation
+    needs (BASELINE.md). Timer state is restored whatever happens, so a
+    crashed config can't leave the process-wide profiler on for later
+    configs."""
     import time
 
     import jax.numpy as jnp
@@ -85,6 +87,11 @@ def _timed_svd(A, rank):
     t0 = time.perf_counter()
     U, S, V = approximate_svd(A, rank, Context(seed=19))
     float(jnp.sum(S))  # force completion through a readback
+    cold = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    U, S, V = approximate_svd(A, rank, Context(seed=19))
+    float(jnp.sum(S))
     wall = time.perf_counter() - t0
 
     prev_enabled = sk_timer.timers_enabled()
@@ -97,6 +104,7 @@ def _timed_svd(A, rank):
         float(jnp.sum(S))
         phases = {k: round(v, 3) for k, v in t.totals.items()}
         phases["note"] = "separate profiled pass (per-phase sync)"
+        phases["cold_wall_s"] = round(cold, 3)
     finally:
         sk_timer.set_enabled(prev_enabled)
         t.totals, t.counts = prev_totals, prev_counts

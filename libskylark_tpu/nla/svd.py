@@ -30,16 +30,27 @@ class ApproximateSVDParams(Params):
     """ref: nla/svd.hpp:24-52 (defaults oversampling_ratio=2, additive=0,
     num_iterations=0, skip_qr=False; JSON-loadable).
 
-    ``ortho`` selects the panel orthogonalization: "qr" (Householder — the
-    reference's El::qr) or "cqr2" (CholeskyQR2, nla/tsqr.py — the
-    mesh-native choice: local gemm + one psum + triangular solve, all
-    MXU work; accurate for cond(panel) ≲ 1/√ε)."""
+    ``ortho`` selects the panel orthogonalization: "cqr2" (CholeskyQR2,
+    nla/tsqr.py — the mesh-native default: local gemm + one psum +
+    triangular solve, all MXU work; the diagonal lift plus second pass
+    keep it accurate far past the textbook cond ≲ 1/√ε bound for the
+    truncated spectra randomized SVD meets) or "qr" (Householder — the
+    reference's El::qr algebra, replicated LAPACK/XLA work on a mesh).
+
+    ``rr`` selects the Rayleigh-Ritz reduction: "cqr2" (default —
+    tall-QR-reduce Bᵀ = (Aᵀ·Q) with CholeskyQR2, then SVD only the
+    (k'×k') triangular factor; every O(n·k'²) flop is a shardable gemm)
+    or "svd" (the reference's direct SVD of the k'×n panel,
+    nla/svd.hpp:286-290 — on a mesh XLA replicates that LAPACK/QR-
+    iteration work on every device, measured 5× slower at 8192²/k'=128,
+    and on TPU the wide-matrix SVD lowering is iterative and slow)."""
 
     oversampling_ratio: float = 2.0
     oversampling_additive: int = 0
     num_iterations: int = 0
     skip_qr: bool = False
-    ortho: str = "qr"
+    ortho: str = "cqr2"
+    rr: str = "cqr2"
 
 
 def _orthonormalize(Q: jnp.ndarray, method: str) -> jnp.ndarray:
@@ -68,7 +79,14 @@ def _as_linear_ops(A):
     if isinstance(A, DistSparseMatrix):
         return A.spmm, A.spmm_t, A.shape
     A = jnp.asarray(A)
-    return (lambda X: A @ X), (lambda X: A.T @ X), A.shape
+    # rmv as (Xᵀ·A)ᵀ, not Aᵀ·X: these call sites run EAGERLY (op-by-op
+    # dispatch — only inner pieces are jitted), and an eager Aᵀ
+    # materializes a transposed copy of the WHOLE operand per call
+    # (268 MB at 8192² f32, with a resharding shuffle when A is
+    # mesh-sharded) where the result transpose is a k'-panel. Under jit
+    # XLA fuses either form into the same gemm; eagerly only this form
+    # is cheap.
+    return (lambda X: A @ X), (lambda X: (X.T @ A).T), A.shape
 
 
 def _transposed(A):
@@ -175,12 +193,31 @@ def approximate_svd(
             Q = _orthonormalize(Q, params.ortho)
         Q = _sync(Q)
 
-    # Rayleigh-Ritz on the range: B = Qᵀ·A = (Aᵀ·Q)ᵀ, small SVD, rotate
-    # back (ref: nla/svd.hpp:283-290).
-    with timer.phase("RAYLEIGH_RITZ"):
-        B = rmv(Q).T  # (kp, n)
-        Ub, S, Vt = jnp.linalg.svd(B, full_matrices=False)
-        U, S, V = _sync((Q @ Ub[:, :k], S[:k], Vt[:k, :].T))
+    # Rayleigh-Ritz on the range: B = Qᵀ·A = (Aᵀ·Q)ᵀ, small
+    # factorization, rotate back (ref: nla/svd.hpp:283-290). Profiled as
+    # two phases: RR_PROJECT is the O(m·n·k') gemm over A — the same
+    # cost class as SKETCH, irreducible — while RR_SMALL is the
+    # factorization/rotation work the r4 verdict flagged at 43% of wall
+    # (an eager whole-operand transpose + replicated wide SVD; now
+    # sharded gemms + a k'×k' SVD).
+    with timer.phase("RR_PROJECT"):
+        Bt = _sync(rmv(Q))  # (n, kp) — tall; B = Btᵀ
+    with timer.phase("RR_SMALL"):
+        if params.rr == "svd":
+            Ub, S, Vt = jnp.linalg.svd(Bt.T, full_matrices=False)
+            U, S, V = _sync((Q @ Ub[:, :k], S[:k], Vt[:k, :].T))
+        elif params.rr == "cqr2":
+            # Bᵀ = Qb·Rb (all-gemm tall QR) ⇒ B = Rbᵀ·Qbᵀ; SVD only the
+            # k'×k' factor: Rbᵀ = Ur·S·Vrᵀ ⇒ B = Ur·S·(Qb·Vr)ᵀ. The
+            # expensive n-dimension work is gemms that shard along n.
+            from libskylark_tpu.nla.tsqr import cholesky_qr2
+
+            Qb, Rb = cholesky_qr2(Bt)
+            Ur, S, Vrt = jnp.linalg.svd(Rb.T, full_matrices=False)
+            U, S, V = _sync((Q @ Ur[:, :k], S[:k], Qb @ Vrt.T[:, :k]))
+        else:
+            raise errors.InvalidParametersError(
+                f"rr must be 'cqr2' or 'svd', got {params.rr!r}")
     return U, S, V
 
 
