@@ -228,7 +228,9 @@ def main():
                 # brick every later save — preserve the evidence of the
                 # tear, start the list fresh
                 os.replace(path, path + ".corrupt")
-        recs = [r for r in recs if r.get("mode") != rec["mode"]] + [rec]
+        key = (rec["mode"], rec["n"], rec["rank"])
+        recs = [r for r in recs
+                if (r.get("mode"), r.get("n"), r.get("rank")) != key] + [rec]
         # atomic: the watcher runs this under `timeout`, and a SIGTERM
         # between a truncating open and the dump's end would destroy the
         # other mode's captured record
