@@ -1,7 +1,7 @@
 """Worker for tests/test_multihost.py: one simulated HOST process.
 
-Run as ``python multihost_worker.py <pid> <nprocs> <port>``. Joins the
-pool through the framework's own bootstrap
+Run as ``python multihost_worker.py <pid> <nprocs> <port>
+[devices_per_proc]``. Joins the pool through the framework's own bootstrap
 (``parallel.multihost.initialize_distributed`` — the MPI_Init analog,
 ref: ml/skylark_ml.cpp:17-20), builds a mesh spanning every process's
 devices, and checks the framework oracle ACROSS HOSTS: a sketch applied
@@ -15,12 +15,15 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# 4 virtual devices per process → the mesh crosses hosts AND has
-# intra-host device parallelism (2 hosts × 4 devices = 8)
+# >1 virtual devices per process → the mesh crosses hosts AND has
+# intra-host device parallelism (2 hosts × 4 devices, or 4 hosts × 2 —
+# the 4-host shape puts THREE host boundaries in the mesh, catching
+# axis-ordering/non-adjacent-shard bugs the pairwise case can't)
+DPP = int(sys.argv[4]) if len(sys.argv) > 4 else 4
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=4").strip()
+        flags + f" --xla_force_host_platform_device_count={DPP}").strip()
 
 import jax
 
@@ -52,7 +55,8 @@ def main() -> None:
 
     devs = jax.devices()
     n_dev = len(devs)
-    assert n_dev == nprocs * 4, f"expected {nprocs * 4} devices, {n_dev}"
+    assert n_dev == nprocs * DPP, \
+        f"expected {nprocs * DPP} devices, {n_dev}"
     mesh = Mesh(np.array(devs), ("d",))
 
     # Global problem, identical in every process (same seed); each
@@ -155,8 +159,8 @@ def main() -> None:
         lambda idx: np.full(1, float(pid + 1), np.float32))
     out = jax.jit(shard_map(lambda v: jax.lax.psum(v, "d"), mesh=mesh,
                             in_specs=P("d"), out_specs=P("d")))(gx)
-    # each process holds 4 shards of value pid+1 → psum = 4*1 + 4*2
-    expect = 4.0 * sum(range(1, nprocs + 1))
+    # each process holds DPP shards of value pid+1 → psum = DPP·Σ(i+1)
+    expect = float(DPP) * sum(range(1, nprocs + 1))
     got = float(np.asarray(out.addressable_shards[0].data)[0])
     assert got == expect, (got, expect)
     print(f"proc {pid}: psum across hosts = {got} MULTIHOST_OK",

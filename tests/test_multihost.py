@@ -31,11 +31,20 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_mesh_runs_sketch_oracle(tmp_path):
+@pytest.mark.parametrize(
+    "nprocs,devs_per_proc",
+    [(2, 4),   # one host boundary, intra-host parallelism 4
+     (4, 2)],  # THREE host boundaries at the same 8 global devices —
+               # axis-ordering/non-adjacent-shard coverage the pairwise
+               # case can't give (the rank-count diversity of ref:
+               # tests/unit/CMakeLists.txt:10-46, np=1/4/5/7)
+)
+def test_process_mesh_runs_sketch_oracle(tmp_path, nprocs,
+                                         devs_per_proc):
     port = _free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    # shared checkpoint root for the cross-host resume step (both
+    # shared checkpoint root for the cross-host resume step (all
     # simulated hosts see one filesystem, as a pod's workers would a
     # shared store)
     env["SKYLARK_MH_TMP"] = str(tmp_path)
@@ -43,16 +52,17 @@ def test_two_process_mesh_runs_sketch_oracle(tmp_path):
     env.pop("XLA_FLAGS", None)
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(pid), "2", str(port)],
+            [sys.executable, WORKER, str(pid), str(nprocs), str(port),
+             str(devs_per_proc)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=HERE,
         )
-        for pid in range(2)
+        for pid in range(nprocs)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=180 * nprocs)
             outs.append(out)
     finally:
         for p in procs:
