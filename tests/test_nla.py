@@ -96,6 +96,35 @@ class TestApproximateSVD:
         with pytest.raises(Exception, match="rank"):
             nla.approximate_svd(jnp.eye(4), 0, Context(0))
 
+    def test_rr_reductions_agree(self):
+        """The CQR2-reduced Rayleigh-Ritz (r5 default — the r4 mesh
+        hotspot fix) and the reference-algebra direct SVD of the k'×n
+        panel (ref: nla/svd.hpp:286-290) must produce the same
+        factorization on the same sketch, including on an
+        ill-conditioned spectrum (decay past 1/√ε in f32)."""
+        rng = np.random.default_rng(21)
+        r0 = 48
+        decay = 0.82 ** np.arange(r0)
+        A = ((rng.standard_normal((300, r0)) * decay)
+             @ rng.standard_normal((r0, 160))).astype(np.float32)
+        out = {}
+        for rr in ("cqr2", "svd"):
+            U, S, V = nla.approximate_svd(
+                jnp.asarray(A), 8, Context(seed=23),
+                nla.ApproximateSVDParams(num_iterations=1, rr=rr))
+            np.testing.assert_allclose(np.asarray(U.T @ U), np.eye(8),
+                                       atol=1e-4)
+            np.testing.assert_allclose(np.asarray(V.T @ V), np.eye(8),
+                                       atol=1e-4)
+            out[rr] = np.asarray(S)
+        np.testing.assert_allclose(out["cqr2"], out["svd"], rtol=1e-4)
+
+    def test_rr_invalid_value_raises(self):
+        with pytest.raises(Exception, match="rr"):
+            nla.approximate_svd(
+                jnp.asarray(_lowrank(40, 20, 4, seed=9)), 4,
+                Context(seed=2), nla.ApproximateSVDParams(rr="bogus"))
+
 
 class TestSymmetricSVD:
     def test_symmetric_reconstruction(self):
