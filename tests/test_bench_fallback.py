@@ -1,0 +1,106 @@
+"""End-to-end tests of bench.py's wedged-tunnel fallback: the
+verified-committed block, content-hash oracle freshness, and the r5
+promotion rule (a committed capture becomes the headline value ONLY
+when its oracle stamp's kernel sha256 matches the working tree).
+
+Runs bench.py as a subprocess from a fixture tree with
+SKYLARK_BENCH_DEADLINE below the probe threshold, so main() goes
+straight to the fallback path — no backend is ever touched (these are
+orchestration tests, deliberately hardware-free)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def tree(tmp_path):
+    """Minimal working tree: bench.py + the kernel file + a committed
+    r99 headline record; returns (dir, write_stamp, run)."""
+    shutil.copy(os.path.join(REPO, "bench.py"), tmp_path / "bench.py")
+    kdir = tmp_path / "libskylark_tpu" / "sketch"
+    kdir.mkdir(parents=True)
+    kernel = kdir / "pallas_dense.py"
+    kernel.write_text("# kernel source v1\n")
+    bdir = tmp_path / "benchmarks"
+    bdir.mkdir()
+    rec = {"metric": "jlt_sketch_apply_GBps_per_chip", "value": 123.4,
+           "provenance": {"captured": "2026-07-31T00:00:00+00:00"},
+           "cold_start_wall_s": 61}
+    (bdir / "results_tpu_r99_headline.json").write_text(json.dumps(rec))
+
+    def write_stamp(content: str | None):
+        p = bdir / ".tpu_oracle_recert_r99"
+        if content is None:
+            kern_sha = hashlib.sha256(
+                kernel.read_bytes()).hexdigest()
+            content = f"2026-07-31T00:00:00Z kernel_sha256={kern_sha}"
+        p.write_text(content)
+
+    def run():
+        env = dict(os.environ)
+        env["SKYLARK_BENCH_DEADLINE"] = "25"  # below the 30s loop gate
+        out = subprocess.run(
+            [sys.executable, str(tmp_path / "bench.py")],
+            capture_output=True, text=True, timeout=60, env=env,
+            cwd=str(tmp_path))
+        assert out.returncode == 0, out.stderr[-500:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    return tmp_path, write_stamp, run
+
+
+def test_no_stamp_reports_null_with_verified_block(tree):
+    _, _, run = tree
+    rec = run()
+    assert rec["value"] is None
+    vc = rec["verified_committed"]
+    assert vc["value"] == 123.4
+    assert vc["oracle_fresh"] is False and vc["oracle_stamp"] is None
+
+
+def test_fresh_stamp_promotes_committed_value(tree):
+    _, write_stamp, run = tree
+    write_stamp(None)  # matching kernel sha
+    rec = run()
+    assert rec["value"] == 123.4
+    assert rec["measured_live"] is False
+    assert rec["promoted_from_committed"].endswith(
+        "results_tpu_r99_headline.json")
+    assert rec["verified_committed"]["oracle_fresh"] is True
+
+
+def test_stale_kernel_hash_blocks_promotion(tree):
+    tmp, write_stamp, run = tree
+    write_stamp(None)
+    # the kernel changes AFTER certification: the number no longer
+    # describes certified numerics — must NOT be promoted
+    (tmp / "libskylark_tpu" / "sketch" / "pallas_dense.py").write_text(
+        "# kernel source v2 (uncertified)\n")
+    rec = run()
+    assert rec["value"] is None
+    assert rec["verified_committed"]["oracle_fresh"] is False
+
+
+def test_pre_r5_stamp_without_hash_does_not_promote(tree):
+    _, write_stamp, run = tree
+    write_stamp("2026-07-31T00:00:00Z")  # old format: timestamp only
+    rec = run()
+    # mtime fallback may judge freshness either way depending on file
+    # creation order, but promotion additionally requires the sha match
+    # path; with no hash in the stamp the mtime path decides
+    # oracle_fresh — written after the kernel here, so fresh=True is
+    # acceptable; the key invariant is the record stays self-describing
+    vc = rec["verified_committed"]
+    assert "kernel_sha256" not in (vc["oracle_stamp"] or "")
+    if rec["value"] is not None:
+        assert rec["measured_live"] is False
