@@ -219,15 +219,31 @@ def bench_frft(scale: str):
     X = jnp.asarray(np.random.default_rng(8).standard_normal((n, d)),
                     jnp.float32)
     out = {}
+    T_frft = FastGaussianRFT(d, s, Context(seed=9), sigma=2.0)
     for tag, T in (
-        ("frft", FastGaussianRFT(d, s, Context(seed=9), sigma=2.0)),
+        ("frft", T_frft),
         ("rft", GaussianRFT(d, s, Context(seed=9), sigma=2.0)),
     ):
         f = jax.jit(lambda X, T=T: jnp.sum(jnp.abs(T.apply(X, ROWWISE))))
         out[tag] = round(n / _time_scalar(f, X) / 1e6, 3)
-    return {"metric": "frft_feature_map_Mrows_per_s", "value": out["frft"],
-            "unit": "Mrows/s", "rft_same_config": out["rft"],
-            "speedup_vs_rft": round(out["frft"] / out["rft"], 3)}
+    # whether the fused single-kernel chain (pallas_fastfood) served the
+    # EAGER path on this backend; inside jit the dispatch sees a tracer
+    # and takes the XLA chain, so also time the eager kernel path when
+    # available — the record must say which path each number describes
+    from libskylark_tpu.sketch import pallas_fastfood as pf
+
+    rec = {"metric": "frft_feature_map_Mrows_per_s", "value": out["frft"],
+           "unit": "Mrows/s", "rft_same_config": out["rft"],
+           "speedup_vs_rft": round(out["frft"] / out["rft"], 3),
+           "path": "xla_chain_jit"}
+    if pf.supported(T_frft, X):
+        g = (lambda X: jnp.sum(jnp.abs(
+            pf.features_rows(T_frft, X))))
+        out["frft_fused_kernel"] = round(n / _time_scalar(g, X) / 1e6, 3)
+        rec["fused_kernel_Mrows_per_s"] = out["frft_fused_kernel"]
+        rec["fused_speedup_vs_rft"] = round(
+            out["frft_fused_kernel"] / out["rft"], 3)
+    return rec
 
 
 def bench_nla(scale: str):

@@ -20,6 +20,7 @@ SWEEP_SPECS=("512 1" "512 0" "1024 1" "1024 0" "256 0")
 
 have_oracle_recert() { [ -f benchmarks/.tpu_oracle_recert_r05 ]; }
 have_battery() { [ -f benchmarks/.tpu_battery_r05 ]; }
+have_fastfood_cert() { [ -f benchmarks/.tpu_fastfood_r05 ]; }
 have_headline() {
     python - <<'EOF'
 import json, sys
@@ -264,6 +265,31 @@ attempt_all() {
             note_fail svd || return 1
         fi
     fi
+    # fused Fastfood kernel: first-ever Mosaic compile of the
+    # take_along_axis lane gather + on-chip oracle (interpret-mode
+    # semantics already pinned on CPU). A compile failure is itself
+    # round evidence — the log tail lands in tpu_validation_r05.txt
+    # either way, and run_all's frft config captures the timing A/B.
+    if [ -f tests/test_pallas_fastfood.py ] && ! have_fastfood_cert \
+            && ! give_up fastfood; then
+        log "fused Fastfood kernel on-chip certification"
+        timeout 900 env JAX_PLATFORMS=tpu SKYLARK_TEST_TPU=1 \
+            python -m pytest tests/test_pallas_fastfood.py -m tpu -rA -q \
+            > /tmp/tpu_fastfood_r05.log 2>&1
+        local rc=$?
+        {
+            echo "# r05 fused-fastfood cert $(date -u +%Y-%m-%dT%H:%M:%SZ) rc=$rc"
+            tail -25 /tmp/tpu_fastfood_r05.log
+        } >> benchmarks/tpu_validation_r05.txt
+        if [ $rc -eq 0 ]; then
+            date -u +%Y-%m-%dT%H:%M:%SZ > benchmarks/.tpu_fastfood_r05
+            commit_artifacts "r05 fused Fastfood kernel certified on chip"
+        else
+            failed=1
+            commit_artifacts "r05 fused Fastfood compile/oracle transcript (rc=$rc)"
+            note_fail fastfood || return 1
+        fi
+    fi
     return $failed
 }
 
@@ -278,5 +304,9 @@ all_done() {
     if [ -f tests/test_tpu_battery.py ]; then
         have_battery || return 1
     fi
-    have_svd_chip
+    have_svd_chip || return 1
+    if [ -f tests/test_pallas_fastfood.py ]; then
+        have_fastfood_cert || return 1
+    fi
+    return 0
 }

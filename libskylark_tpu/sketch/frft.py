@@ -147,6 +147,18 @@ class FastRFT(SketchTransform):
         return self._features_rows(A.T).T
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        # fused single-kernel chain on TPU (one HBM read of A, one write
+        # of the features — the XLA chain re-touches the intermediate
+        # ~9×; BASELINE.md crossover analysis); any decline or Mosaic
+        # failure falls back to the XLA chain below
+        from libskylark_tpu.sketch import params as sketch_params
+
+        if sketch_params.get_use_pallas():
+            from libskylark_tpu.sketch import pallas_fastfood
+
+            out = pallas_fastfood.features_rows(self, A)
+            if out is not None:
+                return out
         return self._features_rows(A)
 
     def _extra_params(self) -> dict[str, Any]:

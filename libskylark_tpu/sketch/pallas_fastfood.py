@@ -1,0 +1,207 @@
+"""Fused Fastfood feature map: the whole SHGΠHB chain in one Mosaic
+kernel.
+
+Motivation (BASELINE.md crossover analysis; ref: sketch/FRFT_Elemental.hpp,
+sketch/FUT.hpp:225-347): the XLA Fastfood chain is bandwidth-bound — at
+(16384, 4096 → 4096) it moves 4.83 GB for 34.8 GFLOP (hlo_cost_r05.json)
+because every stage re-touches the whole (rows, NB) intermediate in HBM,
+while dense RFT's single gemm moves 3.31 GB. This kernel keeps one m-tile
+of the input resident in VMEM through the ENTIRE chain:
+
+    read X tile → B⊙ → WHT → Π-gather → (scal·G)⊙ → WHT → (scal·Sm)⊙
+      → scale·cos(· + shifts) → write F tile
+
+so HBM traffic is one read of X plus one write of F (~0.54 GB at the
+flagship config — ~9× less than the XLA chain, ~6× less than the dense
+gemm) while the WHT matmuls ride the MXU. Each WHT runs as the same
+kron-factored two-dot form as fut._wht_matmul (Ha·X·Hb over the
+(a, b)-folded axis) with the contractions always on a minor axis — the
+(a, b) fold is transposed between the dots with a rank-3 minor-axes swap.
+Contractions use pallas_dense._dot, the on-chip-certified bf16x3 /
+f32 / bf16 regime set (±1 Hadamard factors are bf16-exact, so bf16x3 is
+f32-grade here).
+
+Like pallas_dense, the kernel is planned against the ~16 MiB VMEM budget
+(the m-tile shrinks rather than failing Mosaic) and every caller falls
+back to the XLA chain when the kernel declines or fails to compile —
+the permutation gather (`jnp.take_along_axis` along the lane axis with
+trace-constant indices) is the one op in this kernel without a
+certified precedent in this repo; until a live window compile-checks
+it, the dispatch treats Mosaic rejection as a normal decline. Exact
+semantics vs the XLA chain are pinned by interpret-mode oracles in
+tests/test_pallas_fastfood.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+from libskylark_tpu.sketch.fut import _hadamard_np
+from libskylark_tpu.sketch.pallas_dense import (_VMEM_BUDGET_BYTES, _dot,
+                                                available)
+
+try:  # same import seam as pallas_dense: CPU-only hosts lack TPU pallas
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS = True
+except Exception:  # pragma: no cover
+    _PALLAS = False
+
+
+def _wht_split(NB: int) -> tuple[int, int]:
+    """The (a, b) kron fold — SAME split rule as fut._wht_matmul so the
+    kernel and the XLA path accumulate in comparable order."""
+    k = NB.bit_length() - 1
+    a = 1 << (k - k // 2)
+    return a, NB // a
+
+
+def _wht2(W, Ha, Hb, mt: int, a: int, b: int, precision: str):
+    """Ha·X·Hb over the (a, b)-folded minor axis of W (mt, a·b): two 2-D
+    MXU dots with the fold transposed between them (math identical to
+    fut._wht_matmul's einsum; exact-arithmetic wise both are ±1-weighted
+    f32 sums)."""
+    dims = (((1,), (0,)), ((), ()))
+    Z = _dot(W.reshape(mt * a, b), Hb, dims, precision).reshape(mt, a, b)
+    Zt = jnp.swapaxes(Z, 1, 2)
+    Y = _dot(Zt.reshape(mt * b, a), Ha, dims, precision).reshape(mt, b, a)
+    return jnp.swapaxes(Y, 1, 2).reshape(mt, a * b)
+
+
+def _kernel(mt, NB, precision, scale,
+            x_ref, bdiag_ref, perm_ref, gdiag_ref, smdiag_ref, shift_ref,
+            ha_ref, hb_ref, out_ref):
+    """One (block, m-tile) grid step: the full chain in VMEM.
+
+    Refs: x (mt, NB) padded input rows; bdiag/gdiag/smdiag/shift
+    (1, NB) this block's diagonals (g/sm pre-scaled by √NB·fut.scale);
+    perm (1, NB) int32 gather indices; ha/hb the ±1 Hadamard kron
+    factors (pallas requires trace constants as inputs); out (mt, NB)
+    features before block-order interleave/truncation (done by the
+    caller in XLA)."""
+    a, b = _wht_split(NB)
+    Ha, Hb = ha_ref[:], hb_ref[:]
+    W = bdiag_ref[:] * x_ref[:]
+    W = _wht2(W, Ha, Hb, mt, a, b, precision)
+    W = jnp.take_along_axis(W, perm_ref[:], axis=1)
+    W = gdiag_ref[:] * W
+    W = _wht2(W, Ha, Hb, mt, a, b, precision)
+    W = smdiag_ref[:] * W
+    out_ref[:] = (scale * jnp.cos(W + shift_ref[:])).astype(
+        out_ref.dtype)[None]
+
+
+def plan_m_tile(NB: int, m: int) -> int | None:
+    """Largest m-tile whose working set fits the VMEM budget: double-
+    buffered in/out tiles plus ~4 chain temporaries, all (mt, NB) f32.
+    None when even the minimum tile doesn't fit (NB too large)."""
+    per_row = NB * 4 * (2 + 2 + 4)
+    mt = _VMEM_BUDGET_BYTES // per_row
+    mt = min(int(mt), m, 512)
+    mt -= mt % 8
+    return mt if mt >= 8 else None
+
+
+@functools.partial(jax.jit, static_argnames=("mt", "NB", "nb",
+                                             "precision", "scale",
+                                             "interpret"))
+def _launch(X, bdiag, perms, gdiag, smdiag, shifts, mt, NB, nb,
+            precision, scale, interpret):
+    n_tiles = X.shape[0] // mt
+    a, b = _wht_split(NB)
+    Ha = jnp.asarray(_hadamard_np(a), jnp.float32)
+    Hb = jnp.asarray(_hadamard_np(b), jnp.float32)
+    return pl.pallas_call(
+        functools.partial(_kernel, mt, NB, precision, scale),
+        grid=(nb, n_tiles),
+        in_specs=[
+            pl.BlockSpec((mt, NB), lambda blk, t: (t, 0)),
+            pl.BlockSpec((1, NB), lambda blk, t: (blk, 0)),
+            pl.BlockSpec((1, NB), lambda blk, t: (blk, 0)),
+            pl.BlockSpec((1, NB), lambda blk, t: (blk, 0)),
+            pl.BlockSpec((1, NB), lambda blk, t: (blk, 0)),
+            pl.BlockSpec((1, NB), lambda blk, t: (blk, 0)),
+            pl.BlockSpec((a, a), lambda blk, t: (0, 0)),
+            pl.BlockSpec((b, b), lambda blk, t: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, mt, NB), lambda blk, t: (blk, t, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb, X.shape[0], NB), X.dtype),
+        interpret=interpret,
+    )(X, bdiag, perms, gdiag, smdiag, shifts, Ha, Hb)
+
+
+def supported(transform, A) -> bool:
+    """Whether the fused kernel may serve this FastRFT apply: WHT core
+    in its MXU-matmul regime, f32 single-device eager input (sharded
+    applies keep the XLA path, whose partitioning XLA handles)."""
+    if not (_PALLAS and available()):
+        return False
+    if getattr(transform, "_fut_name", None) != "wht":
+        return False
+    if transform._NB < 512 or transform._NB & (transform._NB - 1):
+        return False
+    if isinstance(A, jax.core.Tracer):
+        return False
+    if not isinstance(A, jax.Array) or A.dtype != jnp.float32:
+        return False
+    try:
+        if len(A.sharding.device_set) != 1:
+            return False
+    except Exception:
+        return False
+    return plan_m_tile(transform._NB, int(A.shape[0])) is not None
+
+
+def features_rows(transform, At, *, interpret: bool = False,
+                  precision: str | None = None):
+    """The (m, S) Fastfood feature map for row-major input At (m, N)
+    through the fused kernel, or None when the kernel declines or fails
+    (caller falls back to the XLA chain — mirror of
+    pallas_dense.rowwise_apply's contract). ``interpret`` runs the
+    pallas interpreter (CPU-testable exact semantics)."""
+    import math
+
+    if not interpret and not supported(transform, At):
+        return None
+    T = transform
+    NB, nb = T._NB, T._numblks
+    m, d = At.shape
+    mt = plan_m_tile(NB, m)
+    if mt is None:
+        return None
+    if precision is None:
+        precision = os.environ.get("SKYLARK_FASTFOOD_PRECISION", "bf16x3")
+    dt = At.dtype
+    scal = math.sqrt(NB) * T._fut.scale()
+
+    pad_rows = (-m) % mt
+    pad_cols = NB - d
+    Ap = (jnp.pad(At, ((0, pad_rows), (0, pad_cols)))
+          if pad_rows or pad_cols else At)
+
+    bdiag = T._B(dt)
+    gdiag = scal * T._G(dt)
+    smdiag = scal * T._Sm(dt).reshape(nb, NB)
+    perms = T._perms().astype(jnp.int32)
+    sh = T.shifts(dt)
+    # shifts indexed by FINAL feature position f = blk·NB + j; features
+    # past S are computed then sliced off — pad their shifts with zeros
+    sh = jnp.pad(sh, (0, nb * NB - T._S)).reshape(nb, NB)
+
+    try:
+        F = _launch(Ap, bdiag, perms, gdiag, smdiag, sh,
+                    mt=mt, NB=NB, nb=nb, precision=precision,
+                    scale=float(T.scale), interpret=interpret)
+    except Exception:
+        if interpret:  # test mode: surface the real failure
+            raise
+        return None
+    # (nb, m_p, NB) → block-major feature order, un-pad, truncate —
+    # identical to FastRFT._features_rows' epilogue
+    return jnp.moveaxis(F, 0, 1).reshape(Ap.shape[0], nb * NB)[
+        :m, : T._S]

@@ -1,0 +1,137 @@
+"""Fused Fastfood kernel oracles.
+
+Interpret-mode tests pin the kernel's EXACT semantics against the XLA
+chain (`FastRFT._features_rows`) on CPU — same diagonals, permutations,
+block order, truncation, cos featurization — so the first live tunnel
+window spends its budget on Mosaic compilation and timing, not
+semantics (the r3/r4 discipline: never burn a window on a test-file
+bug). The @tpu test is the on-chip certification the watcher runs."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libskylark_tpu.base.context import Context
+from libskylark_tpu.sketch import pallas_fastfood as pf
+from libskylark_tpu.sketch.frft import FastGaussianRFT, FastMaternRFT
+
+
+def _X(m, d, seed=0, scale=0.3):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal((m, d)) * scale,
+        jnp.float32)
+
+
+def _oracle(T, X):
+    """The XLA chain is the semantic definition (its own correctness is
+    pinned by the explicit-operator oracle in test_sketch_fast.py)."""
+    return np.asarray(T._features_rows(X), np.float64)
+
+
+class TestInterpretOracle:
+    @pytest.mark.parametrize("m,d,s", [
+        (32, 512, 512),     # single block, no padding
+        (32, 512, 1536),    # THREE blocks (block-major order + perms)
+        (24, 300, 512),     # d < NB: column padding
+        (19, 512, 700),     # ragged rows (row padding) + truncation
+    ])
+    def test_matches_xla_chain(self, m, d, s):
+        T = FastGaussianRFT(d, s, Context(seed=8), sigma=2.5)
+        X = _X(m, d, seed=m)
+        got = pf.features_rows(T, X, interpret=True, precision="f32")
+        assert got is not None and got.shape == (m, s)
+        np.testing.assert_allclose(np.asarray(got), _oracle(T, X),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_matern_sm_diagonal(self):
+        T = FastMaternRFT(512, 1024, Context(seed=9), nu=1.5, l=2.0)
+        X = _X(16, 512, seed=3)
+        got = pf.features_rows(T, X, interpret=True, precision="f32")
+        np.testing.assert_allclose(np.asarray(got), _oracle(T, X),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_bf16x3_regime_stays_in_oracle(self):
+        """The shipping contraction regime: ±1 Hadamard factors are
+        bf16-exact, so the 3-pass split must stay f32-grade through the
+        DOUBLE WHT (error compounds across the two transforms)."""
+        T = FastGaussianRFT(512, 512, Context(seed=11), sigma=2.0)
+        X = _X(32, 512, seed=5)
+        got = pf.features_rows(T, X, interpret=True, precision="bf16x3")
+        np.testing.assert_allclose(np.asarray(got), _oracle(T, X),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_deterministic_across_calls(self):
+        T = FastGaussianRFT(512, 512, Context(seed=12))
+        X = _X(16, 512, seed=7)
+        a = pf.features_rows(T, X, interpret=True)
+        b = pf.features_rows(T, X, interpret=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_kernel_approximates_gaussian_kernel(self):
+        """End-to-end sanity at MC rate — same oracle class as the
+        on-chip battery's Fastfood test."""
+        d, s, m, sigma = 64, 2048, 12, 3.0
+        X = _X(m, d, seed=4)
+        T = FastGaussianRFT(d, s, Context(seed=8), sigma=sigma)
+        F = np.asarray(
+            pf.features_rows(T, X, interpret=True), np.float64)
+        got = F @ F.T
+        Xn = np.asarray(X, np.float64)
+        d2 = ((Xn[:, None, :] - Xn[None, :, :]) ** 2).sum(-1)
+        want = np.exp(-d2 / (2 * sigma * sigma))
+        assert np.max(np.abs(got - want)) < 0.15
+
+
+class TestDispatch:
+    def test_declines_off_tpu_and_falls_back(self):
+        """On the CPU backend supported() is False: the public apply
+        must transparently take the XLA chain (and the kernel path must
+        return None rather than raise)."""
+        from libskylark_tpu.sketch import ROWWISE
+
+        T = FastGaussianRFT(512, 512, Context(seed=13))
+        X = _X(8, 512, seed=9)
+        assert pf.features_rows(T, X) is None
+        out = T.apply(X, ROWWISE)  # dispatch falls through, no error
+        np.testing.assert_allclose(np.asarray(out), _oracle(T, X),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_declines_dct_core_and_small_nb(self):
+        X = _X(8, 300, seed=1)
+        assert not pf.supported(
+            FastGaussianRFT(300, 512, Context(seed=2), fut="dct"), X)
+        assert not pf.supported(
+            FastGaussianRFT(64, 128, Context(seed=3)), _X(8, 64))
+
+    def test_plan_m_tile_respects_budget(self):
+        mt = pf.plan_m_tile(4096, 1 << 20)
+        assert mt is not None and mt % 8 == 0
+        assert mt * 4096 * 4 * 8 <= pf._VMEM_BUDGET_BYTES
+        assert pf.plan_m_tile(1 << 22, 128) is None  # absurd NB declines
+
+
+ON_TPU = (pf.available()
+          or os.environ.get("SKYLARK_BATTERY_FORCE") == "1")
+
+
+@pytest.mark.tpu
+@pytest.mark.skipif(not ON_TPU, reason="needs a real TPU backend")
+class TestOnChip:
+    def test_mosaic_compiles_and_matches_host_oracle(self):
+        """The on-chip certification: real Mosaic lowering (the
+        take_along_axis lane gather is the unproven op), compared to
+        the HOST-side explicit chain."""
+        d, s, m = 2048, 2048, 64
+        T = FastGaussianRFT(d, s, Context(seed=21), sigma=2.0)
+        X = _X(m, d, seed=17)
+        got = pf.features_rows(T, X, precision="bf16x3")
+        if got is None and not pf.available():
+            pytest.skip("kernel declined: no TPU pallas backend")
+        assert got is not None, "Mosaic compile failed (see watcher log)"
+        np.testing.assert_allclose(np.asarray(got), _oracle(T, X),
+                                   atol=1e-4, rtol=1e-4)
