@@ -236,7 +236,11 @@ def bench_frft(scale: str):
            "unit": "Mrows/s", "rft_same_config": out["rft"],
            "speedup_vs_rft": round(out["frft"] / out["rft"], 3),
            "path": "xla_chain_jit"}
-    if pf.supported(T_frft, X):
+    if pf.supported(T_frft, X) and pf.features_rows(T_frft, X) is not None:
+        # the probe call above matters: supported() checks the plan, but
+        # Mosaic can still reject at compile time (features_rows then
+        # returns None per its fallback contract) — that must leave the
+        # already-measured XLA numbers intact, not crash the metric
         g = (lambda X: jnp.sum(jnp.abs(
             pf.features_rows(T_frft, X))))
         out["frft_fused_kernel"] = round(n / _time_scalar(g, X) / 1e6, 3)
@@ -294,16 +298,23 @@ def bench_admm(scale: str):
             "unit": "s", "iters": iters}
 
 
-def _prior_best(scale: str, backend: str,
-                exclude: str | None = None) -> dict[str, float]:
-    """Best prior value per metric across results_r*.json (best respects
-    the metric's direction). Only rounds recorded at the SAME scale and
-    backend are comparable — a full-scale TPU round must not gate a
-    small-scale CPU run. ``exclude`` drops the round's OWN save file:
-    on a --resume pass it matches the glob, and comparing a record
-    against itself would overwrite its genuine cross-round ratio with
-    a spurious 1.0."""
+def _prior_bests(scale: str, backend: str,
+                 exclude: str | None = None
+                 ) -> tuple[dict[str, float], dict[str, float]]:
+    """One pass over results_r*.json → (best raw, best canary-normalized)
+    value per metric, best respecting the metric's direction. Only
+    rounds recorded at the SAME scale and backend are comparable — a
+    full-scale TPU round must not gate a small-scale CPU run.
+    ``exclude`` drops the round's OWN save file: on a --resume pass it
+    matches the glob, and comparing a record against itself would
+    overwrite its genuine cross-round ratio with a spurious 1.0.
+
+    Normalization uses each RECORD's own ``canary_s`` (stored at
+    measurement time, r5+) falling back to the file-level canary;
+    records with neither can't be normalized and feed only the raw
+    ratchet."""
     best: dict[str, float] = {}
+    best_norm: dict[str, float] = {}
     for p in glob.glob(os.path.join(HERE, "results_r*.json")):
         if exclude is not None and os.path.abspath(p) == \
                 os.path.abspath(exclude):
@@ -315,6 +326,7 @@ def _prior_best(scale: str, backend: str,
             continue
         if recs.get("scale") != scale or recs.get("backend") != backend:
             continue
+        file_canary = recs.get("canary_s")
         for rec in recs.get("results", []):
             m, v = rec.get("metric"), rec.get("value")
             if m not in DIRECTIONS or not isinstance(v, (int, float)):
@@ -322,39 +334,12 @@ def _prior_best(scale: str, backend: str,
             d = DIRECTIONS[m]
             if m not in best or (v - best[m]) * d > 0:
                 best[m] = v
-    return best
-
-
-def _prior_best_norm(scale: str, backend: str,
-                     exclude: str | None = None) -> dict[str, float]:
-    """Best prior CANARY-NORMALIZED value per metric across rounds whose
-    save file recorded a ``canary_s`` (r5+). Same direction conventions
-    as :func:`_prior_best`; rounds without a canary can't be normalized
-    and are skipped here (the raw ratchet still sees them)."""
-    best: dict[str, float] = {}
-    for p in glob.glob(os.path.join(HERE, "results_r*.json")):
-        if exclude is not None and os.path.abspath(p) == \
-                os.path.abspath(exclude):
-            continue
-        try:
-            with open(p) as fh:
-                recs = json.load(fh)
-        except Exception:
-            continue
-        if recs.get("scale") != scale or recs.get("backend") != backend:
-            continue
-        canary = recs.get("canary_s")
-        if not isinstance(canary, (int, float)) or canary <= 0:
-            continue
-        for rec in recs.get("results", []):
-            m, v = rec.get("metric"), rec.get("value")
-            if m not in DIRECTIONS or not isinstance(v, (int, float)):
-                continue
-            d = DIRECTIONS[m]
-            nv = _canary_norm(v, d, canary)
-            if m not in best or (nv - best[m]) * d > 0:
-                best[m] = nv
-    return best
+            canary = rec.get("canary_s", file_canary)
+            if isinstance(canary, (int, float)) and canary > 0:
+                nv = _canary_norm(v, d, canary)
+                if m not in best_norm or (nv - best_norm[m]) * d > 0:
+                    best_norm[m] = nv
+    return best, best_norm
 
 
 def _existing_results(path: str, scale: str, backend: str) -> dict[str, dict]:
@@ -378,7 +363,21 @@ def _existing_results(path: str, scale: str, backend: str) -> dict[str, dict]:
                  " Pick another round number or move the file aside.")
     if old.get("backend") != backend:
         return {}
-    return {r["metric"]: r for r in old.get("results", []) if r.get("metric")}
+    out = {}
+    for r in old.get("results", []):
+        if not r.get("metric"):
+            continue
+        if (isinstance(r.get("value"), (int, float))
+                and not isinstance(r.get("canary_s"), (int, float))
+                and isinstance(old.get("canary_s"), (int, float))):
+            # pre-per-record-canary save: attach the file-level canary
+            # the values were measured under, so a --resume on a
+            # different-speed day normalizes them correctly (and
+            # _persist doesn't re-stamp them under today's canary)
+            r = dict(r)
+            r["canary_s"] = old["canary_s"]
+        out[r["metric"]] = r
+    return out
 
 
 def main():
@@ -440,10 +439,8 @@ def main():
                                   jax.default_backend())
                 if save_path else {})
     results: dict[str, dict] = dict(existing)
-    prior = _prior_best(args.scale, jax.default_backend(),
-                        exclude=save_path)
-    prior_norm = _prior_best_norm(args.scale, jax.default_backend(),
-                                  exclude=save_path)
+    prior, prior_norm = _prior_bests(args.scale, jax.default_backend(),
+                                     exclude=save_path)
     canary_s = round(canary_seconds(), 6)
     on_cpu = jax.default_backend() == "cpu"
     print(f"# canary_s={canary_s}", file=sys.stderr)
@@ -477,10 +474,17 @@ def main():
                 rec = {"metric": metric, "value": None,
                        "error": f"{type(e).__name__}: {e}"}
             rec["backend"] = jax.default_backend()
+            if isinstance(rec.get("value"), (int, float)):
+                # the canary travels WITH the record: a --resume pass on
+                # a different-speed day must normalize each value by the
+                # canary measured alongside it, not by today's
+                rec["canary_s"] = canary_s
         m, v = rec.get("metric"), rec.get("value")
-        if m in DIRECTIONS and isinstance(v, (int, float)):
+        rec_canary = rec.get("canary_s")
+        if (m in DIRECTIONS and isinstance(v, (int, float))
+                and isinstance(rec_canary, (int, float))):
             rec["canary_normalized"] = round(
-                _canary_norm(v, DIRECTIONS[m], canary_s), 6)
+                _canary_norm(v, DIRECTIONS[m], rec_canary), 6)
         if m in DIRECTIONS and (m in prior or m in prior_norm):
             if isinstance(v, (int, float)):
                 d = DIRECTIONS[m]
@@ -489,8 +493,9 @@ def main():
                     ratio = (v / prior[m]) if d > 0 else (prior[m] / v)
                     rec["vs_best_prior"] = round(ratio, 4)
                     gate_ratio = ratio
-                if m in prior_norm:
-                    nv = _canary_norm(v, d, canary_s)
+                if m in prior_norm and isinstance(rec_canary,
+                                                 (int, float)):
+                    nv = _canary_norm(v, d, rec_canary)
                     nratio = ((nv / prior_norm[m]) if d > 0
                               else (prior_norm[m] / nv))
                     rec["vs_best_prior_canary_norm"] = round(nratio, 4)

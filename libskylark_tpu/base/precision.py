@@ -48,7 +48,14 @@ def ambient_precision_pinned_by_user() -> bool:
     package installed at import — i.e. the user pinned a policy via
     ``jax.default_matmul_precision(...)`` or ``jax.config.update``.
     Throughput paths with their own preferred regime (fut WHT bf16x3)
-    check this before overriding the ambient setting."""
+    check this before overriding the ambient setting.
+
+    Known limit: a pin whose value EQUALS the installed default
+    ("highest" unless SKYLARK_MATMUL_PRECISION changed it) is
+    indistinguishable from the default and is not detected — jax
+    exposes no "explicitly set" bit. Users who need the override
+    suppressed at exactly that value should set
+    ``SKYLARK_MATMUL_PRECISION`` (always honored)."""
     return ambient_matmul_precision() != _INSTALLED_AMBIENT
 
 

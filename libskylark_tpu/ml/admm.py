@@ -42,12 +42,14 @@ from libskylark_tpu.sketch import ROWWISE, SketchTransform
 from libskylark_tpu.utility.timer import get_timer, timers_enabled
 
 # Resume-identity scheme version: bumped whenever the _identity() hash
-# inputs change (scheme 3 = byte-budgeted sample_digest covering all
-# bytes up to 64 MiB, r4 advisor; scheme 2 = fixed 16-row samples;
-# scheme 1, never written under this field, hashed float device
-# statistics). A checkpoint from another scheme refuses with a format
-# diagnosis rather than a misleading "different training run".
-_IDENTITY_SCHEME = 3
+# inputs change (scheme 4 = byte-budgeted sample_digest with the
+# sampled-bytes bound — wide-row operands sample ≥16 rows, not ≥1024;
+# scheme 3 = byte-budgeted with a 1024-row floor, r4 advisor; scheme 2
+# = fixed 16-row samples; scheme 1, never written under this field,
+# hashed float device statistics). A checkpoint from another scheme
+# refuses with a format diagnosis rather than a misleading "different
+# training run".
+_IDENTITY_SCHEME = 4
 
 
 def _partition(num_features: int, num_partitions: int) -> list[int]:

@@ -175,7 +175,31 @@ def features_rows(transform, At, *, interpret: bool = False,
     if mt is None:
         return None
     if precision is None:
-        precision = os.environ.get("SKYLARK_FASTFOOD_PRECISION", "bf16x3")
+        precision = os.environ.get("SKYLARK_FASTFOOD_PRECISION")
+    if precision is None:
+        # honor an explicit user matmul-precision policy exactly like
+        # the XLA chain does (frft._fut_apply / r4 advisor): pins with
+        # a kernel-equivalent regime map to it — "highest"/"float32" →
+        # full-f32 passes, "high"/"bfloat16_3x" → the 3-pass bf16
+        # split (the same arithmetic _dot("bf16x3") implements),
+        # "bfloat16" → single-pass bf16 — anything else (e.g.
+        # "tensorfloat32", "default") has no kernel equivalent, so
+        # decline and let the XLA chain run under the ambient setting
+        from libskylark_tpu.base import precision as bprec
+
+        pinned = (os.environ.get("SKYLARK_MATMUL_PRECISION")
+                  or (bprec.ambient_matmul_precision()
+                      if bprec.ambient_precision_pinned_by_user()
+                      else None))
+        _PIN_REGIME = {"highest": "f32", "float32": "f32",
+                       "high": "bf16x3", "bfloat16_3x": "bf16x3",
+                       "bfloat16": "bf16"}
+        if pinned is None:
+            precision = "bf16x3"
+        elif pinned in _PIN_REGIME:
+            precision = _PIN_REGIME[pinned]
+        else:
+            return None
     dt = At.dtype
     scal = math.sqrt(NB) * T._fut.scale()
 

@@ -184,13 +184,15 @@ def sample_digest(a, rows: int | None = None,
     time): hash ALL bytes whenever the f32 view fits ``byte_budget``
     (64 MiB default — an (n, d) float32 design matrix up to ~16M
     elements is fully covered); above the budget, sample as many evenly
-    strided leading-axis slices as the budget buys, never fewer than
-    1024. ``rows`` overrides the computed sample size when given
-    (bounded callers). Byte equality is exact and identical across
-    TPU/CPU and JAX versions. Coverage limit above the budget
-    (documented trade): content changes confined to unsampled rows are
-    not caught; shape changes and any change touching a sampled row
-    (including permutations that move sampled rows) are."""
+    strided leading-axis slices as the budget buys — the budget bounds
+    SAMPLED BYTES, so wide-row operands gather few rows (never fewer
+    than 16) rather than blowing past it. ``rows`` overrides the
+    computed sample size when given (bounded callers). Byte equality is
+    exact and identical across TPU/CPU and JAX versions. Coverage limit
+    above the budget (documented trade): content changes confined to
+    unsampled rows are not caught; shape changes and any change
+    touching a sampled row (including permutations that move sampled
+    rows) are."""
     import hashlib
 
     import numpy as np
@@ -217,7 +219,10 @@ def sample_digest(a, rows: int | None = None,
         row_bytes = 4 * int(np.prod(
             [int(d) for d in getattr(a, "shape", ())[1:]], dtype=np.int64)
             or 1)
-        rows = max(1024, byte_budget // max(row_bytes, 1))
+        # byte-bounded, never fewer than 16 rows: a (4k, 4M) operand
+        # must not be forced to gather 1024 × 16 MB rows (review
+        # finding — a row-count floor inverts the byte budget)
+        rows = max(16, byte_budget // max(row_bytes, 1))
     idx = sorted(set(
         int(i) for i in np.linspace(0, max(n - 1, 0), num=min(rows, n))))
     idx_arr = np.asarray(idx, dtype=np.intp)  # empty axis: valid no-op
