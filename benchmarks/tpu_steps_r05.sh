@@ -16,7 +16,14 @@
 #      the FRFT-vs-RFT on-chip config, VERDICT #4)
 #   5. 32k^2 rand-SVD north-star chip mode (VERDICT #5)
 
-SWEEP_SPECS=("512 1" "512 0" "1024 1" "1024 0" "256 0")
+# m_tile  pipeline  precision — the r5 sweep adds the 2-pass
+# "bf16gen2" regime (operator defined as the bf16 rounding of the
+# stream; pass-count ceiling 216 GB/s vs bf16x3's 144 — VERDICT #3's
+# "2-pass compensated split" lever, oracle-tested in
+# test_pallas_dense.py::test_bf16gen2_regime_matches_rounded_operator_oracle)
+SWEEP_SPECS=("512 1 bf16x3" "512 0 bf16x3" "512 1 bf16gen2"
+             "512 0 bf16gen2" "1024 1 bf16x3" "1024 0 bf16x3"
+             "1024 1 bf16gen2" "256 0 bf16x3")
 
 have_oracle_recert() { [ -f benchmarks/.tpu_oracle_recert_r05 ]; }
 have_battery() { [ -f benchmarks/.tpu_battery_r05 ]; }
@@ -32,10 +39,10 @@ sys.exit(0 if rec.get("value") is not None else 1)
 EOF
 }
 
-have_sweep_point() {  # have_sweep_point <m_tile> <pipeline 0|1>
-    python - "$1" "$2" <<'EOF'
+have_sweep_point() {  # have_sweep_point <m_tile> <pipeline 0|1> <precision>
+    python - "$1" "$2" "${3:-bf16x3}" <<'EOF'
 import json, sys
-mt, pipe = int(sys.argv[1]), int(sys.argv[2])
+mt, pipe, prec = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 try:
     rows = [json.loads(l)
             for l in open("benchmarks/results_tpu_r05_mtile_sweep.jsonl")
@@ -43,6 +50,7 @@ try:
 except FileNotFoundError:
     sys.exit(1)
 ok = any(r.get("m_tile") == mt and int(r.get("pipeline", 0)) == pipe
+         and r.get("precision", "bf16x3") == prec
          and (r.get("rec") or {}).get("value") is not None for r in rows)
 sys.exit(0 if ok else 1)
 EOF
@@ -98,19 +106,20 @@ EOF
 
 # ---- steps ----------------------------------------------------------------
 
-sweep_point() {  # sweep_point <m_tile> <pipeline 0|1>
-    local mt=$1 pipe=$2 out=/tmp/sweep_r05_${1}_${2}.json t0 wall
-    log "sweep m_tile=$mt pipeline=$pipe"
+sweep_point() {  # sweep_point <m_tile> <pipeline 0|1> <precision>
+    local mt=$1 pipe=$2 prec=${3:-bf16x3} t0 wall
+    local out=/tmp/sweep_r05_${1}_${2}_${prec}.json
+    log "sweep m_tile=$mt pipeline=$pipe precision=$prec"
     t0=$(date +%s)
     timeout 360 env JAX_PLATFORMS=tpu SKYLARK_PALLAS_MTILE=$mt \
-        SKYLARK_PALLAS_PIPELINE=$pipe \
+        SKYLARK_PALLAS_PIPELINE=$pipe SKYLARK_BENCH_PRECISION=$prec \
         SKYLARK_BENCH_DEADLINE=300 SKYLARK_BENCH_SKIP_EXTRAS=1 \
         python bench.py > "$out" 2>/tmp/sweep_r05_err.log
     wall=$(( $(date +%s) - t0 ))
-    python - "$out" "$mt" "$pipe" "$wall" <<'EOF'
+    python - "$out" "$mt" "$pipe" "$prec" "$wall" <<'EOF'
 import datetime, json, sys
-out, mt, pipe, wall = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), \
-    int(sys.argv[4])
+out, mt, pipe, prec, wall = sys.argv[1], int(sys.argv[2]), \
+    int(sys.argv[3]), sys.argv[4], int(sys.argv[5])
 lines = [l for l in open(out) if l.strip()]
 if not lines:
     sys.exit(1)
@@ -118,7 +127,7 @@ rec = json.loads(lines[-1])
 if rec.get("value") is None:
     print("  -> null:", (rec.get("error") or "")[:160])
     sys.exit(1)
-row = {"m_tile": mt, "pipeline": pipe, "wall_s": wall,
+row = {"m_tile": mt, "pipeline": pipe, "precision": prec, "wall_s": wall,
        "captured": datetime.datetime.now(datetime.timezone.utc).isoformat(),
        "rec": rec}
 with open("benchmarks/results_tpu_r05_mtile_sweep.jsonl", "a") as f:
@@ -216,12 +225,13 @@ attempt_all() {
     fi
     for spec in "${SWEEP_SPECS[@]}"; do
         set -- $spec
-        if ! have_sweep_point "$1" "$2" && ! give_up "sweep_$1_$2"; then
-            if sweep_point "$1" "$2"; then
-                commit_artifacts "r05 sweep point m_tile=$1 pipeline=$2"
+        if ! have_sweep_point "$1" "$2" "$3" \
+                && ! give_up "sweep_$1_$2_$3"; then
+            if sweep_point "$1" "$2" "$3"; then
+                commit_artifacts "r05 sweep point m_tile=$1 pipeline=$2 precision=$3"
             else
                 failed=1
-                note_fail "sweep_$1_$2" || return 1
+                note_fail "sweep_$1_$2_$3" || return 1
             fi
         fi
     done
@@ -297,7 +307,7 @@ all_done() {
     have_oracle_recert || return 1
     for spec in "${SWEEP_SPECS[@]}"; do
         set -- $spec
-        have_sweep_point "$1" "$2" || return 1
+        have_sweep_point "$1" "$2" "$3" || return 1
     done
     have_headline || return 1
     have_runall || return 1

@@ -87,8 +87,16 @@ def _accumulate(out_ref, acc, k):
         out_ref[:] += acc
 
 
-def _dot(lhs, rhs, dims, precision):
+def _dot(lhs, rhs, dims, precision, gen_side=1):
     """MXU contraction at the requested precision regime.
+
+    ``gen_side`` names the operand (0=lhs, 1=rhs) that is the GENERATED
+    operator block — only the "bf16gen2" regime uses it: the operator
+    is rounded to bf16 (by that regime's definition the rounded values
+    ARE the operator — exact in every later bf16 pass), so only the
+    data side needs the error-compensated hi/lo split: 2 MXU passes
+    for f32-grade accuracy w.r.t. the rounded operator, vs bf16x3's 3
+    passes for the f32 operator.
 
     ``"bf16x3"`` (the default, set in sketch/params.py): 3-pass
     error-compensated bf16 split (spelled out below; Mosaic has no
@@ -119,6 +127,12 @@ def _dot(lhs, rhs, dims, precision):
 
     if precision == "bf16":
         return bf16_dot(lhs, rhs)
+    if precision == "bf16gen2":
+        if gen_side == 0:
+            rhs_hi = rhs.astype(jnp.bfloat16).astype(jnp.float32)
+            return bf16_dot(lhs, rhs_hi) + bf16_dot(lhs, rhs - rhs_hi)
+        lhs_hi = lhs.astype(jnp.bfloat16).astype(jnp.float32)
+        return bf16_dot(lhs_hi, rhs) + bf16_dot(lhs - lhs_hi, rhs)
     if precision == "bf16x3":
         # Error-compensated 3-pass split. Mosaic has no lowering for
         # Precision.HIGH (verified on v5e: "Unsupported dot precision:
@@ -233,9 +247,11 @@ def _kernel_pipe(dist_kind, s_dim, n_blocks, precision, keys_ref, a_ref,
 
     S_blk = s_buf[k % 2]
     if rowwise:
-        acc = _dot(a_ref[:], S_blk, (((1,), (1,)), ((), ())), precision)
+        acc = _dot(a_ref[:], S_blk, (((1,), (1,)), ((), ())), precision,
+                   gen_side=1)
     else:
-        acc = _dot(S_blk, a_ref[:], (((1,), (0,)), ((), ())), precision)
+        acc = _dot(S_blk, a_ref[:], (((1,), (0,)), ((), ())), precision,
+                   gen_side=0)
 
     @pl.when(k + 1 < n_blocks)
     def _next():
@@ -267,7 +283,8 @@ def _kernel(dist_kind, s_dim, m_tile, precision, keys_ref, a_ref, out_ref,
     threaded by the caller."""
     k = pl.program_id(1)
     S_blk = _resolve_block(dist_kind, s_dim, keys_ref, k, s_scr)
-    acc = _dot(a_ref[:], S_blk, (((1,), (1,)), ((), ())), precision)
+    acc = _dot(a_ref[:], S_blk, (((1,), (1,)), ((), ())), precision,
+               gen_side=1)
     _accumulate(out_ref, acc, k)
     if epilogue is not None:
         _apply_epilogue(out_ref, epilogue, k, n_blocks)
@@ -287,7 +304,8 @@ def _kernel_cw(dist_kind, s_dim, m_tile, precision, keys_ref, a_ref, out_ref,
     """Columnwise: out_tile += S_blk @ A_blk (same precision regime)."""
     k = pl.program_id(1)
     S_blk = _resolve_block(dist_kind, s_dim, keys_ref, k, s_scr)
-    acc = _dot(S_blk, a_ref[:], (((1,), (0,)), ((), ())), precision)
+    acc = _dot(S_blk, a_ref[:], (((1,), (0,)), ((), ())), precision,
+               gen_side=0)
     _accumulate(out_ref, acc, k)
 
 
