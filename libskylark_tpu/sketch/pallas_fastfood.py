@@ -65,11 +65,21 @@ def _wht2(W, Ha, Hb, mt: int, a: int, b: int, precision: str):
     """Ha·X·Hb over the (a, b)-folded minor axis of W (mt, a·b): two 2-D
     MXU dots with the fold transposed between them (math identical to
     fut._wht_matmul's einsum; exact-arithmetic wise both are ±1-weighted
-    f32 sums)."""
+    f32 sums).
+
+    The Hadamard operand is ±1 — EXACT in bfloat16, so its lo term is
+    identically zero and bf16x3's middle pass (X_hi·H_lo) contributes
+    exact zeros: the 2-pass split with the H side as the "generated"
+    operand is bit-identical to bf16x3 here at 2/3 the MXU passes.
+    ``_dot("bf16gen2", gen_side=1)`` is exactly that split."""
+    if precision == "bf16x3":
+        precision = "bf16gen2"  # bit-identical for ±1 rhs, one less pass
     dims = (((1,), (0,)), ((), ()))
-    Z = _dot(W.reshape(mt * a, b), Hb, dims, precision).reshape(mt, a, b)
+    Z = _dot(W.reshape(mt * a, b), Hb, dims, precision,
+             gen_side=1).reshape(mt, a, b)
     Zt = jnp.swapaxes(Z, 1, 2)
-    Y = _dot(Zt.reshape(mt * b, a), Ha, dims, precision).reshape(mt, b, a)
+    Y = _dot(Zt.reshape(mt * b, a), Ha, dims, precision,
+             gen_side=1).reshape(mt, b, a)
     return jnp.swapaxes(Y, 1, 2).reshape(mt, a * b)
 
 
