@@ -83,10 +83,50 @@ def _wht2(W, Ha, Hb, mt: int, a: int, b: int, precision: str):
     return jnp.swapaxes(Y, 1, 2).reshape(mt, a * b)
 
 
+def _stage_pre(x, bdiag, Ha, Hb, mt, NB, precision):
+    """Everything before the Π gather: B⊙x → WHT. Shared verbatim by
+    the fused kernel and the split variant's stage-1 kernel — one
+    definition so the two variants cannot drift apart."""
+    a, b = _wht_split(NB)
+    return _wht2(bdiag * x, Ha, Hb, mt, a, b, precision)
+
+
+def _stage_post(W, gdiag, smdiag, shift, Ha, Hb, mt, NB, precision,
+                scale):
+    """Everything after the Π gather: (scal·G)⊙ → WHT → (scal·Sm)⊙ →
+    scale·cos(·+shifts). Shared by both variants like _stage_pre."""
+    a, b = _wht_split(NB)
+    W = _wht2(gdiag * W, Ha, Hb, mt, a, b, precision)
+    return scale * jnp.cos(smdiag * W + shift)
+
+
+def _kernel_pre(mt, NB, precision,
+                x_ref, bdiag_ref, ha_ref, hb_ref, out_ref):
+    """Split-variant stage-1 kernel. Exists because the fused kernel's
+    in-kernel lane gather is the one op without certified Mosaic
+    precedent: if Mosaic rejects it, the dispatch falls back to this
+    two-kernel pipeline with the gather done by XLA between the calls —
+    still ~3× less HBM traffic than the all-XLA chain (~1.6 GB modeled
+    vs 4.83 GB at the flagship config)."""
+    out_ref[:] = _stage_pre(x_ref[:], bdiag_ref[:], ha_ref[:], hb_ref[:],
+                            mt, NB, precision).astype(out_ref.dtype)[None]
+
+
+def _kernel_post(mt, NB, precision, scale,
+                 w_ref, gdiag_ref, smdiag_ref, shift_ref,
+                 ha_ref, hb_ref, out_ref):
+    """Split-variant stage-2 kernel."""
+    out_ref[:] = _stage_post(
+        w_ref[0], gdiag_ref[:], smdiag_ref[:], shift_ref[:],
+        ha_ref[:], hb_ref[:], mt, NB, precision, scale,
+    ).astype(out_ref.dtype)[None]
+
+
 def _kernel(mt, NB, precision, scale,
             x_ref, bdiag_ref, perm_ref, gdiag_ref, smdiag_ref, shift_ref,
             ha_ref, hb_ref, out_ref):
-    """One (block, m-tile) grid step: the full chain in VMEM.
+    """One (block, m-tile) grid step: the full chain in VMEM, composed
+    from the SAME stage helpers the split variant runs.
 
     Refs: x (mt, NB) padded input rows; bdiag/gdiag/smdiag/shift
     (1, NB) this block's diagonals (g/sm pre-scaled by √NB·fut.scale);
@@ -94,16 +134,13 @@ def _kernel(mt, NB, precision, scale,
     factors (pallas requires trace constants as inputs); out (mt, NB)
     features before block-order interleave/truncation (done by the
     caller in XLA)."""
-    a, b = _wht_split(NB)
     Ha, Hb = ha_ref[:], hb_ref[:]
-    W = bdiag_ref[:] * x_ref[:]
-    W = _wht2(W, Ha, Hb, mt, a, b, precision)
+    W = _stage_pre(x_ref[:], bdiag_ref[:], Ha, Hb, mt, NB, precision)
     W = jnp.take_along_axis(W, perm_ref[:], axis=1)
-    W = gdiag_ref[:] * W
-    W = _wht2(W, Ha, Hb, mt, a, b, precision)
-    W = smdiag_ref[:] * W
-    out_ref[:] = (scale * jnp.cos(W + shift_ref[:])).astype(
-        out_ref.dtype)[None]
+    out_ref[:] = _stage_post(
+        W, gdiag_ref[:], smdiag_ref[:], shift_ref[:], Ha, Hb,
+        mt, NB, precision, scale,
+    ).astype(out_ref.dtype)[None]
 
 
 def plan_m_tile(NB: int, m: int) -> int | None:
@@ -145,6 +182,43 @@ def _launch(X, bdiag, perms, gdiag, smdiag, shifts, mt, NB, nb,
     )(X, bdiag, perms, gdiag, smdiag, shifts, Ha, Hb)
 
 
+@functools.partial(jax.jit, static_argnames=("mt", "NB", "nb",
+                                             "precision", "scale",
+                                             "interpret"))
+def _launch_split(X, bdiag, perms, gdiag, smdiag, shifts, mt, NB, nb,
+                  precision, scale, interpret):
+    """Two-kernel pipeline: K1 (B⊙ + WHT) → XLA Π gather → K2
+    (G⊙ + WHT + Sm⊙ + cos). The gather runs exactly as in the XLA
+    chain; everything else stays in VMEM-resident kernels."""
+    n_tiles = X.shape[0] // mt
+    a, b = _wht_split(NB)
+    Ha = jnp.asarray(_hadamard_np(a), jnp.float32)
+    Hb = jnp.asarray(_hadamard_np(b), jnp.float32)
+    diag_spec = pl.BlockSpec((1, NB), lambda blk, t: (blk, 0))
+    ha_spec = pl.BlockSpec((a, a), lambda blk, t: (0, 0))
+    hb_spec = pl.BlockSpec((b, b), lambda blk, t: (0, 0))
+    out3 = pl.BlockSpec((1, mt, NB), lambda blk, t: (blk, t, 0))
+    W1 = pl.pallas_call(
+        functools.partial(_kernel_pre, mt, NB, precision),
+        grid=(nb, n_tiles),
+        in_specs=[pl.BlockSpec((mt, NB), lambda blk, t: (t, 0)),
+                  diag_spec, ha_spec, hb_spec],
+        out_specs=out3,
+        out_shape=jax.ShapeDtypeStruct((nb, X.shape[0], NB), X.dtype),
+        interpret=interpret,
+    )(X, bdiag, Ha, Hb)
+    Wg = jnp.take_along_axis(W1, perms[:, None, :], axis=-1)
+    return pl.pallas_call(
+        functools.partial(_kernel_post, mt, NB, precision, scale),
+        grid=(nb, n_tiles),
+        in_specs=[out3, diag_spec, diag_spec, diag_spec,
+                  ha_spec, hb_spec],
+        out_specs=out3,
+        out_shape=jax.ShapeDtypeStruct((nb, X.shape[0], NB), X.dtype),
+        interpret=interpret,
+    )(Wg, gdiag, smdiag, shifts, Ha, Hb)
+
+
 def supported(transform, A) -> bool:
     """Whether the fused kernel may serve this FastRFT apply: WHT core
     in its MXU-matmul regime, f32 single-device eager input (sharded
@@ -167,15 +241,32 @@ def supported(transform, A) -> bool:
     return plan_m_tile(transform._NB, int(A.shape[0])) is not None
 
 
+# which launcher served the last successful features_rows call
+# ("fused" | "split") — diagnostics for the on-chip certification and
+# the bench record; never consulted for dispatch decisions
+last_served_variant: str | None = None
+
+
 def features_rows(transform, At, *, interpret: bool = False,
-                  precision: str | None = None):
+                  precision: str | None = None,
+                  variant: str = "auto"):
     """The (m, S) Fastfood feature map for row-major input At (m, N)
     through the fused kernel, or None when the kernel declines or fails
     (caller falls back to the XLA chain — mirror of
     pallas_dense.rowwise_apply's contract). ``interpret`` runs the
-    pallas interpreter (CPU-testable exact semantics)."""
+    pallas interpreter (CPU-testable exact semantics).
+
+    ``variant``: "fused" (single kernel, in-kernel Π gather), "split"
+    (two kernels around an XLA gather — the fallback if Mosaic rejects
+    the in-kernel gather), or "auto" (fused, then split on failure;
+    under ``interpret`` a fused failure re-raises instead — the
+    interpreter has no Mosaic to reject, so any exception there is a
+    plain bug that must not be masked by the fallback)."""
     import math
 
+    if variant not in ("auto", "fused", "split"):
+        raise ValueError(
+            f"variant must be 'auto', 'fused' or 'split', got {variant!r}")
     if not interpret and not supported(transform, At):
         return None
     T = transform
@@ -227,13 +318,26 @@ def features_rows(transform, At, *, interpret: bool = False,
     # past S are computed then sliced off — pad their shifts with zeros
     sh = jnp.pad(sh, (0, nb * NB - T._S)).reshape(nb, NB)
 
-    try:
-        F = _launch(Ap, bdiag, perms, gdiag, smdiag, sh,
-                    mt=mt, NB=NB, nb=nb, precision=precision,
-                    scale=float(T.scale), interpret=interpret)
-    except Exception:
-        if interpret:  # test mode: surface the real failure
-            raise
+    global last_served_variant
+    launchers = {"fused": (_launch,), "split": (_launch_split,),
+                 "auto": (_launch, _launch_split)}[variant]
+    F = None
+    for launch in launchers:
+        try:
+            F = launch(Ap, bdiag, perms, gdiag, smdiag, sh,
+                       mt=mt, NB=NB, nb=nb, precision=precision,
+                       scale=float(T.scale), interpret=interpret)
+            last_served_variant = (
+                "fused" if launch is _launch else "split")
+            break
+        except Exception:
+            if interpret:
+                # the interpreter has no Mosaic rejection to tolerate:
+                # an exception here is a plain bug — surface it rather
+                # than silently degrading the oracle to the other
+                # variant (review finding)
+                raise
+    if F is None:
         return None
     # (nb, m_p, NB) → block-major feature order, un-pad, truncate —
     # identical to FastRFT._features_rows' epilogue
