@@ -144,7 +144,10 @@ class FastRFT(SketchTransform):
         return self.scale * jnp.cos(W + self.shifts(dt)[None, :])
 
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
-        return self._features_rows(A.T).T
+        # route through the rowwise dispatch so the fused kernel serves
+        # this orientation too (the transpose feeds the kernel's
+        # row-major tile layout either way)
+        return self._apply_rowwise(A.T).T
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
         # fused single-kernel chain on TPU (one HBM read of A, one write
