@@ -129,8 +129,12 @@ def bench_jlt(scale: str):
     else:
         gbps, secs, plan = bench.run(m=1024, n=1024, s=128, repeats=2,
                                      precision=precision)
+    # plan_id top-level: every measurement names the plan that served it
+    # (bench.run also feeds kernel measurements back into the tune/
+    # plan cache — see bench._record_plan_measurement)
     return {"metric": "jlt_sketch_apply_GBps", "value": round(gbps, 3),
-            "unit": "GB/s", "precision": precision, "plan": plan}
+            "unit": "GB/s", "precision": precision, "plan": plan,
+            "plan_id": plan.get("plan_id")}
 
 
 def _sparse_input(scale: str):

@@ -193,9 +193,12 @@ attempt_all() {
             tail -10 /tmp/oracle_recert_r05.log
         } >> benchmarks/tpu_validation_r05.txt
         if [ $rc -eq 0 ]; then
-            # stamp carries the certified kernel's content hash so
-            # bench.py's oracle_fresh survives git checkouts (no mtimes)
-            echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) kernel_sha256=$(sha256sum libskylark_tpu/sketch/pallas_dense.py | cut -d' ' -f1)" \
+            # stamp carries the certified kernel CLOSURE's content hash
+            # (pallas_dense + params + randgen; `bench.py --stamp` is
+            # the single source of the format) so bench.py's
+            # oracle_fresh survives git checkouts (no mtimes) and a
+            # post-certification knob/stream change can't ride it
+            echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) $(python bench.py --stamp)" \
                 > benchmarks/.tpu_oracle_recert_r05
             commit_artifacts "r05 on-chip oracle re-certification"
         else

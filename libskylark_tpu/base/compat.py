@@ -1,0 +1,61 @@
+"""Version-portability shims for jax APIs that moved between releases.
+
+One import seam per moved symbol, so every caller in the package (and in
+tests/) tracks a single definition instead of each picking its own jax
+version to support. The rule for adding a shim: prefer the NEWEST public
+location first, fall back to where older installed versions keep it, and
+raise the original ImportError only when no location works — the package
+must import (and its CPU test tier must collect) on every jax the image
+ships.
+
+``shard_map``: public top-level ``jax.shard_map`` from jax 0.6; on the
+0.4.x line it lives in ``jax.experimental.shard_map``. The replication
+checker was also renamed across that move (``check_rep`` →
+``check_vma``): the wrapper translates whichever spelling the call site
+used into the one the installed jax accepts.
+
+``pvary``: the varying-manual-axes annotation only exists on jax lines
+that HAVE the vma system (as ``lax.pcast``/``lax.pvary``); where it
+doesn't exist the annotation is meaningless and the shim is identity.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+from jax import lax
+
+try:  # jax >= 0.6: public top-level export
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # 0.4.x/0.5.x: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+_SHARD_MAP_PARAMS = frozenset(
+    inspect.signature(_shard_map_impl).parameters)
+
+
+def shard_map(f, **kwargs):
+    """``shard_map`` accepting either replication-checker spelling
+    (``check_vma``/``check_rep``) on any supported jax."""
+    for ours, theirs in (("check_vma", "check_rep"),
+                         ("check_rep", "check_vma")):
+        if ours in kwargs and ours not in _SHARD_MAP_PARAMS:
+            if theirs in _SHARD_MAP_PARAMS:
+                kwargs[theirs] = kwargs.pop(ours)
+            else:
+                kwargs.pop(ours)
+    return _shard_map_impl(f, **kwargs)
+
+
+def pvary(x, axis_name):
+    """Mark ``x`` device-varying over ``axis_name`` inside a manual
+    (shard_map) region — identity on jax lines without the vma type
+    system, where every value is already implicitly varying."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axis_name)
+    return x
+
+
+__all__ = ["pvary", "shard_map"]

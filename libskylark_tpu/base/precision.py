@@ -31,15 +31,36 @@ _SOLVER_PRECISION = "highest"
 _INSTALLED_AMBIENT: str | None = None
 
 
+_warned_private_state_moved = False
+
+
 def ambient_matmul_precision() -> str | None:
     """The effective ambient matmul precision, context-aware: inside a
     user's ``jax.default_matmul_precision(...)`` block this reads the
-    context value, not just the global config."""
+    context value, not just the global config. When the private
+    ``jax._src.config`` State API has moved (a jax upgrade), this
+    silently degrades to the GLOBAL config — context pins become
+    invisible to the pinned-by-user detection — so the first fallback
+    emits a one-time warning instead of hiding the capability loss."""
+    global _warned_private_state_moved
     try:
         from jax._src.config import default_matmul_precision
 
         return default_matmul_precision.value
     except Exception:  # private State API moved — fall back to the global
+        if not _warned_private_state_moved:
+            _warned_private_state_moved = True
+            import warnings
+
+            warnings.warn(
+                "jax's private default_matmul_precision state moved in "
+                f"this jax ({jax.__version__}): context-scoped "
+                "jax.default_matmul_precision(...) pins are no longer "
+                "detectable and only the global config is honored — "
+                "throughput paths may override a context pin. Pin via "
+                "SKYLARK_MATMUL_PRECISION or jax.config.update to be "
+                "honored unconditionally.",
+                RuntimeWarning, stacklevel=2)
         return jax.config.jax_default_matmul_precision
 
 

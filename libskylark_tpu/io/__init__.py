@@ -25,6 +25,7 @@ from libskylark_tpu.io.streaming import StreamingCWT
 from libskylark_tpu.io.chunked import (
     iter_libsvm_batches,
     iter_hdf5_batches,
+    prefetch_batches,
     read_libsvm_sharded,
     scan_libsvm_dims,
     stream_sketch_libsvm,
@@ -43,6 +44,7 @@ __all__ = [
     "StreamingCWT",
     "iter_libsvm_batches",
     "iter_hdf5_batches",
+    "prefetch_batches",
     "read_libsvm_sharded",
     "scan_libsvm_dims",
     "stream_sketch_libsvm",

@@ -24,6 +24,9 @@ arrival-order streaming sketch, ref: python-skylark/skylark/streaming.py).
 
 from __future__ import annotations
 
+import os
+import queue
+import threading
 from typing import Iterable, Iterator, Optional, Tuple, Union
 
 import jax
@@ -33,6 +36,107 @@ import numpy as np
 from libskylark_tpu.base import errors
 
 ROWS = "rows"
+
+# Default prefetch depth for the double-buffered streaming overlap:
+# 2 slots = the classic double buffer (one batch on device computing,
+# the next one parsing/transferring). SKYLARK_STREAM_PREFETCH sets the
+# depth; 0 disables the overlap everywhere it defaults on.
+_PREFETCH_DEPTH_DEFAULT = 2
+
+
+def default_prefetch_depth() -> int:
+    try:
+        d = int(os.environ.get("SKYLARK_STREAM_PREFETCH",
+                               _PREFETCH_DEPTH_DEFAULT))
+    except ValueError:
+        return _PREFETCH_DEPTH_DEFAULT
+    return max(0, d)
+
+
+class _PrefetchDone:
+    """Sentinel + terminal state of a prefetch worker."""
+
+    def __init__(self):
+        self.exc: Optional[BaseException] = None
+
+
+def prefetch_batches(
+    batches: Iterable[Tuple],
+    depth: Optional[int] = None,
+    to_device: bool = True,
+) -> Iterator[Tuple]:
+    """Double-buffered minibatch prefetch: a background thread pulls up
+    to ``depth`` batches ahead of the consumer, so the host-side parse
+    (and, with ``to_device``, the host→device transfer of the leading
+    array — jax dispatch makes the copy asynchronous) overlaps with the
+    consumer's device compute on the CURRENT batch.
+
+    Yields exactly the input tuples in exactly the input order, with the
+    first element ``jax.device_put`` when ``to_device`` (bit-exact: a
+    device transfer moves bytes, it never rounds) — the
+    layout-independence invariant is untouched because nothing about the
+    VALUES or their processing order changes, only WHEN they move.
+
+    ``depth=0`` (or ``None`` with SKYLARK_STREAM_PREFETCH=0) is the
+    synchronous passthrough. A producer exception is re-raised at the
+    consumer's position, after the batches that preceded it. If the
+    consumer abandons the iterator early (``close()``/GC), the worker is
+    told to stop and drops its queue."""
+    if depth is None:
+        depth = default_prefetch_depth()
+    if depth <= 0:
+        for item in batches:
+            if to_device and isinstance(item, tuple) and item:
+                item = (jax.device_put(item[0]),) + item[1:]
+            yield item
+        return
+
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    done = _PrefetchDone()
+
+    def _put(obj) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(obj, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker():
+        try:
+            for item in batches:
+                if to_device and isinstance(item, tuple) and item:
+                    # async H2D of the array the sketch consumes; labels
+                    # and metadata stay host-side
+                    item = (jax.device_put(item[0]),) + item[1:]
+                if not _put(item):
+                    return
+        except BaseException as e:  # re-raised at the consumer
+            done.exc = e
+        finally:
+            _put(done)
+
+    t = threading.Thread(target=_worker, name="skylark-prefetch",
+                         daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is done:
+                if done.exc is not None:
+                    raise done.exc
+                return
+            yield item
+    finally:
+        stop.set()
+        # unblock a worker stuck on a full queue, then let it exit
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
 
 
 def _line_iter(source) -> Iterator[str]:
@@ -244,8 +348,13 @@ def read_libsvm_sharded(
     filled = 0
     si = 0
     consumed = 0
-    for Xb, Yb in iter_libsvm_batches(
-        source, batch_rows, d=d, max_n=max_n, dtype=dtype
+    # parse-ahead only (to_device=False): placement here is per-owner
+    # device, so the H2D half of the overlap is the place() calls below;
+    # the background thread keeps the line parser off their critical path
+    for Xb, Yb in prefetch_batches(
+        iter_libsvm_batches(source, batch_rows, d=d, max_n=max_n,
+                            dtype=dtype),
+        to_device=False,
     ):
         Yb = Yb.reshape(len(Xb), -1)
         consumed += len(Xb)
@@ -304,10 +413,13 @@ def stream_sketch_libsvm(
     max_n: int = -1,
     checkpoint=None,
     checkpoint_every: int = 0,
+    prefetch_depth: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Sketch a libsvm source down to ``s`` rows in bounded memory:
     chunked parse → :class:`StreamingCWT`. Equals the one-shot
     ``CWT.apply`` on the full file (counter-stream order independence).
+    ``prefetch_depth`` tunes the double-buffered parse/transfer overlap
+    (see :meth:`StreamingCWT.sketch`; default SKYLARK_STREAM_PREFETCH).
 
     Needs a re-readable path (one pass to size the streams, one to
     sketch); for a one-shot stream, run :func:`scan_libsvm_dims` on a
@@ -327,4 +439,5 @@ def stream_sketch_libsvm(
     batches = iter_libsvm_batches(source, batch_rows, d=d, max_n=max_n)
     return sk.sketch(batches, num_classes=num_classes,
                      checkpoint=checkpoint,
-                     checkpoint_every=checkpoint_every)
+                     checkpoint_every=checkpoint_every,
+                     prefetch_depth=prefetch_depth)

@@ -22,6 +22,18 @@ from libskylark_tpu.ml.coding import dummy_coding
 from libskylark_tpu.sketch.hash import CWT
 
 
+# Checkpoint digest-scheme version — the ml/admm.py ``_IDENTITY_SCHEME``
+# discipline applied to the streaming checkpoints: bumped whenever the
+# bytes feeding the resume digests change meaning. Scheme 2 = sha256
+# config identity + byte-budgeted ``sample_digest`` batch-0 hash (the
+# current format). Scheme 1, never written under this field, fingerprinted
+# batch 0 with a float device statistic. A checkpoint recording a
+# DIFFERENT scheme refuses with a format diagnosis — without the tag it
+# would fail the digest comparison and misdiagnose as "different stream"
+# (ADVICE r5).
+_DIGEST_SCHEME = 2
+
+
 class StreamingCWT:
     """Sketch a stream of row-minibatches down to ``s`` rows.
 
@@ -66,11 +78,22 @@ class StreamingCWT:
         num_classes: int = 0,
         checkpoint=None,
         checkpoint_every: int = 0,
+        prefetch_depth: Optional[int] = None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Consume ``(X, Y)`` minibatches; return ``(SX, SY)``.
 
         ``num_classes > 2`` dummy-codes labels to ±1 one-vs-all before
         sketching (ref: streaming.py:13-17 + ml/utils dummycode).
+
+        ``prefetch_depth`` enables the double-buffered streaming
+        overlap (:func:`libskylark_tpu.io.chunked.prefetch_batches`): a
+        background thread parses batch k+1 and starts its host→device
+        transfer while batch k's scatter-add computes on device.
+        Defaults to SKYLARK_STREAM_PREFETCH (2; 0 disables). The result
+        is BIT-EQUAL to the unprefetched pass — and to the one-shot
+        ``CWT.apply`` on the concatenated data (the layout-independence
+        invariant): prefetch moves bytes earlier, it never changes a
+        value or the accumulation order.
 
         ``checkpoint`` (directory path or
         :class:`~libskylark_tpu.utility.TrainCheckpointer`) persists the
@@ -116,6 +139,16 @@ class StreamingCWT:
         try:
             if ckpt is not None and ckpt.latest_step() is not None:
                 step0, meta = ckpt.metadata()
+                scheme = meta.get("digest_scheme")
+                if scheme is not None and scheme != _DIGEST_SCHEME:
+                    # a digest under another scheme is incomparable —
+                    # diagnose the FORMAT, don't let the comparison
+                    # below misread it as a different stream
+                    raise errors.InvalidParametersError(
+                        f"checkpoint was written under digest scheme "
+                        f"{scheme}; this build uses {_DIGEST_SCHEME} — "
+                        "stream identity cannot be compared across "
+                        "schemes; re-ingest from scratch")
                 if meta.get("identity") != ident:
                     raise errors.InvalidParametersError(
                         "checkpoint belongs to a different streaming "
@@ -139,10 +172,20 @@ class StreamingCWT:
                     return self._finish(SX, SY)
             row0 = resume_rows
 
+            from libskylark_tpu.io.chunked import prefetch_batches
+
             batches_seen = 0
             rows_scanned = 0
-            for X, Y in batches:
-                nb = np.asarray(X).shape[0]
+            # on a resume, the fast-forward below discards every
+            # already-folded-in batch — prefetching must not pay a
+            # host→device transfer per discarded batch, so the worker
+            # stays parse-ahead-only (the in-loop jnp.asarray moves the
+            # kept batches); a fresh pass gets the full H2D overlap
+            for X, Y in prefetch_batches(batches, depth=prefetch_depth,
+                                         to_device=resume_rows == 0):
+                # np.shape reads the shape attribute — no device sync
+                # on a prefetched (device-resident) batch
+                nb = int(np.shape(X)[0])
                 if rows_scanned == 0 and (ckpt is not None):
                     b0 = self._batch_hash(X)
                     # exact digest equality (NaN bytes compare like any
@@ -175,12 +218,18 @@ class StreamingCWT:
                         Yb = Yb[:, None]
                 h = jnp.asarray(h_all[row0:row0 + nb])
                 v = jnp.asarray(v_all[row0:row0 + nb])
-                SXb = jnp.zeros((self._s, X.shape[1]), X.dtype).at[h].add(
-                    v[:, None] * X)
-                SYb = jnp.zeros((self._s, Yb.shape[1]), Yb.dtype).at[h].add(
-                    v[:, None] * Yb)
-                SX = SXb if SX is None else SX + SXb
-                SY = SYb if SY is None else SY + SYb
+                if SX is None:
+                    SX = jnp.zeros((self._s, X.shape[1]), X.dtype)
+                    SY = jnp.zeros((self._s, Yb.shape[1]), Yb.dtype)
+                # scatter each batch into the CARRIED accumulator (not
+                # zeros-then-sum): per bucket, updates land in row order
+                # exactly as the one-shot CWT.apply scatter applies them,
+                # so the streamed result is BIT-EQUAL to the one-shot
+                # sketch of the concatenated data — the layout-
+                # independence invariant at full strength (partial sums
+                # per batch would reassociate the f32 additions)
+                SX = SX.at[h].add(v[:, None] * X)
+                SY = SY.at[h].add(v[:, None] * Yb)
                 row0 += nb
                 batches_seen += 1
                 if ckpt is not None and checkpoint_every > 0 \
@@ -220,7 +269,8 @@ class StreamingCWT:
     def _save(ckpt, ident, rows, SX, SY, b0) -> None:
         ckpt.save(int(rows), {"SX": SX, "SY": SY},
                   {"identity": ident, "rows": int(rows),
-                   "batch0_hash": b0})
+                   "batch0_hash": b0,
+                   "digest_scheme": _DIGEST_SCHEME})
 
     @staticmethod
     def _finish(SX, SY):

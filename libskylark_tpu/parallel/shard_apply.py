@@ -25,10 +25,11 @@ in ``jax.jit`` at the call site like any other apply.
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from libskylark_tpu.base import errors
+from libskylark_tpu.base.compat import pvary, shard_map
 from libskylark_tpu.parallel.mesh import ROWS
 from libskylark_tpu.sketch.dense import BLOCK_COLS, DenseTransform
 
@@ -109,11 +110,8 @@ def _pipeline(T, A, mesh: Mesh, axis: str, seq_axis: int,
             out_shape = ((s_dim, A_loc.shape[1]) if columnwise
                          else (A_loc.shape[0], s_dim))
             # the carry must be marked device-varying to match the body
-            zero = jnp.zeros(out_shape, A_loc.dtype)
-            if hasattr(lax, "pcast"):
-                acc0 = lax.pcast(zero, axis, to="varying")
-            else:  # older jax
-                acc0 = lax.pvary(zero, axis)
+            # (identity on jax lines without the vma system — compat)
+            acc0 = pvary(jnp.zeros(out_shape, A_loc.dtype), axis)
             part = lax.fori_loop(0, blocks_per_shard, body, acc0)
         return lax.psum(part, axis)
 

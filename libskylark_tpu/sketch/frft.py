@@ -153,7 +153,11 @@ class FastRFT(SketchTransform):
         # fused single-kernel chain on TPU (one HBM read of A, one write
         # of the features — the XLA chain re-touches the intermediate
         # ~9×; BASELINE.md crossover analysis); any decline or Mosaic
-        # failure falls back to the XLA chain below
+        # failure falls back to the XLA chain below. features_rows
+        # consults the autotuner plan cache (libskylark_tpu/tune/)
+        # first: a cached plan picks the fused/split variant and regime,
+        # or certifies the XLA chain for this workload (it then declines
+        # and the chain below serves).
         from libskylark_tpu.sketch import params as sketch_params
 
         if sketch_params.get_use_pallas():

@@ -29,6 +29,10 @@ try:  # import guarded so non-TPU environments can import the module
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    # renamed TPUCompilerParams -> CompilerParams across jax releases
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams")
+
     _HAVE_PALLAS = True
 except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
@@ -262,11 +266,18 @@ def _kernel_pipe(dist_kind, s_dim, n_blocks, precision, keys_ref, a_ref,
         _apply_epilogue(out_ref, epilogue, k, n_blocks)
 
 
-def _pipeline_enabled() -> bool:
-    # read at TRACE time: _fused_call's jit cache is keyed by shapes and
-    # static args only, so toggle the env before the first call of a
-    # given shape (the bench A/Bs in separate processes)
-    return os.environ.get("SKYLARK_PALLAS_PIPELINE") == "1"
+def _pipeline_env() -> bool | None:
+    """Tri-state SKYLARK_PALLAS_PIPELINE: None when unset (a cached
+    plan may decide), True for "1", False for any other set value — an
+    EXPLICITLY set env must beat a cached plan in either direction
+    (=0 is the escape hatch when a cached pipelined plan misbehaves).
+    Read at TRACE time: _fused_call's jit cache is keyed by shapes and
+    static args only, so toggle the env before the first call of a
+    given shape (the bench A/Bs in separate processes)."""
+    v = os.environ.get("SKYLARK_PALLAS_PIPELINE")
+    if v is None:
+        return None
+    return v == "1"
 
 
 def _kernel(dist_kind, s_dim, m_tile, precision, keys_ref, a_ref, out_ref,
@@ -331,24 +342,31 @@ def _scratch(s_dim: int, n: int, m: int, m_tile: int):
     return [pltpu.VMEM((s_dim, n_blocks * BLOCK_COLS), jnp.float32)]
 
 
-def _pipe_fits(scratch, s_dim: int, m_tile: int) -> bool:
+def _pipe_fits(scratch, s_dim: int, m_tile: int,
+               pipeline: bool | None = None) -> bool:
     """Pipelined-generation selection predicate — the SINGLE source of
     truth shared by the kernel call sites (via :func:`_select_pipe`) and
     :func:`effective_plan`, so the reported plan can't drift from the
     executed one: engage when the operator-cache scratch doesn't apply
-    (the big-operator regime), SKYLARK_PALLAS_PIPELINE=1, and the double
+    (the big-operator regime), the pipeline is requested — an
+    explicitly set SKYLARK_PALLAS_PIPELINE wins in either direction,
+    else a cached plan's ``pipeline`` flag decides — and the double
     buffer fits the same VMEM budget _qualify planned against."""
+    env = _pipeline_env()
+    enabled = env if env is not None else bool(pipeline)
     pipe_bytes = 2 * s_dim * BLOCK_COLS * 4
-    return (not scratch and _pipeline_enabled()
+    return (not scratch and enabled
             and _vmem_estimate(m_tile, s_dim, pipe_bytes)
             <= _VMEM_BUDGET_BYTES)
 
 
-def _select_pipe(kern, pipe_kern, scratch, s_dim: int, m_tile: int):
+def _select_pipe(kern, pipe_kern, scratch, s_dim: int, m_tile: int,
+                 pipeline: bool | None = None):
     """Swap in the pipelined kernel + generation double buffer when
     :func:`_pipe_fits` says so — over budget, stay on the plain kernel
     (no fallback seam exists on the shard_map path)."""
-    if pipe_kern is not None and _pipe_fits(scratch, s_dim, m_tile):
+    if pipe_kern is not None and _pipe_fits(scratch, s_dim, m_tile,
+                                            pipeline):
         return pipe_kern, [pltpu.VMEM((2, s_dim, BLOCK_COLS), jnp.float32)]
     return kern, scratch
 
@@ -357,7 +375,7 @@ def _grid_params(scratch):
     """dimension_semantics for pallas_call: the operator cache needs
     strictly sequential grid order (the i==0 sweep fills it) — no megacore
     splitting over the m-tile dimension."""
-    return pltpu.CompilerParams(
+    return _CompilerParams(
         dimension_semantics=(
             ("arbitrary", "arbitrary") if scratch
             else ("parallel", "arbitrary")
@@ -366,7 +384,7 @@ def _grid_params(scratch):
 
 
 def _rowwise_pallas_call(A, keys, extra_operands, kern, *, s_dim, m_tile,
-                         interpret, pipe_kern=None):
+                         interpret, pipe_kern=None, pipeline=None):
     """Shared rowwise pallas_call plumbing: grid, key-table SMEM spec,
     A-tile spec, accumulator out spec, operator scratch, compiler params.
     ``extra_operands`` are (1, s_dim) VMEM vectors threaded to the kernel
@@ -382,7 +400,8 @@ def _rowwise_pallas_call(A, keys, extra_operands, kern, *, s_dim, m_tile,
     grid = (m // m_tile, n_blocks)
     scratch = _scratch(s_dim, n, m, m_tile)
     grid_params = _grid_params(scratch)
-    kern, scratch = _select_pipe(kern, pipe_kern, scratch, s_dim, m_tile)
+    kern, scratch = _select_pipe(kern, pipe_kern, scratch, s_dim, m_tile,
+                                 pipeline)
     return pl.pallas_call(
         kern,
         grid=grid,
@@ -419,26 +438,27 @@ def _kernel_pipe_cos(dist_kind, s_dim, n_blocks, precision, inscale,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("s_dim", "dist_kind", "m_tile", "precision", "interpret"),
+    static_argnames=("s_dim", "dist_kind", "m_tile", "precision",
+                     "interpret", "pipeline"),
 )
 def _fused_call(A, keys, *, s_dim, dist_kind, m_tile, precision="f32",
-                interpret=False):
+                interpret=False, pipeline=None):
     kern = functools.partial(_kernel, dist_kind, s_dim, m_tile, precision)
     pipe = functools.partial(_kernel_pipe, dist_kind, s_dim,
                              A.shape[1] // BLOCK_COLS, precision)
     return _rowwise_pallas_call(A, keys, (), kern, s_dim=s_dim,
                                 m_tile=m_tile, interpret=interpret,
-                                pipe_kern=pipe)
+                                pipe_kern=pipe, pipeline=pipeline)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("s_dim", "dist_kind", "m_tile", "precision",
-                     "inscale", "outscale", "interpret"),
+                     "inscale", "outscale", "interpret", "pipeline"),
 )
 def _fused_call_cos(A, keys, sc, sh, *, s_dim, dist_kind, m_tile,
                     precision="f32", inscale=1.0, outscale=1.0,
-                    interpret=False):
+                    interpret=False, pipeline=None):
     n_blocks = A.shape[1] // BLOCK_COLS
     kern = functools.partial(_kernel_cos, dist_kind, s_dim, m_tile,
                              n_blocks, precision, inscale, outscale)
@@ -446,15 +466,16 @@ def _fused_call_cos(A, keys, sc, sh, *, s_dim, dist_kind, m_tile,
                              precision, inscale, outscale)
     return _rowwise_pallas_call(A, keys, (sc, sh), kern, s_dim=s_dim,
                                 m_tile=m_tile, interpret=interpret,
-                                pipe_kern=pipe)
+                                pipe_kern=pipe, pipeline=pipeline)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("s_dim", "dist_kind", "m_tile", "precision", "interpret"),
+    static_argnames=("s_dim", "dist_kind", "m_tile", "precision",
+                     "interpret", "pipeline"),
 )
 def _fused_call_cw(A, keys, *, s_dim, dist_kind, m_tile, precision="f32",
-                   interpret=False):
+                   interpret=False, pipeline=None):
     n, m = A.shape
     n_blocks = n // BLOCK_COLS
     grid = (m // m_tile, n_blocks)
@@ -463,7 +484,8 @@ def _fused_call_cw(A, keys, *, s_dim, dist_kind, m_tile, precision="f32",
     kern = functools.partial(_kernel_cw, dist_kind, s_dim, m_tile, precision)
     pipe = functools.partial(_kernel_pipe_cw, dist_kind, s_dim, n_blocks,
                              precision)
-    kern, scratch = _select_pipe(kern, pipe, scratch, s_dim, m_tile)
+    kern, scratch = _select_pipe(kern, pipe, scratch, s_dim, m_tile,
+                                 pipeline)
     return pl.pallas_call(
         kern,
         grid=grid,
@@ -489,6 +511,78 @@ _DIST_KINDS = {
     randgen.Cauchy: "cauchy",
     randgen.Rademacher: "rademacher",
 }
+
+
+def _consult_cache(dist, shape, dtype, s_dim: int, seq_axis: int,
+                   rft: bool = False):
+    """Cached autotuner plan for this apply, or None. Gated on
+    params.use_plan_cache; never raises (a broken cache must not take
+    down a sketch apply)."""
+    from libskylark_tpu.sketch import params as sketch_params
+
+    if not sketch_params.get_use_plan_cache():
+        return None
+    kind = _DIST_KINDS.get(type(dist))
+    if kind is None or not supported(dist, dtype):
+        return None
+    try:
+        from libskylark_tpu import tune
+
+        return tune.plan_for(tune.dense_workload(
+            kind, shape, dtype, s_dim, seq_axis, rft=rft))
+    except Exception:
+        return None
+
+
+# marker: the cached plan says the XLA path serves this workload better
+# than the kernel — dispatch declines and the caller falls back
+_TAKE_XLA = object()
+
+
+def _resolve_knobs(dist, shape, dtype, s_dim: int, seq_axis: int,
+                   m_tile, precision, rft: bool = False):
+    """Apply the documented dispatch precedence (sketch/params.py
+    ``use_plan_cache`` doc) to the two tuning knobs: explicit call-site
+    argument > explicit user override (env/setter) > cached plan >
+    heuristic default. Returns ``(m_tile, precision, pipeline, source)``
+    with ``pipeline`` None (env decides) unless a cached plan pins it,
+    or the :data:`_TAKE_XLA` marker when a consulted plan certifies the
+    XLA path for this workload (only when the user overrode NO knob —
+    m-tile, precision, or the pipeline env; an explicit override means
+    a sweep/pin and must reach the kernel)."""
+    from libskylark_tpu.sketch import params as sketch_params
+
+    mt_open = m_tile is None and not sketch_params.pallas_m_tile_overridden()
+    prec_open = (precision is None
+                 and not sketch_params.pallas_precision_overridden())
+    plan = (_consult_cache(dist, shape, dtype, s_dim, seq_axis, rft=rft)
+            if mt_open or prec_open else None)
+    if plan is not None and plan.backend != "pallas":
+        if mt_open and prec_open and _pipeline_env() is None:
+            return _TAKE_XLA
+        plan = None
+    source = "heuristic"
+    pipeline = None
+    if plan is not None:
+        source = "cache"
+        if mt_open and plan.m_tile:
+            m_tile = plan.m_tile
+        # oracle-grade regimes ONLY: the cache file is a committed,
+        # hand-editable artifact, and the default dispatch must never
+        # auto-select a regime outside the 1e-4 determinism oracle
+        # (bf16/bf16gen2 stay call-site/setter opt-in) — nor pass an
+        # unknown string through _dot's silent HIGHEST fall-through
+        # under a mislabeling plan_id
+        from libskylark_tpu.tune.plans import ORACLE_PRECISIONS
+
+        if prec_open and plan.precision in ORACLE_PRECISIONS:
+            precision = plan.precision
+        pipeline = plan.pipeline or None
+    if m_tile is None:
+        m_tile = _DEFAULT_M_TILE()
+    if precision is None:
+        precision = _default_precision()
+    return m_tile, precision, pipeline, source
 
 
 def supported(dist, dtype) -> bool:
@@ -591,8 +685,13 @@ def rowwise_apply(
 ) -> Optional[jnp.ndarray]:
     """out = scale · A @ Sᵀ with S the virtual (s_dim × N) matrix of
     :func:`randgen.dense_block`. Returns None when not applicable (caller
-    falls back to the XLA path)."""
-    m_tile = m_tile or _DEFAULT_M_TILE()
+    falls back to the XLA path) — including when a cached autotuner plan
+    certifies the XLA path for this workload."""
+    knobs = _resolve_knobs(dist, A.shape, A.dtype, s_dim, 1, m_tile,
+                           precision)
+    if knobs is _TAKE_XLA:
+        return None
+    m_tile, precision, pipeline, _src = knobs
     mt = _qualify(dist, A, seq_axis=1, m_tile=m_tile, interpret=interpret,
                   s_dim=s_dim)
     if mt is None:
@@ -602,8 +701,8 @@ def rowwise_apply(
     try:
         out = _fused_call(Ap, _block_keys(key, A.shape[1]), s_dim=s_dim,
                           dist_kind=_DIST_KINDS[type(dist)], m_tile=mt,
-                          precision=precision or _default_precision(),
-                          interpret=interpret)
+                          precision=precision, interpret=interpret,
+                          pipeline=pipeline)
     except jax.errors.JaxRuntimeError:
         # eager-mode Mosaic compile failure (e.g. VMEM exhaustion on a
         # small-VMEM part) → let the caller take the XLA path
@@ -623,7 +722,11 @@ def columnwise_apply(
 ) -> Optional[jnp.ndarray]:
     """out = scale · S @ A for A (N, m); same fused generation, transposed
     contraction."""
-    m_tile = m_tile or _DEFAULT_M_TILE()
+    knobs = _resolve_knobs(dist, A.shape, A.dtype, s_dim, 0, m_tile,
+                           precision)
+    if knobs is _TAKE_XLA:
+        return None
+    m_tile, precision, pipeline, _src = knobs
     mt = _qualify(dist, A, seq_axis=0, m_tile=m_tile, interpret=interpret,
                   s_dim=s_dim)
     if mt is None:
@@ -633,8 +736,8 @@ def columnwise_apply(
     try:
         out = _fused_call_cw(Ap, _block_keys(key, A.shape[0]), s_dim=s_dim,
                              dist_kind=_DIST_KINDS[type(dist)], m_tile=mt,
-                             precision=precision or _default_precision(),
-                             interpret=interpret)
+                             precision=precision, interpret=interpret,
+                             pipeline=pipeline)
     except jax.errors.JaxRuntimeError:
         return None
     return scale * out[:, :m]
@@ -658,7 +761,11 @@ def rft_rowwise_apply(
     epilogue applied in VMEM (no extra HBM round-trip of the feature
     matrix). ``sc``/``sh`` are (s_dim,) per-feature scales/shifts.
     Returns None when not applicable."""
-    m_tile = m_tile or _DEFAULT_M_TILE()
+    knobs = _resolve_knobs(dist, A.shape, A.dtype, s_dim, 1, m_tile,
+                           precision, rft=True)
+    if knobs is _TAKE_XLA:
+        return None
+    m_tile, precision, pipeline, _src = knobs
     mt = _qualify(dist, A, seq_axis=1, m_tile=m_tile, interpret=interpret,
                   s_dim=s_dim)
     if mt is None:
@@ -671,9 +778,9 @@ def rft_rowwise_apply(
             jnp.asarray(sc, jnp.float32).reshape(1, s_dim),
             jnp.asarray(sh, jnp.float32).reshape(1, s_dim),
             s_dim=s_dim, dist_kind=_DIST_KINDS[type(dist)], m_tile=mt,
-            precision=precision or _default_precision(),
-            inscale=float(inscale), outscale=float(outscale),
-            interpret=interpret)
+            precision=precision, inscale=float(inscale),
+            outscale=float(outscale), interpret=interpret,
+            pipeline=pipeline)
     except jax.errors.JaxRuntimeError:
         return None
     return out[:m]
@@ -708,7 +815,11 @@ def fused_partial(
     backend/distribution qualification is _qualify's)."""
     if A_loc.shape[seq_axis] != keys.shape[0] * BLOCK_COLS:
         return None
-    m_tile = m_tile or _DEFAULT_M_TILE()
+    knobs = _resolve_knobs(dist, A_loc.shape, A_loc.dtype, s_dim,
+                           seq_axis, m_tile, precision)
+    if knobs is _TAKE_XLA:
+        return None
+    m_tile, precision, pipeline, _src = knobs
     mt = _qualify(dist, A_loc, seq_axis=seq_axis, m_tile=m_tile,
                   interpret=interpret, s_dim=s_dim)
     if mt is None:
@@ -716,8 +827,8 @@ def fused_partial(
     m = A_loc.shape[1 - seq_axis]
     Ap = _padded(A_loc, seq_axis=seq_axis, mt=mt)
     kw = dict(s_dim=s_dim, dist_kind=_DIST_KINDS[type(dist)], m_tile=mt,
-              precision=precision or _default_precision(),
-              interpret=interpret)
+              precision=precision, interpret=interpret,
+              pipeline=pipeline)
     if seq_axis == 1:
         return _fused_call(Ap, keys, **kw)[:m]
     return _fused_call_cw(Ap, keys, **kw)[:, :m]
@@ -725,7 +836,8 @@ def fused_partial(
 
 def effective_plan(dist, shape, dtype, s_dim: int, seq_axis: int,
                    m_tile: int | None = None,
-                   interpret: bool = False) -> dict:
+                   interpret: bool = False,
+                   precision: str | None = None) -> dict:
     """The plan a fused apply with these arguments would actually run —
     WITHOUT running it. Both tuning knobs can be silently adjusted
     downstream (:func:`_qualify` shrinks an over-budget m-tile;
@@ -733,22 +845,39 @@ def effective_plan(dist, shape, dtype, s_dim: int, seq_axis: int,
     doesn't fit), so anything recording a measurement labeled with the
     REQUESTED knobs must ask for the EFFECTIVE ones or the record lies
     about what was measured (e.g. the m-tile/pipeline sweep rows in
-    benchmarks/).
+    benchmarks/). Runs the SAME plan-cache resolution as the dispatch
+    (:func:`_resolve_knobs`), so the report reflects cached plans too.
 
-    Returns ``{"kernel": False}`` when the apply would take the XLA
-    fallback, else ``kernel/m_tile/operator_cache/pipelined``."""
-    m_tile = m_tile or _DEFAULT_M_TILE()
+    Returns ``{"kernel": False, "plan_id": "xla"}`` when the apply would
+    take the XLA fallback, else ``kernel/m_tile/operator_cache/
+    pipelined/precision/plan_id/plan_source``."""
+    knobs = _resolve_knobs(dist, tuple(shape), jnp.dtype(dtype), s_dim,
+                           seq_axis, m_tile, precision)
+    if knobs is _TAKE_XLA:
+        return {"kernel": False, "plan_id": "xla",
+                "plan_source": "cache"}
+    m_tile, precision, pipeline, source = knobs
     A = jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
     mt = _qualify(dist, A, seq_axis=seq_axis, m_tile=m_tile,
                   interpret=interpret, s_dim=s_dim)
     if mt is None:
-        return {"kernel": False}
+        return {"kernel": False, "plan_id": "xla",
+                "plan_source": source}
     # the same padding/scratch/pipeline helpers the pallas_call sites use
     n_p, m_p = _padded_extents(shape[seq_axis], shape[1 - seq_axis], mt)
     scratch = _scratch(s_dim, n_p, m_p, mt)
+    pipelined = _pipe_fits(scratch, s_dim, mt, pipeline)
+    # single source of the id format: the same Plan the cache stores
+    from libskylark_tpu.tune.plans import Plan
+
+    plan_id = Plan("pallas", m_tile=mt, precision=precision,
+                   pipeline=pipelined).plan_id()
     return {"kernel": True, "m_tile": mt,
             "operator_cache": bool(scratch),
-            "pipelined": _pipe_fits(scratch, s_dim, mt)}
+            "pipelined": pipelined,
+            "precision": precision,
+            "plan_id": plan_id,
+            "plan_source": source}
 
 
 def jr_key_data(k):

@@ -247,6 +247,23 @@ def supported(transform, A) -> bool:
 last_served_variant: str | None = None
 
 
+def _consult_cache(transform, At):
+    """Cached autotuner plan for this Fastfood feature map, or None.
+    Same precedence/gating as pallas_dense._consult_cache."""
+    from libskylark_tpu.sketch import params as sketch_params
+
+    if not sketch_params.get_use_plan_cache():
+        return None
+    try:
+        from libskylark_tpu import tune
+
+        return tune.plan_for(tune.fastfood_workload(
+            type(transform).sketch_type, At.shape, At.dtype,
+            transform._S))
+    except Exception:
+        return None
+
+
 def features_rows(transform, At, *, interpret: bool = False,
                   precision: str | None = None,
                   variant: str = "auto"):
@@ -258,10 +275,12 @@ def features_rows(transform, At, *, interpret: bool = False,
 
     ``variant``: "fused" (single kernel, in-kernel Π gather), "split"
     (two kernels around an XLA gather — the fallback if Mosaic rejects
-    the in-kernel gather), or "auto" (fused, then split on failure;
-    under ``interpret`` a fused failure re-raises instead — the
-    interpreter has no Mosaic to reject, so any exception there is a
-    plain bug that must not be masked by the fallback)."""
+    the in-kernel gather), or "auto" (a cached autotuner plan first —
+    which may also certify the XLA chain, declining the kernel — then
+    fused, then split on failure; under ``interpret`` a fused failure
+    re-raises instead — the interpreter has no Mosaic to reject, so any
+    exception there is a plain bug that must not be masked by the
+    fallback)."""
     import math
 
     if variant not in ("auto", "fused", "split"):
@@ -275,6 +294,33 @@ def features_rows(transform, At, *, interpret: bool = False,
     mt = plan_m_tile(NB, m)
     if mt is None:
         return None
+    # cached plan: consulted only for the decisions the caller left open
+    # (explicit variant/precision arguments and the env override below
+    # always win — the documented dispatch precedence,
+    # sketch/params.py ``use_plan_cache``)
+    prec_open = (precision is None
+                 and os.environ.get("SKYLARK_FASTFOOD_PRECISION") is None)
+    plan = (_consult_cache(T, At)
+            if variant == "auto" or prec_open else None)
+    cache_pinned_variant = False
+    if plan is not None and variant == "auto":
+        if plan.backend == "xla_chain":
+            if prec_open:
+                return None  # certified: the XLA chain serves this
+            # the caller pinned a kernel regime explicitly (argument or
+            # SKYLARK_FASTFOOD_PRECISION): a sweep/pin must reach the
+            # kernel — the cached decline applies only to fully-open
+            # dispatch (mirrors pallas_dense._resolve_knobs' _TAKE_XLA
+            # condition)
+            plan = None
+        elif plan.backend in ("fused", "split"):
+            variant = plan.backend
+            cache_pinned_variant = True
+    if plan is not None and plan.backend != variant:
+        # a plan certified for a DIFFERENT backend must not donate its
+        # regime to an explicitly requested variant (e.g. cached split/
+        # f32 would silently run an explicit fused certification at f32)
+        plan = None
     if precision is None:
         precision = os.environ.get("SKYLARK_FASTFOOD_PRECISION")
     if precision is None:
@@ -296,7 +342,15 @@ def features_rows(transform, At, *, interpret: bool = False,
                        "high": "bf16x3", "bfloat16_3x": "bf16x3",
                        "bfloat16": "bf16"}
         if pinned is None:
-            precision = "bf16x3"
+            # no user pin: a cached plan's regime (oracle-grade only —
+            # same read-time guard as pallas_dense._resolve_knobs; the
+            # committed cache file must not be able to opt the default
+            # dispatch into bf16), else the default
+            from libskylark_tpu.tune.plans import ORACLE_PRECISIONS
+
+            precision = (plan.precision if plan is not None
+                         and plan.precision in ORACLE_PRECISIONS
+                         else "bf16x3")
         elif pinned in _PIN_REGIME:
             precision = _PIN_REGIME[pinned]
         else:
@@ -321,6 +375,15 @@ def features_rows(transform, At, *, interpret: bool = False,
     global last_served_variant
     launchers = {"fused": (_launch,), "split": (_launch_split,),
                  "auto": (_launch, _launch_split)}[variant]
+    if cache_pinned_variant and variant == "fused":
+        # a cache-pinned fused plan keeps "auto"'s split fallback: the
+        # cache key is a pow2 shape BUCKET, so a different concrete
+        # shape (or toolchain rev) can still hit the one op without
+        # certified Mosaic precedent (the in-kernel gather) — degrading
+        # to the split kernel (~3x traffic) beats falling all the way
+        # to the XLA chain (~9x). An EXPLICIT variant="fused" argument
+        # stays exact (a certification run must not silently switch).
+        launchers = (_launch, _launch_split)
     F = None
     for launch in launchers:
         try:

@@ -67,6 +67,35 @@ def set_use_pallas(on: bool) -> None:
     _use_pallas = bool(on)
 
 
+# ``use_plan_cache`` — consult the persistent autotuner plan cache
+# (libskylark_tpu/tune/) at dispatch time, BEFORE the heuristic
+# defaults below. Precedence at every dispatch site: explicit call-site
+# argument > explicit user override (env SKYLARK_PALLAS_MTILE /
+# set_pallas_m_tile / set_pallas_precision — a sweep or a pin must beat
+# a cached winner) > cached plan > heuristic default. Disabled entirely
+# with SKYLARK_USE_PLAN_CACHE=0 (or set_use_plan_cache(False)); the
+# cache file location is SKYLARK_PLAN_CACHE (tune/cache.py).
+def _env_flag(name: str, default: bool) -> bool:
+    import os
+
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("0", "false", "off", "no", "")
+
+
+_use_plan_cache = _env_flag("SKYLARK_USE_PLAN_CACHE", True)
+
+
+def get_use_plan_cache() -> bool:
+    return _use_plan_cache
+
+
+def set_use_plan_cache(on: bool) -> None:
+    global _use_plan_cache
+    _use_plan_cache = bool(on)
+
+
 # ``pallas_precision`` — contraction regime inside the fused kernel.
 # "bf16x3" (default): 3-pass error-compensated bf16 split — f32-grade
 # rounding at roughly twice the MXU rate of full-f32 passes;
@@ -89,11 +118,22 @@ def set_use_pallas(on: bool) -> None:
 # from the f32 stream at ~2⁻⁸, it is strictly opt-in and its oracle
 # compares against an XLA apply of the SAME rounded operator
 # (tests/test_pallas_dense.py).
-_pallas_precision = "bf16x3"
+_PALLAS_PRECISION_DEFAULT = "bf16x3"
+_pallas_precision = _PALLAS_PRECISION_DEFAULT
 
 
 def get_pallas_precision() -> str:
     return _pallas_precision
+
+
+def pallas_precision_overridden() -> bool:
+    """True when the runtime regime differs from the shipping default —
+    an explicit pin beats a cached plan's precision (``use_plan_cache``
+    precedence). A pin whose value EQUALS the default is
+    indistinguishable and not detected (the same documented limit as
+    base/precision.ambient_precision_pinned_by_user; such callers pass
+    ``precision=`` at the call site, which always wins)."""
+    return _pallas_precision != _PALLAS_PRECISION_DEFAULT
 
 
 def set_pallas_precision(p: str) -> None:
@@ -116,14 +156,18 @@ def set_pallas_precision(p: str) -> None:
 # _qualify still shrinks per-call when s_dim is larger. Seeded from
 # SKYLARK_PALLAS_MTILE for on-chip sweeps without code changes; invalid
 # values fall back to the default.
+_PALLAS_M_TILE_DEFAULT = 512
+
+
 def _env_m_tile() -> int:
     import os
 
     try:
-        v = int(os.environ.get("SKYLARK_PALLAS_MTILE", 512))
+        v = int(os.environ.get("SKYLARK_PALLAS_MTILE",
+                               _PALLAS_M_TILE_DEFAULT))
     except ValueError:
-        return 512
-    return v if v >= 8 else 512
+        return _PALLAS_M_TILE_DEFAULT
+    return v if v >= 8 else _PALLAS_M_TILE_DEFAULT
 
 
 _pallas_m_tile = _env_m_tile()
@@ -131,6 +175,25 @@ _pallas_m_tile = _env_m_tile()
 
 def get_pallas_m_tile() -> int:
     return _pallas_m_tile
+
+
+def pallas_m_tile_overridden() -> bool:
+    """True when the user set the tile explicitly — a one-shot
+    SKYLARK_PALLAS_MTILE (valid value; a typo degrades to the default
+    INCLUDING cache consultation) or a runtime set_pallas_m_tile away
+    from the shipping default. An on-chip sweep's env override must
+    beat a cached winner or the sweep can't explore."""
+    if _pallas_m_tile != _PALLAS_M_TILE_DEFAULT:
+        return True
+    import os
+
+    v = os.environ.get("SKYLARK_PALLAS_MTILE")
+    if v is None:
+        return False
+    try:
+        return int(v) >= 8
+    except ValueError:
+        return False
 
 
 def set_pallas_m_tile(t: int) -> None:
@@ -156,15 +219,6 @@ def set_pallas_m_tile(t: int) -> None:
 # change results vs the first (OperatorCache._materialize_changes_numerics;
 # explicit materialize() remains the visible way to choose the cached
 # regime on TPU). SKYLARK_AUTO_MATERIALIZE=0 disables the dispatch.
-def _env_flag(name: str, default: bool) -> bool:
-    import os
-
-    v = os.environ.get(name)
-    if v is None:
-        return default
-    return v.strip().lower() not in ("0", "false", "off", "no", "")
-
-
 _auto_materialize = _env_flag("SKYLARK_AUTO_MATERIALIZE", True)
 _auto_materialize_after = 3
 _auto_materialize_bytes = 64 * 1024 * 1024

@@ -1,0 +1,112 @@
+"""Sketch-apply autotuner: candidate plans, offline cost ranking, and a
+persistent plan cache the dispatchers consult before their heuristics.
+
+The flow (designed for scarce TPU access — see ISSUE/ROADMAP):
+
+1. **Offline** (any host, no TPU): :func:`enumerate_candidates` lists
+   every plan for a workload; :func:`rank_candidates` orders them with
+   the hardware-free cost model (:mod:`tune.cost`); :func:`autotune_topk`
+   returns the short list a live window should actually measure.
+2. **Live window**: measure the top-k (bench.py does this for the
+   headline config) and :func:`record_measurement` the winner — the
+   cache persists to disk (``benchmarks/plan_cache.json`` by default).
+3. **Dispatch**: the sketch dispatchers (sketch/pallas_dense.py,
+   sketch/pallas_fastfood.py via sketch/frft.py) call :func:`plan_for`
+   before falling back to their heuristics. Explicit call-site
+   arguments and the one-shot env overrides (``SKYLARK_PALLAS_MTILE``
+   et al.) still take precedence — the cache fills in only what the
+   caller left unspecified.
+
+``SKYLARK_PLAN_CACHE`` points the cache elsewhere (or ``0`` disables
+persistence); :func:`libskylark_tpu.sketch.params.set_use_plan_cache`
+gates dispatch-time consultation at runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from libskylark_tpu.tune.cache import (PlanCache, default_cache_path,
+                                       get_cache, set_cache)
+from libskylark_tpu.tune.cost import (RATES, analyze_jitted, plan_cost,
+                                      rank_plans)
+from libskylark_tpu.tune.plans import (Plan, Workload, bucket_dim,
+                                       current_device_kind,
+                                       enumerate_candidates,
+                                       normalize_device_kind)
+
+__all__ = [
+    "Plan", "PlanCache", "Workload", "analyze_jitted", "autotune_topk",
+    "bucket_dim", "current_device_kind", "default_cache_path",
+    "dense_workload", "enumerate_candidates", "fastfood_workload",
+    "get_cache", "normalize_device_kind", "plan_cost", "plan_for",
+    "rank_candidates", "rank_plans", "record_measurement", "set_cache",
+    "RATES",
+]
+
+
+# -- workload constructors (the dispatchers' vocabulary) --
+
+def dense_workload(dist_kind: str, shape, dtype, s_dim: int,
+                   seq_axis: int, *, rft: bool = False,
+                   device_kind: Optional[str] = None) -> Workload:
+    """Workload for a dense virtual-operator apply. ``shape`` is the
+    2-D input's shape; ``seq_axis`` its contracted axis (1 → rowwise
+    A·Sᵀ, 0 → columnwise S·A); ``rft`` marks the cos-epilogue variant."""
+    m = int(shape[1 - seq_axis])
+    n = int(shape[seq_axis])
+    op = ("rft_rowwise" if rft
+          else ("dense_rowwise" if seq_axis == 1 else "dense_columnwise"))
+    return Workload(
+        device_kind=device_kind or current_device_kind(),
+        op=op, transform=str(dist_kind), dtype=str(dtype),
+        shape=(m, n, int(s_dim)))
+
+
+def fastfood_workload(transform_type: str, shape, dtype, s_dim: int, *,
+                      device_kind: Optional[str] = None) -> Workload:
+    """Workload for a Fastfood feature map on row-major (m, d) input."""
+    return Workload(
+        device_kind=device_kind or current_device_kind(),
+        op="fastfood_rows", transform=str(transform_type),
+        dtype=str(dtype), shape=(int(shape[0]), int(shape[1]),
+                                 int(s_dim)))
+
+
+# -- the three public verbs --
+
+def plan_for(w: Workload) -> Optional[Plan]:
+    """Cached plan for ``w``, or None (dispatcher keeps its heuristic).
+    Never raises: a broken cache must not take down a sketch apply."""
+    try:
+        return get_cache().lookup(w)
+    except Exception:
+        return None
+
+
+def rank_candidates(w: Workload, allow_fast: bool = False,
+                    rates: Optional[dict] = None):
+    """(plan, cost-record) pairs, best modeled plan first."""
+    return rank_plans(w, enumerate_candidates(w, allow_fast=allow_fast),
+                      rates)
+
+
+def autotune_topk(w: Workload, k: int = 3,
+                  allow_fast: bool = False) -> list[Plan]:
+    """The k plans a live TPU window should measure for ``w``, best
+    modeled first — the offline half of the tuner."""
+    return [p for p, _ in rank_candidates(w, allow_fast=allow_fast)[:k]]
+
+
+def record_measurement(w: Workload, plan: Plan, value: float,
+                       unit: str = "GB/s",
+                       extra: Optional[dict] = None) -> bool:
+    """Feed a measured result into the global cache and persist it.
+    Returns whether the cache changed (see
+    :meth:`PlanCache.record_measurement` for the better-only rule)."""
+    cache = get_cache()
+    changed = cache.record_measurement(w, plan, value, unit=unit,
+                                       extra=extra)
+    if changed:
+        cache.save()
+    return changed

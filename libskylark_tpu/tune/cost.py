@@ -1,0 +1,185 @@
+"""Hardware-free cost models for plan ranking.
+
+Two complementary models live here:
+
+1. :func:`analyze_jitted` — XLA's own compiled-HLO cost analysis
+   (flops / bytes-accessed / memory split), promoted into the package
+   from ``benchmarks/hlo_cost.py`` (which now imports it from here).
+   For a fixed jitted computation at fixed shapes these numbers are
+   deterministic properties of the lowered HLO — the drift-proof perf
+   signal the CI ratchet gates on, and the cost oracle for XLA-path
+   plans.
+
+2. :func:`plan_cost` / :func:`rank_plans` — an **analytic** roofline
+   model for Pallas kernel plans, which XLA cannot cost (the Mosaic
+   kernel only compiles on TPU). It prices the exact quantities the
+   kernel's own documentation identifies as the cost structure
+   (sketch/pallas_dense.py, sketch/params.py): MXU passes per
+   contraction regime, operator generation on the VPU (~50 ops/entry,
+   one full regeneration per m-tile sweep unless the operator-cache
+   scratch fits), HBM traffic, and generation/matmul overlap when the
+   pipelined kernel engages.
+
+Absolute times from the analytic model are NOT predictions — only the
+ORDERING is consumed (rank the candidates, measure the top-k in a live
+window). The rate constants are v5e headline figures; override via the
+``RATES`` mapping for other parts. Ranking is deterministic: stable
+sort on (modeled seconds, plan_id).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from libskylark_tpu.tune.plans import (FASTFOOD_OPS, Plan, Workload)
+
+# --------------------------------------------------------------------------
+# compiled-HLO analysis (promoted from benchmarks/hlo_cost.py)
+# --------------------------------------------------------------------------
+
+
+def analyze_jitted(name: str, jitted, *avals) -> dict:
+    """Lower+compile ``jitted`` at ``avals`` and return its XLA cost /
+    memory analysis as a flat record. Deterministic for fixed shapes and
+    toolchain — zero hardware, zero timing noise."""
+    compiled = jitted.lower(*avals).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):  # older jax returned [dict]
+        ca = ca[0]
+    mem = compiled.memory_analysis()
+    return {
+        "config": name,
+        "flops": float(ca.get("flops", 0.0)),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+        "argument_bytes": int(getattr(mem, "argument_size_in_bytes", 0)),
+        "output_bytes": int(getattr(mem, "output_size_in_bytes", 0)),
+        "temp_bytes": int(getattr(mem, "temp_size_in_bytes", 0)),
+    }
+
+
+# --------------------------------------------------------------------------
+# analytic kernel-plan roofline
+# --------------------------------------------------------------------------
+
+# v5e headline rates. Ranking consumes ratios, not absolutes, so these
+# only need to be right RELATIVE to each other at the order-of-magnitude
+# level the plan axes move (MXU pass count, generation sweeps, HBM).
+RATES = {
+    "mxu_flops_per_s": 197e12,   # one bf16 MXU pass
+    "vpu_ops_per_s": 5e12,       # effective generation issue rate —
+    # calibrated against the r03 on-chip headline (86.3 GB/s = 3.50
+    # ms/apply at mt512/bf16x3: 2.09 ms MXU + ~1.4 ms generation, 16
+    # sweeps × 8192·1024 entries × ~50 ops); the model then reproduces
+    # the measured f32 regime within ~20%
+    "hbm_bytes_per_s": 820e9,    # HBM bandwidth
+}
+
+# VPU ops per generated operator entry: Threefry + inverse-CDF ≈ 50
+# (sketch/params.py m-tile analysis; SURVEY §3.1).
+GEN_OPS_PER_ENTRY = 50
+
+# MXU passes per logical f32 contraction at each kernel regime
+# (sketch/pallas_dense._dot): bf16 single pass; bf16gen2 two;
+# bf16x3 three; "f32" lowers to Precision.HIGHEST ≈ 6 bf16 passes.
+MXU_PASSES = {"bf16": 1, "bf16gen2": 2, "bf16x3": 3, "f32": 6}
+
+# The XLA paths' measured-regime factors, relative to the fused kernel's
+# single-gemm traffic at the same shapes (BASELINE.md / hlo_cost_r05:
+# the XLA Fastfood chain re-touches the (rows, NB) intermediate ~9x;
+# the split variant ~3x).
+_FASTFOOD_TRAFFIC_X = {"fused": 1.0, "split": 3.0, "xla_chain": 9.0}
+
+
+def _dense_operator_cached(m: int, n: int, s: int, m_tile: int) -> bool:
+    """Whether the kernel would serve this plan from the VMEM operator
+    cache — the kernel's OWN decision logic and env-resolved budgets
+    (pallas_dense._scratch / SKYLARK_PALLAS_SCRATCH_CAP /
+    SKYLARK_PALLAS_VMEM_BUDGET), imported lazily so ranking can't
+    drift from dispatch on parts whose budgets were overridden. The
+    import is cycle-safe: pallas_dense only reaches tune lazily inside
+    its dispatch functions."""
+    from libskylark_tpu.sketch.pallas_dense import (_SCRATCH_CAP_BYTES,
+                                                    _VMEM_BUDGET_BYTES,
+                                                    _vmem_estimate)
+
+    if m // m_tile <= 1:
+        return False
+    scratch_bytes = s * n * 4
+    if scratch_bytes > _SCRATCH_CAP_BYTES:
+        return False
+    return _vmem_estimate(m_tile, s, scratch_bytes) <= _VMEM_BUDGET_BYTES
+
+
+def plan_cost(w: Workload, p: Plan, rates: Optional[dict] = None) -> dict:
+    """Modeled cost record for serving ``w`` with ``p``:
+    ``{flops, bytes, gen_entries, modeled_s}``. See module doc — only
+    the ordering of ``modeled_s`` across plans is meaningful."""
+    rates = rates or RATES
+    m, n, s = w.shape
+    if w.op in FASTFOOD_OPS:
+        return _fastfood_cost(w, p, rates)
+
+    bytes_moved = 4.0 * (m * n + m * s)
+    hbm_s = bytes_moved / rates["hbm_bytes_per_s"]
+    if p.backend == "xla":
+        # materialize S (one more operator-sized HBM round trip) + one
+        # HIGHEST-precision gemm; generation runs once, fused by XLA
+        flops = 2.0 * m * n * s * MXU_PASSES["f32"]
+        gen_entries = float(n * s)
+        xla_bytes = bytes_moved + 2.0 * 4.0 * n * s
+        compute_s = (flops / rates["mxu_flops_per_s"]
+                     + gen_entries * GEN_OPS_PER_ENTRY
+                     / rates["vpu_ops_per_s"])
+        modeled = max(xla_bytes / rates["hbm_bytes_per_s"], compute_s)
+        return {"flops": flops, "bytes": xla_bytes,
+                "gen_entries": gen_entries, "modeled_s": modeled}
+
+    if p.backend != "pallas":
+        raise ValueError(f"unknown dense backend {p.backend!r}")
+    m_tile = p.m_tile or 512
+    precision = p.precision or "bf16x3"
+    flops = 2.0 * m * n * s * MXU_PASSES[precision]
+    sweeps = 1 if _dense_operator_cached(m, n, s, m_tile) \
+        else max(1, -(-m // m_tile))
+    gen_entries = float(n * s * sweeps)
+    mxu_s = flops / rates["mxu_flops_per_s"]
+    gen_s = gen_entries * GEN_OPS_PER_ENTRY / rates["vpu_ops_per_s"]
+    # the pipelined kernel hides generation under the matmul; the plain
+    # kernel serializes them (sketch/pallas_dense._kernel_pipe doc)
+    compute_s = max(mxu_s, gen_s) if p.pipeline else mxu_s + gen_s
+    modeled = max(hbm_s, compute_s)
+    return {"flops": flops, "bytes": bytes_moved,
+            "gen_entries": gen_entries, "modeled_s": modeled}
+
+
+def _fastfood_cost(w: Workload, p: Plan, rates: dict) -> dict:
+    m, _d, s = w.shape
+    # block length NB ≥ s for the single-block case; the chain computes
+    # nb·NB ≥ s features. Use s rounded to the bucket as the effective
+    # feature extent — exact block math is the kernel's business.
+    nb_feats = max(s, 512)
+    base_bytes = 4.0 * m * nb_feats  # one intermediate-sized touch
+    traffic_x = _FASTFOOD_TRAFFIC_X.get(p.backend)
+    if traffic_x is None:
+        raise ValueError(f"unknown fastfood backend {p.backend!r}")
+    bytes_moved = base_bytes * (1.0 + traffic_x)
+    # two WHTs as kron-factored dots: 2 · 2·m·NB·(√NB+√NB) ≈
+    # 4·m·NB^1.5 flops per pass
+    passes = MXU_PASSES[p.precision or "bf16x3"] if p.backend != \
+        "xla_chain" else MXU_PASSES["f32"]
+    flops = 4.0 * m * nb_feats * (nb_feats ** 0.5) * passes
+    modeled = max(bytes_moved / rates["hbm_bytes_per_s"],
+                  flops / rates["mxu_flops_per_s"])
+    return {"flops": flops, "bytes": bytes_moved, "gen_entries": 0.0,
+            "modeled_s": modeled}
+
+
+def rank_plans(w: Workload, plans: Sequence[Plan],
+               rates: Optional[dict] = None
+               ) -> list[tuple[Plan, dict]]:
+    """Deterministically rank ``plans`` for ``w``: ascending modeled
+    seconds, ties broken by plan_id. The offline pre-ranking a live TPU
+    window's top-k measurement starts from."""
+    scored = [(p, plan_cost(w, p, rates)) for p in plans]
+    scored.sort(key=lambda pc: (pc[1]["modeled_s"], pc[0].plan_id()))
+    return scored

@@ -1,0 +1,179 @@
+"""Kernel-plan and workload descriptors for the sketch-apply autotuner.
+
+A **workload** names a sketch-apply hot-path invocation abstractly enough
+to be cached across processes: ``(device_kind, op, transform, dtype,
+shape bucket)``. A **plan** names every tuning decision the dispatchers
+can make for it: which backend serves the apply (fused Pallas kernel vs
+the XLA path; fused vs split Fastfood variant), the Pallas ``m_tile``,
+the contraction-precision regime, and whether the pipelined-generation
+kernel engages.
+
+Shapes are bucketed to the next power of two so one certified plan
+serves a neighborhood of shapes — the kernels' own qualification
+(``pallas_dense._qualify``) re-validates the concrete shape at dispatch
+and shrinks/declines as needed, so a bucket can never force an invalid
+configuration, only a suboptimal one.
+
+Candidate enumeration is the offline half of the tuner: it lists every
+plan worth considering for a workload so :mod:`tune.cost` can pre-rank
+them without hardware and a live TPU window measures only the top-k
+(TPU windows have been scarce for four straight rounds — a window must
+certify the best config, not probe for it). Accuracy-opt-in regimes
+("bf16", "bf16gen2" on data contractions) are enumerated only with
+``allow_fast=True``: the autotuner must never auto-select a regime the
+1e-4 determinism oracle doesn't cover.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional, Sequence
+
+# Dense-kernel m-tile candidates: powers of two spanning the regimes the
+# r2/r3 on-chip sweeps explored. _qualify pre-shrinks over-budget tiles,
+# so enumeration may include tiles a given s_dim can't hold.
+DENSE_M_TILES = (128, 256, 512, 1024)
+
+# Oracle-grade contraction regimes (auto-selectable) vs throughput
+# regimes (opt-in via allow_fast; see sketch/params.py regime docs).
+ORACLE_PRECISIONS = ("bf16x3", "f32")
+FAST_PRECISIONS = ("bf16gen2", "bf16")
+
+# ops the dense kernel can serve
+DENSE_OPS = ("dense_rowwise", "dense_columnwise", "rft_rowwise")
+FASTFOOD_OPS = ("fastfood_rows",)
+
+
+def bucket_dim(x: int) -> int:
+    """Next power of two ≥ x (min 8): one cache entry serves the whole
+    bucket; concrete-shape feasibility stays the dispatcher's job."""
+    x = max(int(x), 8)
+    return 1 << (x - 1).bit_length()
+
+
+def normalize_device_kind(kind: str) -> str:
+    """Canonical cache-key form of ``jax.Device.device_kind`` (or
+    "cpu"): lowercased, runs of non-alphanumerics collapsed to one
+    underscore, so "TPU v5 lite" and "tpu-v5-lite" key identically."""
+    import re
+
+    return re.sub(r"[^a-z0-9]+", "_", str(kind).lower()).strip("_")
+
+
+def current_device_kind() -> str:
+    try:
+        import jax
+
+        return normalize_device_kind(jax.devices()[0].device_kind)
+    except Exception:
+        return "unknown"
+
+
+@dataclasses.dataclass(frozen=True)
+class Workload:
+    """One cacheable hot-path invocation class.
+
+    ``op``: dispatch site — one of DENSE_OPS / FASTFOOD_OPS.
+    ``transform``: the operator stream kind — a distribution kind
+    ("normal"/"cauchy"/"rademacher") for dense ops, the transform's
+    ``sketch_type`` for Fastfood.
+    ``shape``: (m, n, s) — m the non-contracted input extent, n the
+    contracted (sketched) extent, s the sketch/feature dimension.
+    """
+
+    device_kind: str
+    op: str
+    transform: str
+    dtype: str
+    shape: tuple[int, int, int]
+
+    def bucket(self) -> tuple[int, int, int]:
+        return tuple(bucket_dim(d) for d in self.shape)
+
+    def key(self) -> str:
+        b = "x".join(str(d) for d in self.bucket())
+        return "|".join((normalize_device_kind(self.device_kind),
+                         self.op, self.transform, str(self.dtype), b))
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """One complete tuning decision for a workload.
+
+    ``backend``: "pallas" | "xla" for dense ops; "fused" | "split" |
+    "xla_chain" for Fastfood. The XLA backends carry no knobs — they
+    mean "take the existing non-kernel path".
+    """
+
+    backend: str
+    m_tile: Optional[int] = None
+    precision: Optional[str] = None
+    pipeline: bool = False
+
+    def plan_id(self) -> str:
+        """Deterministic short id — the label bench records carry and
+        tie-break ranking sorts by."""
+        parts = [self.backend]
+        if self.m_tile is not None:
+            parts.append(f"mt{self.m_tile}")
+        if self.precision is not None:
+            parts.append(self.precision)
+        if self.pipeline:
+            parts.append("pipe")
+        return "/".join(parts)
+
+    def to_dict(self) -> dict:
+        d = {"backend": self.backend}
+        if self.m_tile is not None:
+            d["m_tile"] = int(self.m_tile)
+        if self.precision is not None:
+            d["precision"] = self.precision
+        if self.pipeline:
+            d["pipeline"] = True
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Plan":
+        return cls(
+            backend=str(d["backend"]),
+            m_tile=(int(d["m_tile"]) if d.get("m_tile") is not None
+                    else None),
+            precision=d.get("precision"),
+            pipeline=bool(d.get("pipeline", False)),
+        )
+
+
+def _dense_candidates(w: Workload, precisions: Sequence[str]
+                      ) -> Iterator[Plan]:
+    m, _n, _s = w.bucket()
+    for prec in precisions:
+        for mt in DENSE_M_TILES:
+            if mt > m:
+                continue
+            for pipe in (False, True):
+                yield Plan("pallas", m_tile=mt, precision=prec,
+                           pipeline=pipe)
+    yield Plan("xla")
+
+
+def _fastfood_candidates(precisions: Sequence[str]) -> Iterator[Plan]:
+    for prec in precisions:
+        yield Plan("fused", precision=prec)
+        yield Plan("split", precision=prec)
+    yield Plan("xla_chain")
+
+
+def enumerate_candidates(w: Workload,
+                         allow_fast: bool = False) -> list[Plan]:
+    """Every plan worth ranking for ``w``. The dense list crosses
+    m-tiles × precision regimes × pipeline on/off, plus the XLA
+    fallback; Fastfood crosses variant × precision plus the XLA chain.
+    ``allow_fast`` adds the accuracy-opt-in regimes (never
+    auto-selected by default — see module doc)."""
+    precisions = ORACLE_PRECISIONS + (FAST_PRECISIONS if allow_fast
+                                      else ())
+    if w.op in DENSE_OPS:
+        return list(_dense_candidates(w, precisions))
+    if w.op in FASTFOOD_OPS:
+        return list(_fastfood_candidates(precisions))
+    raise ValueError(f"unknown workload op {w.op!r}")

@@ -185,7 +185,12 @@ class TestAccelerated:
         )
         assert int(it) > 0, "should use LSQR path, not fallback"
         x_np = np.linalg.lstsq(A, B, rcond=None)[0]
-        np.testing.assert_allclose(np.asarray(x), x_np, atol=5e-3)
+        # f32 accuracy floor, not solver quality: at cond=1e3 the
+        # attainable error is ~cond·eps_f32·‖x‖ ≈ 4e-3 and the exact
+        # placement wobbles with the toolchain's gemm rounding; 1e-2
+        # stays a "high accuracy" bound (~3e-4 relative) while clearing
+        # the floor on every jax line
+        np.testing.assert_allclose(np.asarray(x), x_np, atol=1e-2)
         # sketch-preconditioned LSQR should converge quickly
         assert int(it) <= 60
 

@@ -1,7 +1,9 @@
 """End-to-end tests of bench.py's wedged-tunnel fallback: the
 verified-committed block, content-hash oracle freshness, and the r5
 promotion rule (a committed capture becomes the headline value ONLY
-when its oracle stamp's kernel sha256 matches the working tree).
+when its oracle stamp certifies the working tree — since the closure
+extension, the stamp's closure_sha256 must match the kernel-relevant
+closure: pallas_dense.py + sketch/params.py + base/randgen.py).
 
 Runs bench.py as a subprocess from a fixture tree with
 SKYLARK_BENCH_DEADLINE below the probe threshold, so main() goes
@@ -10,7 +12,6 @@ orchestration tests, deliberately hardware-free)."""
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import shutil
@@ -24,13 +25,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.fixture
 def tree(tmp_path):
-    """Minimal working tree: bench.py + the kernel file + a committed
-    r99 headline record; returns (dir, write_stamp, run)."""
+    """Minimal working tree: bench.py + the kernel-closure files + a
+    committed r99 headline record; returns (dir, write_stamp, run)."""
     shutil.copy(os.path.join(REPO, "bench.py"), tmp_path / "bench.py")
     kdir = tmp_path / "libskylark_tpu" / "sketch"
     kdir.mkdir(parents=True)
-    kernel = kdir / "pallas_dense.py"
-    kernel.write_text("# kernel source v1\n")
+    (kdir / "pallas_dense.py").write_text("# kernel source v1\n")
+    (kdir / "params.py").write_text("# knobs v1\n")
+    bdir2 = tmp_path / "libskylark_tpu" / "base"
+    bdir2.mkdir(parents=True)
+    (bdir2 / "randgen.py").write_text("# streams v1\n")
     bdir = tmp_path / "benchmarks"
     bdir.mkdir()
     rec = {"metric": "jlt_sketch_apply_GBps_per_chip", "value": 123.4,
@@ -41,9 +45,14 @@ def tree(tmp_path):
     def write_stamp(content: str | None):
         p = bdir / ".tpu_oracle_recert_r99"
         if content is None:
-            kern_sha = hashlib.sha256(
-                kernel.read_bytes()).hexdigest()
-            content = f"2026-07-31T00:00:00Z kernel_sha256={kern_sha}"
+            # the REAL stamp writer — the steps scripts call this same
+            # entry point, so the test certifies the actual format
+            out = subprocess.run(
+                [sys.executable, str(tmp_path / "bench.py"), "--stamp"],
+                capture_output=True, text=True, timeout=60,
+                cwd=str(tmp_path))
+            assert out.returncode == 0, out.stderr[-500:]
+            content = f"2026-07-31T00:00:00Z {out.stdout.strip()}"
         p.write_text(content)
 
     def run():
@@ -91,16 +100,29 @@ def test_stale_kernel_hash_blocks_promotion(tree):
     assert rec["verified_committed"]["oracle_fresh"] is False
 
 
-def test_pre_r5_stamp_without_hash_does_not_promote(tree):
+@pytest.mark.parametrize("rel", [
+    os.path.join("libskylark_tpu", "sketch", "params.py"),
+    os.path.join("libskylark_tpu", "base", "randgen.py"),
+])
+def test_stale_closure_blocks_promotion(tree, rel):
+    """The ADVICE r5 stamp-closure extension: a post-certification
+    change to the tuning knobs or the generation streams — not just the
+    kernel file — makes the stamp stale."""
+    tmp, write_stamp, run = tree
+    write_stamp(None)
+    (tmp / rel).write_text("# changed after certification\n")
+    rec = run()
+    assert rec["value"] is None
+    assert rec["verified_committed"]["oracle_fresh"] is False
+
+
+def test_pre_closure_stamp_does_not_promote(tree):
+    """Legacy stamps (kernel_sha256 only, or bare timestamps) certify at
+    most one file of the three-file closure: definitively stale."""
     _, write_stamp, run = tree
     write_stamp("2026-07-31T00:00:00Z")  # old format: timestamp only
     rec = run()
-    # mtime fallback may judge freshness either way depending on file
-    # creation order, but promotion additionally requires the sha match
-    # path; with no hash in the stamp the mtime path decides
-    # oracle_fresh — written after the kernel here, so fresh=True is
-    # acceptable; the key invariant is the record stays self-describing
+    assert rec["value"] is None
     vc = rec["verified_committed"]
-    assert "kernel_sha256" not in (vc["oracle_stamp"] or "")
-    if rec["value"] is not None:
-        assert rec["measured_live"] is False
+    assert vc["oracle_fresh"] is False
+    assert "pre-closure" in vc.get("oracle_stale_reason", "")

@@ -428,6 +428,34 @@ class TestStreamingResume:
             StreamingCWT(64, 16, Context(seed=5)).sketch(
                 self._batches(X, Y, 8), checkpoint=ckdir)
 
+    def test_foreign_digest_scheme_diagnosed_as_format(
+            self, stream_data, tmp_path):
+        """A checkpoint tagged with a DIFFERENT digest scheme must
+        refuse with a format diagnosis (the ml/admm.py _IDENTITY_SCHEME
+        discipline applied to streaming, ADVICE r5) — not fall through
+        to a digest comparison that misdiagnoses it as a different
+        stream."""
+        from libskylark_tpu.base.context import Context
+        from libskylark_tpu.io.streaming import StreamingCWT
+        from libskylark_tpu.utility.checkpoint import TrainCheckpointer
+
+        X, Y = stream_data
+        ckdir = tmp_path / "stream"
+        s = StreamingCWT(64, 16, Context(seed=5))
+        s.sketch(self._batches(X[:24], Y[:24], 8), checkpoint=ckdir,
+                 checkpoint_every=1)
+        with TrainCheckpointer(str(ckdir)) as ck:
+            step, meta = ck.metadata()
+            assert meta["digest_scheme"] == 2  # current scheme tagged
+            _, state, _ = ck.restore(step)
+            meta = dict(meta)
+            meta["digest_scheme"] = 99  # a future/foreign scheme
+            ck.save(step + 1, state, meta)
+        with pytest.raises(errors.InvalidParametersError,
+                           match="digest scheme"):
+            StreamingCWT(64, 16, Context(seed=5)).sketch(
+                self._batches(X, Y, 8), checkpoint=ckdir)
+
     def test_exact_offset_rerun_is_consistent_noop(self, stream_data,
                                                    tmp_path):
         """A re-supplied stream ending EXACTLY at the checkpointed

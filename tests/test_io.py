@@ -6,6 +6,7 @@ write/read round trip compared by norm; tests/unit/ReadArcList.cpp)."""
 
 import io as pyio
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -210,6 +211,107 @@ class TestStreaming:
             [(X[:15], Y[:15]), (X[15:], Y[15:])], num_classes=c)
         assert SX.shape == (s, d)
         assert SY.shape == (s, c)
+
+
+class TestStreamingOverlap:
+    """Double-buffered prefetch (io/chunked.prefetch_batches wired into
+    StreamingCWT.sketch): overlap must move bytes EARLIER without
+    changing a single bit of the result."""
+
+    def _data(self, n=192, d=6):
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((n, d)).astype(np.float32)
+        Y = rng.integers(0, 2, n).astype(np.float32) * 2 - 1
+        return X, Y
+
+    def test_double_buffered_bit_equal_to_one_shot(self):
+        """The acceptance oracle: the streaming double-buffered path is
+        BIT-equal to the one-shot CWT.apply on the concatenated data
+        (carried-accumulator scatter + value-preserving prefetch)."""
+        from libskylark_tpu.sketch import COLUMNWISE
+        from libskylark_tpu.sketch.hash import CWT
+
+        n, d, s = 192, 6, 8
+        X, Y = self._data(n, d)
+        batches = [(X[i:i + 32], Y[i:i + 32]) for i in range(0, n, 32)]
+
+        SX, SY = skio.StreamingCWT(n, s, Context(seed=7)).sketch(
+            iter(batches), prefetch_depth=2)
+        cwt = CWT(n, s, Context(seed=7))
+        SX_ref = cwt.apply(jnp.asarray(X), COLUMNWISE)
+        SY_ref = cwt.apply(jnp.asarray(Y[:, None]), COLUMNWISE)[:, 0]
+        np.testing.assert_array_equal(np.asarray(SX), np.asarray(SX_ref))
+        np.testing.assert_array_equal(np.asarray(SY), np.asarray(SY_ref))
+
+    def test_prefetch_bit_equal_to_synchronous(self):
+        n, s = 192, 8
+        X, Y = self._data(n)
+        batches = [(X[i:i + 32], Y[i:i + 32]) for i in range(0, n, 32)]
+        SX_pf, SY_pf = skio.StreamingCWT(n, s, Context(seed=7)).sketch(
+            iter(batches), prefetch_depth=3)
+        SX_sy, SY_sy = skio.StreamingCWT(n, s, Context(seed=7)).sketch(
+            iter(batches), prefetch_depth=0)
+        np.testing.assert_array_equal(np.asarray(SX_pf),
+                                      np.asarray(SX_sy))
+        np.testing.assert_array_equal(np.asarray(SY_pf),
+                                      np.asarray(SY_sy))
+
+    def test_prefetch_preserves_order_and_devices_leading_array(self):
+        import jax as _jax
+
+        items = [(np.full((2, 2), i, np.float32), i) for i in range(7)]
+        out = list(skio.prefetch_batches(iter(items), depth=2))
+        assert [y for _, y in out] == list(range(7))
+        for X, i in out:
+            assert isinstance(X, _jax.Array)  # moved to device
+            np.testing.assert_array_equal(np.asarray(X),
+                                          np.full((2, 2), i, np.float32))
+
+    def test_prefetch_depth_zero_is_synchronous_passthrough(self):
+        items = [(np.zeros((1, 1), np.float32), k) for k in range(3)]
+        out = list(skio.prefetch_batches(iter(items), depth=0))
+        assert [k for _, k in out] == [0, 1, 2]
+
+    def test_prefetch_propagates_producer_exception_in_position(self):
+        def gen():
+            yield (np.zeros((1, 1), np.float32), 0)
+            yield (np.zeros((1, 1), np.float32), 1)
+            raise RuntimeError("stream broke")
+
+        it = skio.prefetch_batches(gen(), depth=2)
+        assert next(it)[1] == 0
+        assert next(it)[1] == 1
+        with pytest.raises(RuntimeError, match="stream broke"):
+            next(it)
+
+    def test_prefetch_consumer_abandon_does_not_hang(self):
+        produced = []
+
+        def gen():
+            for i in range(1000):
+                produced.append(i)
+                yield (np.zeros((1, 1), np.float32), i)
+
+        it = skio.prefetch_batches(gen(), depth=2)
+        next(it)
+        it.close()  # abandon early: worker must stop, not deadlock
+        assert len(produced) < 1000
+
+    def test_stream_sketch_libsvm_prefetch_matches_sync(self, tmp_path):
+        rng = np.random.default_rng(5)
+        lines = []
+        for i in range(40):
+            feats = " ".join(f"{j + 1}:{rng.standard_normal():.5f}"
+                             for j in range(6))
+            lines.append(f"{1 if i % 2 else -1} {feats}\n")
+        p = tmp_path / "data.libsvm"
+        p.write_text("".join(lines))
+        a = skio.stream_sketch_libsvm(str(p), 8, Context(seed=2),
+                                      batch_rows=16, prefetch_depth=2)
+        b = skio.stream_sketch_libsvm(str(p), 8, Context(seed=2),
+                                      batch_rows=16, prefetch_depth=0)
+        np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+        np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
 
 
 class TestReviewRegressions:

@@ -119,6 +119,35 @@ class TestApproximateSVD:
             out[rr] = np.asarray(S)
         np.testing.assert_allclose(out["cqr2"], out["svd"], rtol=1e-4)
 
+    @pytest.mark.parametrize("rr,ortho", [("cqr2", "cqr2"),
+                                          ("svd", "qr")])
+    def test_ill_conditioned_parity_near_f32_cqr_bound(self, rr, ortho):
+        """Parity at a spectrum spanning ~10× past the f32 CholeskyQR
+        textbook bound (cond ≲ 1/√ε ≈ 3e3): the top-k singular values
+        must match reference algebra (np.linalg.svd) at f32 grade for
+        BOTH the mesh-native default (cqr2/cqr2 — accurate far past the
+        textbook bound for the truncated spectra randomized SVD meets)
+        and the reference-algebra combination rr='svd', ortho='qr'
+        (Householder + direct panel SVD — the configuration to reach
+        for on EXTREME spectra; docs/nla.rst). ADVICE r5."""
+        rng = np.random.default_rng(2)
+        m, n, k = 512, 64, 8
+        Uq, _ = np.linalg.qr(rng.standard_normal((m, n)))
+        Vq, _ = np.linalg.qr(rng.standard_normal((n, n)))
+        s = np.logspace(0, -4.5, n)        # cond ≈ 3e4 ≈ 10/√ε_f32
+        A = (Uq * s) @ Vq.T
+        ref = np.linalg.svd(A, compute_uv=False)[:k]
+        U, S, V = nla.approximate_svd(
+            jnp.asarray(A, jnp.float32), k, Context(seed=13),
+            nla.ApproximateSVDParams(num_iterations=2, rr=rr,
+                                     ortho=ortho))
+        np.testing.assert_allclose(np.asarray(S), ref, rtol=1e-4)
+        # factors stay orthonormal through the ill-conditioned panels
+        np.testing.assert_allclose(np.asarray(U.T @ U), np.eye(k),
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(V.T @ V), np.eye(k),
+                                   atol=1e-4)
+
     def test_rr_invalid_value_raises(self):
         with pytest.raises(Exception, match="rr"):
             nla.approximate_svd(

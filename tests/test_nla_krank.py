@@ -203,8 +203,12 @@ class TestNonlinear:
         from libskylark_tpu.ml.nonlinear import SketchRLS
 
         X, y = _classification_data()
+        # 384 features: at 128 the accuracy sits ON the 75% threshold
+        # and flips with the toolchain's random-stream details (70-78%
+        # across seeds/jax versions); more features make the kernel
+        # approximation — the thing under test — robustly good
         model = SketchRLS(Gaussian(8, sigma=2.0)).train(
-            X[:200], y[:200], Context(seed=9), random_features=128,
+            X[:200], y[:200], Context(seed=9), random_features=384,
             regularization=0.01)
         pred = model.predict(X[200:])
         assert classification_accuracy(pred, y[200:]) > 75

@@ -281,14 +281,19 @@ def test_pipelined_variant_matches_plain(monkeypatch):
     A = jnp.asarray(
         np.random.default_rng(9).standard_normal((m, n)), jnp.float32
     )
+    # baselines at the SAME m_tile/scratch config as the pipelined runs
+    # below: XLA's CPU gemm may reassociate differently per program
+    # shape, so equality is only a pipeline-scheduling oracle when the
+    # two sides differ in NOTHING but the pipeline toggle
+    monkeypatch.setattr(pd, "_SCRATCH_CAP_BYTES", 0)
     plain = np.asarray(pd.rowwise_apply(
         jlt._alloc.key, jlt.dist, A, s, jlt.scale,
-        precision="f32", interpret=True))
+        m_tile=16, precision="f32", interpret=True))
     T = GaussianRFT(n, s, Context(seed=22), sigma=2.0)
     plain_cos = np.asarray(pd.rft_rowwise_apply(
         T.subkey(0), T.dist, A, s, T.inscale, T.outscale,
         np.asarray(T.row_scales()), np.asarray(T.shifts()),
-        precision="f32", interpret=True))
+        m_tile=16, precision="f32", interpret=True))
     A_c = jnp.asarray(
         np.random.default_rng(10).standard_normal((n, 48)), jnp.float32
     )
@@ -296,12 +301,9 @@ def test_pipelined_variant_matches_plain(monkeypatch):
     # sides would run the pipe kernel and a defect would self-compare)
     plain_c = np.asarray(pd.columnwise_apply(
         jlt._alloc.key, jlt.dist, A_c, s, jlt.scale,
-        precision="f32", interpret=True))
+        m_tile=16, precision="f32", interpret=True))
 
     monkeypatch.setenv("SKYLARK_PALLAS_PIPELINE", "1")
-    # tile smaller than m so the grid really sweeps; cache disabled so
-    # the pipe path engages
-    monkeypatch.setattr(pd, "_SCRATCH_CAP_BYTES", 0)
     piped = np.asarray(pd.rowwise_apply(
         jlt._alloc.key, jlt.dist, A, s, jlt.scale,
         m_tile=16, precision="f32", interpret=True))
@@ -415,15 +417,25 @@ def test_effective_plan_reports_actual_config(monkeypatch):
     _select_pipe can drop the pipeline buffer, so sweep records labeled
     with requested knobs would lie about the measurement (the m-tile
     sweep in benchmarks/ keys its rows off this)."""
+    from libskylark_tpu.sketch import params as sketch_params
+
     dist = randgen.Normal()
     monkeypatch.delenv("SKYLARK_PALLAS_PIPELINE", raising=False)
+    # isolate from the COMMITTED plan cache: on a v5e host the seeded
+    # flagship entry would hit the headline-shape workload below and
+    # flip plan_source to "cache" — this test pins the HEURISTIC report
+    monkeypatch.setattr(sketch_params, "_use_plan_cache", False)
 
     # headline shape, requested tile fits: honored, operator too big to
-    # cache (32 MiB > cap), no pipeline without the env
+    # cache (32 MiB > cap), no pipeline without the env. The plan also
+    # names itself (plan_id/precision/plan_source — the autotuner
+    # cache's reporting surface).
     p = pd.effective_plan(dist, (8192, 8192), jnp.float32, 1024,
                           seq_axis=1, m_tile=1024, interpret=True)
     assert p == {"kernel": True, "m_tile": 1024, "operator_cache": False,
-                 "pipelined": False}
+                 "pipelined": False, "precision": "bf16x3",
+                 "plan_id": "pallas/mt1024/bf16x3",
+                 "plan_source": "heuristic"}
 
     # requested tile exceeds the VMEM plan: pre-shrunk, and the plan says
     # so (this is the silent adjustment the record must surface)
@@ -446,7 +458,8 @@ def test_effective_plan_reports_actual_config(monkeypatch):
     # unsupported dtype: the apply would take the XLA fallback
     p = pd.effective_plan(dist, (1024, 1024), jnp.float64, 128,
                           seq_axis=1, m_tile=256, interpret=True)
-    assert p == {"kernel": False}
+    assert p == {"kernel": False, "plan_id": "xla",
+                 "plan_source": "heuristic"}
 
 
 def test_bf16gen2_regime_matches_rounded_operator_oracle():

@@ -32,7 +32,9 @@ def _rand(m, n, seed=0):
 # (tests/unit/test_utils.hpp:48) is an f64 bound; heavy-tailed frequency
 # draws (LaplacianRFT's Cauchy W can land |W|~1e3+) legitimately amplify
 # f32 partial-sum reorder to a few 1e-4, so those entries carry a
-# conditioning-scaled tolerance.
+# conditioning-scaled tolerance. ExpSemigroupRLT is the other amplifier:
+# its features are e^w with w up to ~30, so an f32 reorder wobble δ in w
+# lands as relative output error ≈ δ·|w|.
 ALL_TRANSFORMS = [
     (lambda N, S, ctx: sk.JLT(N, S, ctx), 1e-4),
     (lambda N, S, ctx: sk.CT(N, S, ctx, C=2.0), 1e-4),
@@ -44,7 +46,7 @@ ALL_TRANSFORMS = [
     (lambda N, S, ctx: sk.GaussianRFT(N, S, ctx, sigma=2.0), 1e-4),
     (lambda N, S, ctx: sk.LaplacianRFT(N, S, ctx, sigma=2.0), 1e-3),
     (lambda N, S, ctx: sk.MaternRFT(N, S, ctx, nu=1.5, l=2.0), 1e-4),
-    (lambda N, S, ctx: sk.ExpSemigroupRLT(N, S, ctx, beta=0.5), 1e-4),
+    (lambda N, S, ctx: sk.ExpSemigroupRLT(N, S, ctx, beta=0.5), 1e-3),
 ]
 
 
@@ -77,8 +79,11 @@ class TestShardedOracle:
         local = np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
         A_sharded = par.distribute(A, par.row_sharded(mesh1d))
         sharded = np.asarray(T.apply(A_sharded, sk.COLUMNWISE))
+        # the per-transform tolerance scales rtol too: the amplifying
+        # transforms' error is relative to huge feature values, where
+        # any atol is a no-op
         np.testing.assert_allclose(sharded, local, atol=max(ATOL, atol),
-                                   rtol=1e-4)
+                                   rtol=max(1e-4, atol))
 
     @pytest.mark.parametrize("make,atol", ALL_TRANSFORMS[:6])
     def test_grid2d_rowwise(self, make, atol, mesh2d):
