@@ -17,9 +17,11 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import jax.scipy.linalg as jsl
 
+from libskylark_tpu import engine
 from libskylark_tpu.algorithms import krylov
 from libskylark_tpu.algorithms.precond import MatPrecond, Precond, TriInversePrecond
 from libskylark_tpu.base import errors
@@ -86,14 +88,31 @@ def solve_l2_sketched(
     """Sketch-and-solve: compress rows of [A | B] with any columnwise sketch
     transform, then solve the small problem exactly
     (ref: sketched_regression_solver_Elemental.hpp — sketch to [STAR,STAR]
-    and solve locally; here the small problem is replicated by construction)."""
+    and solve locally; here the small problem is replicated by construction).
+
+    Dense operands run sketch + solve as one engine-compiled executable
+    (keyed on the transform's serialization digest); sparse operands and
+    calls inside a user jit take the direct path."""
+    from libskylark_tpu.base.sparse import is_sparse_operand
     from libskylark_tpu.sketch import COLUMNWISE
 
     B = jnp.asarray(B)
     squeeze = B.ndim == 1  # sketch apply promotes vectors to (N, 1)
-    SA = transform.apply(A, COLUMNWISE)
-    SB = transform.apply(B, COLUMNWISE)
-    X = solve_l2_exact(SA, SB, method=method)
+
+    def solve(A, B):
+        SA = transform.apply(A, COLUMNWISE)
+        SB = transform.apply(B, COLUMNWISE)
+        return solve_l2_exact(SA, SB, method=method)
+
+    if is_sparse_operand(A) or isinstance(A, jax.core.Tracer) \
+            or isinstance(B, jax.core.Tracer):
+        X = solve(A, B)
+    else:
+        cf = engine.compiled(
+            solve, name="solve_l2_sketched", donate_argnums=(0, 1),
+            donate="auto",
+            key_fn=lambda *a: (engine.digest(transform), method))
+        X = cf(jnp.asarray(A), B)
     return X[:, 0] if squeeze else X
 
 
@@ -111,27 +130,54 @@ class AcceleratedParams(Params):
     sketch: str = "fjlt"  # fjlt | jlt | cwt
 
 
+def _accel_transform(m: int, n: int, context: Context,
+                     params: AcceleratedParams, *, gaussian: bool = False):
+    """The row-compressing sketch of the accelerated family; allocated
+    eagerly (advances the Context counter) so the compiled solve phases
+    can be keyed on its serialization digest."""
+    from libskylark_tpu import sketch as sk
+
+    s = int(params.sketch_size_factor * n)
+    s = min(max(s, n + 1), m)
+    if gaussian:
+        return sk.JLT(m, s, context)
+    if params.sketch == "fjlt":
+        return sk.FJLT(m, s, context)
+    if params.sketch == "jlt":
+        return sk.JLT(m, s, context)
+    if params.sketch == "cwt":
+        return sk.CWT(m, max(s, 4 * n), context)
+    raise errors.InvalidParametersError(f"unknown sketch {params.sketch!r}")
+
+
+def _blendenpik_r(A, T) -> jnp.ndarray:
+    """R factor of the sketched operand — the right preconditioner
+    (ref: accelerated_linearl2_regression_solver_Elemental.hpp:68-77)."""
+    from libskylark_tpu import sketch as sk
+
+    SA = T.apply(A, sk.COLUMNWISE)
+    return jnp.linalg.qr(SA, mode="r")
+
+
+def _lsrn_parts(A, T) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """LSRN preconditioner N = V·Σ⁻¹ from the SVD of the sketch
+    (ref: accelerated_linearl2_regression_solver.hpp lsrn_tag)."""
+    from libskylark_tpu import sketch as sk
+
+    SA = T.apply(A, sk.COLUMNWISE)
+    _, sv, Vt = jnp.linalg.svd(SA, full_matrices=False)
+    Ninv = Vt.T * (1.0 / jnp.maximum(sv, sv[0] * jnp.finfo(SA.dtype).eps))[None, :]
+    return Ninv, sv
+
+
 @with_solver_precision
 def build_blendenpik_precond(
     A: jnp.ndarray, context: Context, params: AcceleratedParams
 ) -> tuple[Precond, jnp.ndarray]:
     """Sketch A and QR the sketch; R is the right preconditioner
     (ref: accelerated_linearl2_regression_solver_Elemental.hpp:68-77)."""
-    from libskylark_tpu import sketch as sk
-
-    m, n = A.shape
-    s = int(params.sketch_size_factor * n)
-    s = min(max(s, n + 1), m)
-    if params.sketch == "fjlt":
-        T = sk.FJLT(m, s, context)
-    elif params.sketch == "jlt":
-        T = sk.JLT(m, s, context)
-    elif params.sketch == "cwt":
-        T = sk.CWT(m, max(s, 4 * n), context)
-    else:
-        raise errors.InvalidParametersError(f"unknown sketch {params.sketch!r}")
-    SA = T.apply(A, sk.COLUMNWISE)
-    R = jnp.linalg.qr(SA, mode="r")
+    T = _accel_transform(*A.shape, context, params)
+    R = _blendenpik_r(A, T)
     return TriInversePrecond(R), R
 
 
@@ -141,15 +187,8 @@ def build_lsrn_precond(
 ) -> tuple[Precond, jnp.ndarray]:
     """LSRN: Gaussian sketch, SVD of the sketch, precond N = V·Σ⁻¹
     (ref: accelerated_linearl2_regression_solver.hpp lsrn_tag)."""
-    from libskylark_tpu import sketch as sk
-
-    m, n = A.shape
-    s = int(params.sketch_size_factor * n)
-    s = min(max(s, n + 1), m)
-    T = sk.JLT(m, s, context)
-    SA = T.apply(A, sk.COLUMNWISE)
-    _, sv, Vt = jnp.linalg.svd(SA, full_matrices=False)
-    Ninv = Vt.T * (1.0 / jnp.maximum(sv, sv[0] * jnp.finfo(A.dtype).eps))[None, :]
+    T = _accel_transform(*A.shape, context, params, gaussian=True)
+    Ninv, sv = _lsrn_parts(A, T)
     return MatPrecond(Ninv), sv
 
 
@@ -171,7 +210,13 @@ def solve_l2_accelerated(
     :class:`DistSparseMatrix` — sparse operands default the sketch to CWT
     (the reference's sparse-input path; the FJLT needs a dense fast
     transform) and run LSQR through the sparse matvecs.
-    """
+
+    Dense operands run as TWO engine-compiled executables — the
+    precond-build phase (sketch → factor → condition estimate) and the
+    LSQR ``lax.while_loop`` phase — with exactly one scalar host sync
+    between them: the reference's CondEst fallback decision
+    (ref: :241-253), which is a genuine host branch (the fallback
+    traces a completely different program)."""
     from libskylark_tpu.base.sparse import is_sparse_operand
 
     params = params or AcceleratedParams()
@@ -182,19 +227,45 @@ def solve_l2_accelerated(
     else:
         A = jnp.asarray(A)
     B = jnp.asarray(B)
+    use_engine = (not is_sparse
+                  and not isinstance(A, jax.core.Tracer)
+                  and not isinstance(B, jax.core.Tracer))
 
     if method in ("blendenpik", "simplified_blendenpik"):
-        if method == "simplified_blendenpik":
-            p2 = dataclasses.replace(params, sketch="cwt")
-            precond, R = build_blendenpik_precond(A, context, p2)
+        p2 = (dataclasses.replace(params, sketch="cwt")
+              if method == "simplified_blendenpik" else params)
+        if use_engine:
+            T = _accel_transform(*A.shape, context, p2)
+
+            def build(A):
+                R = _blendenpik_r(A, T)
+                # Condition of the small R factor — the reference runs
+                # CondEst and falls back to exact SVD (ref: :241-253).
+                return R, jnp.linalg.cond(R)
+
+            P, cond = engine.compiled(
+                build, name="ls_accel_precond",
+                key_fn=lambda *a: (engine.digest(T), method))(A)
+            make_precond = TriInversePrecond
         else:
-            precond, R = build_blendenpik_precond(A, context, params)
-        # Condition of the small R factor — the reference runs CondEst
-        # and falls back to the exact SVD solver (ref: :241-253).
-        cond = jnp.linalg.cond(R)
+            precond, R = build_blendenpik_precond(A, context, p2)
+            cond = jnp.linalg.cond(R)
     elif method == "lsrn":
-        precond, sv = build_lsrn_precond(A, context, params)
-        cond = sv[0] / jnp.maximum(sv[-1], jnp.finfo(A.dtype).tiny)
+        if use_engine:
+            T = _accel_transform(*A.shape, context, params, gaussian=True)
+
+            def build(A):
+                Ninv, sv = _lsrn_parts(A, T)
+                return Ninv, sv[0] / jnp.maximum(sv[-1],
+                                                 jnp.finfo(sv.dtype).tiny)
+
+            P, cond = engine.compiled(
+                build, name="ls_accel_precond",
+                key_fn=lambda *a: (engine.digest(T), method))(A)
+            make_precond = MatPrecond
+        else:
+            precond, sv = build_lsrn_precond(A, context, params)
+            cond = sv[0] / jnp.maximum(sv[-1], jnp.finfo(A.dtype).tiny)
     else:
         raise errors.InvalidParametersError(f"unknown accelerated method {method!r}")
 
@@ -204,4 +275,12 @@ def solve_l2_accelerated(
         return solve_l2_exact(Ad, B, method="svd"), jnp.int32(0)
 
     kp = krylov.KrylovParams(tolerance=params.tolerance, iter_lim=params.iter_lim)
+    if use_engine:
+        def run_lsqr(A, B, P):
+            return krylov.lsqr(A, B, params=kp, precond=make_precond(P))
+
+        return engine.compiled(
+            run_lsqr, name="ls_accel_lsqr", donate_argnums=(1,),
+            donate="auto",
+            key_fn=lambda *a: (method, kp.tolerance, kp.iter_lim))(A, B, P)
     return krylov.lsqr(A, B, params=kp, precond=precond)

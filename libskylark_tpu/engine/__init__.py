@@ -1,0 +1,40 @@
+"""Solver-pipeline compilation engine: compile once, serve many.
+
+The NLA/ML layers' headline algorithms (randomized SVD, sketch-
+preconditioned least squares, random-features KRR) are whole-solver
+``jax.jit`` programs served from a donation-aware executable cache —
+the layer above the sketch-apply autotuner (:mod:`libskylark_tpu.tune`):
+tune certifies *kernel plans*, the engine caches the *compiled solver
+executables* whose keys include the plan fingerprint, so a certified
+plan change recompiles exactly the affected pipelines.
+
+Public surface::
+
+    compiled(fn, static_argnames=..., donate_argnums=..., key_fn=...)
+    stats() / reset()          # hit/miss/recompile/compile-time counters
+    cache()                    # the LRU itself (snapshot, keys)
+    donation_enabled() / maybe_donate(argnums)
+    enable_persistent_cache()  # jax.experimental.compilation_cache wiring
+    dump_stats(path)           # the CI jit-leak gate's exit artifact
+
+Environment: ``SKYLARK_EXEC_CACHE_SIZE`` (LRU capacity, default 128),
+``SKYLARK_EXEC_CACHE_DIR`` (persistent cross-process cache dir),
+``SKYLARK_ENGINE_DONATE=1`` (solver entry points donate operands),
+``SKYLARK_ENGINE_STATS_DUMP`` (write counters JSON at process exit).
+"""
+
+from libskylark_tpu.engine.cache import (CacheEntry, EngineStats,
+                                         ExecutableCache)
+from libskylark_tpu.engine.compiled import (CompiledFn, cache, code_version,
+                                            compiled, digest,
+                                            donation_enabled, dump_stats,
+                                            enable_persistent_cache,
+                                            maybe_donate, plan_fingerprint,
+                                            reset, stats)
+
+__all__ = [
+    "CacheEntry", "CompiledFn", "EngineStats", "ExecutableCache", "cache",
+    "code_version", "compiled", "digest", "donation_enabled", "dump_stats",
+    "enable_persistent_cache", "maybe_donate", "plan_fingerprint", "reset",
+    "stats",
+]

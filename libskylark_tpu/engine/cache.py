@@ -1,0 +1,150 @@
+"""In-process executable cache: LRU over compiled solver programs.
+
+One entry = one XLA executable, AOT-compiled (``jit(...).lower(...)
+.compile()``) so an entry can never silently recompile — every backend
+compile in the engine goes through :meth:`ExecutableCache.put`, which
+makes the cache's own counters *the* compile counters (the jit-leak CI
+gate and the recompile-guard tests key off them).
+
+Counter vocabulary:
+
+``hits`` / ``misses``
+    lookup outcomes; a miss is always followed by exactly one compile.
+``recompiles``
+    misses whose key was compiled before in this process — either LRU
+    thrash (evicted then needed again) or key churn (a key component
+    flapping between two values). The CI jit-leak gate asserts this
+    stays 0 across the tier-1 solver tests.
+``evictions``
+    LRU entries dropped at capacity (``SKYLARK_EXEC_CACHE_SIZE``,
+    default 128 executables).
+``compile_seconds`` / ``execute_seconds``
+    cumulative wall time split the bench reports per solver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+from typing import Any, Hashable, Optional
+
+
+@dataclasses.dataclass
+class EngineStats:
+    """Mutable counter block; one global instance plus one per wrapped
+    solver (``CompiledFn.stats``)."""
+
+    hits: int = 0
+    misses: int = 0
+    recompiles: int = 0
+    evictions: int = 0
+    executions: int = 0
+    compile_seconds: float = 0.0
+    execute_seconds: float = 0.0
+
+    def hit_rate(self) -> Optional[float]:
+        n = self.hits + self.misses
+        return (self.hits / n) if n else None
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["hit_rate"] = self.hit_rate()
+        return d
+
+    def reset(self) -> None:
+        self.hits = self.misses = self.recompiles = 0
+        self.evictions = self.executions = 0
+        self.compile_seconds = self.execute_seconds = 0.0
+
+    def merge(self, other: "EngineStats") -> None:
+        """Accumulate ``other`` into this block (the lifetime rollup)."""
+        self.hits += other.hits
+        self.misses += other.misses
+        self.recompiles += other.recompiles
+        self.evictions += other.evictions
+        self.executions += other.executions
+        self.compile_seconds += other.compile_seconds
+        self.execute_seconds += other.execute_seconds
+
+
+@dataclasses.dataclass
+class CacheEntry:
+    """One compiled executable plus its provenance."""
+
+    executable: Any           # jax.stages.Compiled
+    name: str                 # wrapped solver name
+    compile_seconds: float
+    calls: int = 0
+
+
+class ExecutableCache:
+    """Thread-safe LRU of :class:`CacheEntry` keyed on the engine's
+    static key tuples. ``seen`` remembers every key ever compiled in
+    this process so a re-compile of a previously-compiled key (thrash)
+    is distinguishable from a first compile."""
+
+    def __init__(self, maxsize: int = 128):
+        self.maxsize = int(maxsize)
+        self._entries: "OrderedDict[Hashable, CacheEntry]" = OrderedDict()
+        self._seen: set = set()
+        self._lock = threading.Lock()
+        self.stats = EngineStats()
+        # counters folded in at every reset(): the process-lifetime view
+        # the CI jit-leak gate reads, immune to tests zeroing `stats`
+        self.lifetime = EngineStats()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def lookup(self, key: Hashable) -> Optional[CacheEntry]:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.stats.hits += 1
+                return entry
+            self.stats.misses += 1
+            if key in self._seen:
+                self.stats.recompiles += 1
+            return None
+
+    def insert(self, key: Hashable, entry: CacheEntry) -> None:
+        with self._lock:
+            self._seen.add(key)
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            self.stats.compile_seconds += entry.compile_seconds
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
+                self.stats.evictions += 1
+
+    def clear(self) -> None:
+        """Drop all executables (the ``seen`` set survives — a post-clear
+        recompile is still thrash from the gate's point of view; use
+        :meth:`reset` for a clean slate)."""
+        with self._lock:
+            self._entries.clear()
+
+    def reset(self) -> None:
+        """Full reset: entries, seen-keys, and counters (tests). The
+        window's counters roll into ``lifetime`` first — thrash cannot
+        be erased by resetting."""
+        with self._lock:
+            self._entries.clear()
+            self._seen.clear()
+            self.lifetime.merge(self.stats)
+            self.stats.reset()
+
+    def keys(self) -> list:
+        with self._lock:
+            return list(self._entries.keys())
+
+    def snapshot(self) -> list[dict]:
+        """Per-entry provenance for bench/debug output."""
+        with self._lock:
+            return [
+                {"name": e.name, "calls": e.calls,
+                 "compile_seconds": round(e.compile_seconds, 4)}
+                for e in self._entries.values()
+            ]

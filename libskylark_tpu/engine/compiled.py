@@ -1,0 +1,393 @@
+"""``engine.compiled`` — whole-solver compilation with an explicit cache.
+
+``compiled(fn, ...)`` wraps a pure solver pipeline in ``jax.jit`` with
+explicit static arguments and (opt-in) buffer donation, AOT-compiles it
+(``lower().compile()``) and serves the executable from an in-process
+LRU (:mod:`libskylark_tpu.engine.cache`). The cache key is explicit —
+nothing is left to jit's implicit closure identity, so two *different*
+transform objects with the same (seed, counter) share one executable,
+and a plan-cache edit (``tune``) invalidates exactly the executables
+whose dispatch it could change:
+
+    (solver name, code-version hash, static args, key_fn extras,
+     abstract shapes/dtypes, sharding/mesh fingerprint,
+     autotuner plan fingerprint, solver-precision regime, backend)
+
+The AOT discipline buys a hard property: an entry can never silently
+recompile — ``jax.stages.Compiled`` raises on a signature mismatch
+instead of re-tracing — so the engine's miss counter is exactly the
+process's solver-compile counter, which the recompile-guard tests and
+the CI jit-leak gate rely on.
+
+Donation: callers opt in per-site (``donate_argnums``) and globally
+(``SKYLARK_ENGINE_DONATE=1`` flips :func:`donation_enabled`, which the
+solver entry points consult via :func:`maybe_donate`). Donated operands
+are consumed — the caller's array is invalidated on every backend,
+including CPU. The tier-1 default is off because the public solvers
+take *user* operands (docs/performance.rst, "donation caveats").
+
+Cross-process reuse rides jax's persistent compilation cache:
+``SKYLARK_EXEC_CACHE_DIR=<dir>`` wires
+``jax.experimental.compilation_cache`` at first engine compile, so a
+serve-many process pays tracing but not XLA backend compilation for
+executables certified by an earlier process.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import inspect
+import json
+import os
+import time
+from typing import Callable, Optional, Sequence
+
+import jax
+
+from libskylark_tpu.engine.cache import CacheEntry, EngineStats, ExecutableCache
+
+# ---------------------------------------------------------------------------
+# global cache + policy switches
+# ---------------------------------------------------------------------------
+
+
+def _cache_size() -> int:
+    try:
+        n = int(os.environ.get("SKYLARK_EXEC_CACHE_SIZE", "128"))
+        return n if n > 0 else 128
+    except ValueError:
+        return 128
+
+
+_CACHE = ExecutableCache(maxsize=_cache_size())
+
+
+def cache() -> ExecutableCache:
+    """The process-global executable cache."""
+    return _CACHE
+
+
+def stats() -> EngineStats:
+    """Global engine counters (hits/misses/recompiles/compile time)."""
+    return _CACHE.stats
+
+
+def reset() -> None:
+    """Drop every executable and zero the counters (tests/benches)."""
+    _CACHE.reset()
+
+
+def donation_enabled() -> bool:
+    """Whether solver entry points donate their operands
+    (``SKYLARK_ENGINE_DONATE=1``). Off by default: donation invalidates
+    the caller's arrays (on every backend, CPU included)."""
+    return os.environ.get("SKYLARK_ENGINE_DONATE", "0") == "1"
+
+
+def maybe_donate(argnums: Sequence[int]) -> tuple[int, ...]:
+    """``argnums`` when donation is enabled, else ``()`` — the one-line
+    policy the solver entry points use for their donate_argnums."""
+    return tuple(argnums) if donation_enabled() else ()
+
+
+# ---------------------------------------------------------------------------
+# persistent (cross-process) compilation cache wiring
+# ---------------------------------------------------------------------------
+
+_persistent_wired = False
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> bool:
+    """Wire jax's persistent compilation cache at ``path`` (or
+    ``SKYLARK_EXEC_CACHE_DIR``). Returns whether wiring happened. Never
+    raises — the persistent cache is an optimization, not a failure
+    mode."""
+    global _persistent_wired
+    path = path or os.environ.get("SKYLARK_EXEC_CACHE_DIR")
+    if not path or path.strip().lower() in ("0", "off", "no", "false"):
+        return False
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        try:
+            # jax memoizes a "cache disabled" decision at the first
+            # compile; dropping it makes the next compile re-read the
+            # config — without this, wiring after any eager op (key
+            # fold_in, a warm-up) is silently a no-op
+            from jax.experimental.compilation_cache import compilation_cache
+
+            compilation_cache.reset_cache()
+        except Exception:
+            pass
+        try:
+            # lower than bench.py's 1.0s TPU threshold: solver pipeline
+            # executables backend-compile in well under a second on CPU
+            # hosts yet are exactly the artifacts worth persisting for
+            # the serve-many processes
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.1)
+        except Exception:
+            pass
+        _persistent_wired = True
+        return True
+    except Exception:
+        return False
+
+
+def _maybe_wire_persistent() -> None:
+    global _persistent_wired
+    if not _persistent_wired and "SKYLARK_EXEC_CACHE_DIR" in os.environ:
+        _persistent_wired = True  # one attempt per process
+        enable_persistent_cache()
+
+
+# ---------------------------------------------------------------------------
+# cache-key components
+# ---------------------------------------------------------------------------
+
+_code_hashes: dict[str, str] = {}
+
+
+def _file_hash(path: str) -> str:
+    h = _code_hashes.get(path)
+    if h is None:
+        try:
+            with open(path, "rb") as fh:
+                h = hashlib.sha256(fh.read()).hexdigest()[:16]
+        except OSError:
+            h = "unreadable"
+        _code_hashes[path] = h
+    return h
+
+
+def code_version(fn: Callable) -> str:
+    """Code-version component of the cache key: a hash over the wrapped
+    solver's defining module plus the engine's own sources, so editing
+    either invalidates persisted executables keyed on it (the
+    cross-process analog of "recompile after a code change")."""
+    paths = [__file__, os.path.join(os.path.dirname(__file__), "cache.py")]
+    try:
+        src = inspect.getsourcefile(fn)
+        if src:
+            paths.append(src)
+    except TypeError:
+        pass
+    return "-".join(_file_hash(p) for p in paths)
+
+
+def plan_fingerprint() -> str:
+    """The autotuner plan cache's content fingerprint
+    (:func:`libskylark_tpu.tune.plan_fingerprint` — one implementation,
+    re-exported here for the key path): part of every engine key, so a
+    certified-plan change triggers — and a no-op write avoids —
+    recompilation. Never raises: a broken plan cache must not take down
+    a solver call."""
+    try:
+        from libskylark_tpu import tune
+
+        return tune.plan_fingerprint()
+    except Exception:
+        return "no-plan-cache"
+
+
+def digest(obj) -> str:
+    """Stable identity of a closed-over collaborator (sketch transform,
+    kernel, params block) for ``key_fn`` extras: the hash of its JSON
+    serialization when it has one (``to_json`` — transforms serialize
+    their (seed, counter) creation context, kernels their
+    hyperparameters), else its ``repr``. Two transform *objects* with
+    the same serialization are the same pure function of the input —
+    and share one executable."""
+    try:
+        doc = obj.to_json()
+    except AttributeError:
+        doc = repr(obj)
+    return hashlib.sha256(str(doc).encode()).hexdigest()[:16]
+
+
+def _precision_fingerprint() -> tuple:
+    from libskylark_tpu.base import precision
+
+    try:
+        ambient = precision.ambient_matmul_precision()
+    except Exception:
+        ambient = None
+    return (precision.get_solver_precision(), str(ambient))
+
+
+def _aval_key(x) -> tuple:
+    shape = tuple(getattr(x, "shape", ()))
+    dtype = str(getattr(x, "dtype", type(x).__name__))
+    return (shape, dtype)
+
+
+def _sharding_key(x) -> str:
+    try:
+        return str(x.sharding)
+    except Exception:
+        return "unsharded"
+
+
+# ---------------------------------------------------------------------------
+# the wrapper
+# ---------------------------------------------------------------------------
+
+
+class CompiledFn:
+    """A solver pipeline bound to the executable cache. Call it like the
+    wrapped function; statics go by keyword (``static_argnames``),
+    everything positional is a traced array."""
+
+    def __init__(self, fn: Callable, *, static_argnames: Sequence[str] = (),
+                 donate_argnums: Sequence[int] = (),
+                 donate: str = "explicit",
+                 key_fn: Optional[Callable] = None,
+                 name: Optional[str] = None):
+        if donate not in ("explicit", "auto"):
+            raise ValueError(f"donate must be 'explicit' or 'auto', "
+                             f"got {donate!r}")
+        self._fn = fn
+        self._static_argnames = tuple(static_argnames)
+        self._donate_argnums = tuple(donate_argnums)
+        self._donate_mode = donate
+        self._key_fn = key_fn
+        self.name = name or getattr(fn, "__qualname__", repr(fn))
+        self.stats = EngineStats()
+        self._code_version = None
+        functools.update_wrapper(self, fn)
+
+    # -- key --
+
+    def _effective_donate(self) -> tuple[int, ...]:
+        """``donate="auto"`` sites (the public solver entry points)
+        donate only when the user opted in (SKYLARK_ENGINE_DONATE=1);
+        "explicit" sites always honor their argnums. The effective
+        tuple is part of the cache key — flipping the opt-in mid-
+        process keys a fresh executable rather than mis-serving one
+        with the wrong aliasing contract."""
+        if self._donate_mode == "auto" and not donation_enabled():
+            return ()
+        return self._donate_argnums
+
+    def _key(self, args, statics, kwargs, donate_argnums) -> tuple:
+        if self._code_version is None:
+            self._code_version = code_version(self._fn)
+        extra = self._key_fn(*args, **kwargs) if self._key_fn else ()
+        return (
+            self.name,
+            self._code_version,
+            statics,
+            extra,
+            tuple(_aval_key(a) for a in args),
+            tuple(_sharding_key(a) for a in args),
+            donate_argnums,
+            plan_fingerprint(),
+            _precision_fingerprint(),
+            jax.default_backend(),
+        )
+
+    # -- call --
+
+    def __call__(self, *args, **kwargs):
+        import jax.numpy as jnp
+
+        statics = tuple(
+            (k, kwargs[k]) for k in self._static_argnames if k in kwargs
+        )
+        unknown = set(kwargs) - set(self._static_argnames)
+        if unknown:
+            raise TypeError(
+                f"engine.compiled({self.name}): dynamic arguments must be "
+                f"positional; got keyword {sorted(unknown)!r}")
+        args = tuple(
+            a if isinstance(a, jax.Array) else jnp.asarray(a) for a in args
+        )
+        donate_argnums = self._effective_donate()
+        key = self._key(args, statics, kwargs, donate_argnums)
+        entry = _CACHE.lookup(key)
+        if entry is None:
+            self.stats.misses += 1
+            _maybe_wire_persistent()
+            t0 = time.perf_counter()
+            jitted = jax.jit(
+                self._fn,
+                static_argnames=self._static_argnames or None,
+                donate_argnums=donate_argnums or None,
+            )
+            executable = jitted.lower(*args, **kwargs).compile()
+            dt = time.perf_counter() - t0
+            self.stats.compile_seconds += dt
+            entry = CacheEntry(executable=executable, name=self.name,
+                               compile_seconds=dt)
+            _CACHE.insert(key, entry)
+        else:
+            self.stats.hits += 1
+        t0 = time.perf_counter()
+        out = entry.executable(*args)
+        dt = time.perf_counter() - t0  # dispatch wall; async past this
+        entry.calls += 1
+        self.stats.executions += 1
+        self.stats.execute_seconds += dt
+        _CACHE.stats.executions += 1
+        _CACHE.stats.execute_seconds += dt
+        return out
+
+
+def compiled(fn: Optional[Callable] = None, *,
+             static_argnames: Sequence[str] = (),
+             donate_argnums: Sequence[int] = (),
+             donate: str = "explicit",
+             key_fn: Optional[Callable] = None,
+             name: Optional[str] = None):
+    """Wrap ``fn`` (usable as a decorator) in the donation-aware
+    executable cache. See the module docstring for key anatomy."""
+    if fn is None:
+        return functools.partial(
+            compiled, static_argnames=static_argnames,
+            donate_argnums=donate_argnums, donate=donate, key_fn=key_fn,
+            name=name)
+    return CompiledFn(fn, static_argnames=static_argnames,
+                      donate_argnums=donate_argnums, donate=donate,
+                      key_fn=key_fn, name=name)
+
+
+# ---------------------------------------------------------------------------
+# stats dump (CI jit-leak gate)
+# ---------------------------------------------------------------------------
+
+
+def dump_stats(path: str) -> None:
+    """Write global counters + per-entry snapshot as JSON (atomic).
+    ``lifetime`` is the reset-proof rollup (current window included) —
+    what the CI jit-leak gate reads."""
+    lifetime = EngineStats()
+    lifetime.merge(_CACHE.lifetime)
+    lifetime.merge(_CACHE.stats)
+    doc = {"stats": _CACHE.stats.to_dict(),
+           "lifetime": lifetime.to_dict(),
+           "entries": _CACHE.snapshot(),
+           "cache_size": len(_CACHE)}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def _install_stats_dump() -> None:
+    path = os.environ.get("SKYLARK_ENGINE_STATS_DUMP")
+    if not path:
+        return
+    import atexit
+
+    atexit.register(lambda: _try_dump(path))
+
+
+def _try_dump(path: str) -> None:
+    try:
+        dump_stats(path)
+    except Exception:
+        pass
+
+
+_install_stats_dump()

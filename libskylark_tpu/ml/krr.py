@@ -21,7 +21,18 @@ Convention: rows are examples — X is (n, d), Y is (n, t); feature maps apply
 ROWWISE giving Z (n, s); W is (s, t); Gram coefficients A are (n, t). This is
 the reference's ``direction == base::ROWS`` orientation; the COLUMNS variant
 is a transpose away and not duplicated.
-"""
+
+Every regime runs as ONE compiled program per (shapes, feature maps,
+solver knobs) class, served from the :mod:`libskylark_tpu.engine`
+executable cache — the feature maps are allocated eagerly (they are
+part of the returned model and advance the Context counter exactly
+once), then the solve itself is a single device dispatch. Iterative
+regimes keep their convergence state device-resident: ``faster_``'s PCG
+is the :func:`libskylark_tpu.algorithms.krylov.cg` ``lax.while_loop``,
+and ``large_scale_``'s BCD sweeps are a ``lax.while_loop`` whose carry
+holds the block solutions, the residual, and the relative-update scalar
+— zero host round-trips per iteration (the old implementation synced
+``float(jnp.sum(...))`` on every sweep)."""
 
 from __future__ import annotations
 
@@ -29,9 +40,12 @@ import dataclasses
 import math
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import jax.scipy.linalg as jsl
+from jax import lax
 
+from libskylark_tpu import engine
 from libskylark_tpu.algorithms.krylov import KrylovParams, cg
 from libskylark_tpu.algorithms.precond import FunctionPrecond, IdPrecond
 from libskylark_tpu.base.context import Context
@@ -58,7 +72,7 @@ def _feature_tag(params: KrrParams) -> str:
     return "fast" if params.use_fast else "regular"
 
 
-def _ridge_solve(Z: jnp.ndarray, Y: jnp.ndarray, lam: float) -> jnp.ndarray:
+def _ridge_solve(Z: jnp.ndarray, Y: jnp.ndarray, lam) -> jnp.ndarray:
     """W = argmin ‖Z·W − Y‖²_F + λ‖W‖²_F (the El::Ridge(√λ) analog)."""
     s = Z.shape[1]
     G = Z.T @ Z + lam * jnp.eye(s, dtype=Z.dtype)
@@ -79,6 +93,27 @@ def _split_sizes(s: int, d: int, max_split: int) -> list[int]:
     return sizes
 
 
+def _is_tracer(*arrays) -> bool:
+    return any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
+def _run_compiled(fn, name, extras, X, Y, lam):
+    """One-executable dispatch of a solver program closed over its
+    (eagerly allocated) feature maps. Inside a user jit the program is
+    inlined — the outer trace owns compilation; otherwise the global
+    executable cache serves it, keyed on the closure's collaborator
+    digests (``extras``) rather than closure object identity, so two
+    calls with feature maps of the same (seed, counter) share one
+    executable. Operand donation is opt-in (donate="auto")."""
+    lam = jnp.asarray(lam, X.dtype)
+    if _is_tracer(X, Y, lam):
+        return fn(X, Y, lam)
+    cf = engine.compiled(fn, name=name, donate_argnums=(0, 1),
+                         donate="auto",
+                         key_fn=lambda *a, **k: extras)
+    return cf(X, Y, lam)
+
+
 @with_solver_precision
 def kernel_ridge(
     k: Kernel,
@@ -92,11 +127,18 @@ def kernel_ridge(
     params = params or KrrParams()
     X = jnp.asarray(X)
     Y = jnp.asarray(Y)
-    n = X.shape[0]
-    K = k.symmetric_gram(X) + lam * jnp.eye(n, dtype=X.dtype)
+    if Y.ndim == 1:
+        Y = Y[:, None]
     params.log(1, "kernel_ridge: solving (K + lambda I) A = Y")
-    L = jsl.cholesky(K, lower=True)
-    return jsl.cho_solve((L, True), Y if Y.ndim > 1 else Y[:, None])
+
+    def solve(X, Y, lam):
+        n = X.shape[0]
+        K = k.symmetric_gram(X) + lam * jnp.eye(n, dtype=X.dtype)
+        L = jsl.cholesky(K, lower=True)
+        return jsl.cho_solve((L, True), Y)
+
+    return _run_compiled(solve, "kernel_ridge", (engine.digest(k),),
+                         X, Y, lam)
 
 
 @with_solver_precision
@@ -122,22 +164,30 @@ def approximate_kernel_ridge(
     if Y.ndim == 1:
         Y = Y[:, None]
     S = k.create_rft(s, context, _feature_tag(params))
-    Z = S.apply(X, sk.ROWWISE)
-
     if params.sketched_rr:
-        n = Z.shape[0]
+        n = X.shape[0]
         t = 4 * s if params.sketch_size == -1 else params.sketch_size
         R = (
             sk.CWT(n, t, context)
             if params.fast_sketch
             else sk.FJLT(n, t, context)
         )
-        SZ = R.apply(Z, sk.COLUMNWISE)
-        SY = R.apply(Y, sk.COLUMNWISE)
     else:
-        SZ, SY = Z, Y
+        R = None
 
-    W = _ridge_solve(SZ, SY, lam)
+    def solve(X, Y, lam):
+        Z = S.apply(X, sk.ROWWISE)
+        if R is not None:
+            SZ = R.apply(Z, sk.COLUMNWISE)
+            SY = R.apply(Y, sk.COLUMNWISE)
+        else:
+            SZ, SY = Z, Y
+        return _ridge_solve(SZ, SY, lam)
+
+    W = _run_compiled(
+        solve, "approximate_kernel_ridge",
+        (engine.digest(S), None if R is None else engine.digest(R)),
+        X, Y, lam)
     return S, W
 
 
@@ -169,18 +219,24 @@ def sketched_approximate_kernel_ridge(
     t = 4 * s if t == -1 else t
 
     R = sk.CWT(n, t, context) if params.fast_sketch else sk.FJLT(n, t, context)
-    SY = R.apply(Y, sk.COLUMNWISE)
+    transforms = [
+        k.create_rft(thiss, context, _feature_tag(params))
+        for thiss in _split_sizes(s, d, params.max_split)
+    ]
 
-    transforms = []
-    blocks = []
-    for thiss in _split_sizes(s, d, params.max_split):
-        S = k.create_rft(thiss, context, _feature_tag(params))
-        transforms.append(S)
-        Z = S.apply(X, sk.ROWWISE) * math.sqrt(thiss / s)
-        blocks.append(R.apply(Z, sk.COLUMNWISE))  # (t, thiss)
-    SZ = jnp.concatenate(blocks, axis=1)  # (t, s)
+    def solve(X, Y, lam):
+        SY = R.apply(Y, sk.COLUMNWISE)
+        blocks = []
+        for S in transforms:
+            Z = S.apply(X, sk.ROWWISE) * math.sqrt(S.sketch_dim / s)
+            blocks.append(R.apply(Z, sk.COLUMNWISE))  # (t, s_c)
+        SZ = jnp.concatenate(blocks, axis=1)  # (t, s)
+        return _ridge_solve(SZ, SY, lam)
 
-    W = _ridge_solve(SZ, SY, lam)
+    W = _run_compiled(
+        solve, "sketched_approximate_kernel_ridge",
+        (engine.digest(R),) + tuple(engine.digest(S) for S in transforms),
+        X, Y, lam)
     return transforms, W
 
 
@@ -189,6 +245,11 @@ class FeatureMapPrecond(FunctionPrecond):
     (ref: ml/krr.hpp:310-398 feature_map_precond_t): with U = (s, n) features,
     approximate K ≈ UᵀU, so apply (λI + UᵀU)⁻¹ via SMW:
     P(B) = B/λ − Uᵀ·(I + U·Uᵀ/λ)⁻¹·(U·B)/λ².
+
+    :meth:`from_features` builds the same preconditioner from an
+    already-applied feature matrix — the form the compiled
+    ``faster_kernel_ridge`` pipeline uses inside its trace (the SMW
+    algebra lives HERE, once).
     """
 
     def __init__(self, k, lam, X, s, context, use_fast: bool = False):
@@ -196,15 +257,24 @@ class FeatureMapPrecond(FunctionPrecond):
 
         X = jnp.asarray(X)
         S = k.create_rft(s, context, "fast" if use_fast else "regular")
-        U = S.apply(X, sk.ROWWISE).T  # (s, n)
-        C = jnp.eye(s, dtype=U.dtype) + (U @ U.T) / lam
+        self._init_from_features(S.apply(X, sk.ROWWISE).T, lam)
+
+    @classmethod
+    def from_features(cls, U: jnp.ndarray, lam) -> "FeatureMapPrecond":
+        """Preconditioner from a pre-computed (s, n) feature matrix."""
+        self = cls.__new__(cls)
+        self._init_from_features(U, lam)
+        return self
+
+    def _init_from_features(self, U: jnp.ndarray, lam) -> None:
+        C = jnp.eye(U.shape[0], dtype=U.dtype) + (U @ U.T) / lam
         L = jsl.cholesky(C, lower=True)
 
         def apply(B):
             CUB = jsl.cho_solve((L, True), U @ B)
             return B / lam - (U.T @ CUB) / (lam * lam)
 
-        super().__init__(apply)
+        FunctionPrecond.__init__(self, apply)
         self.U = U
         self.L = L
         self.lam = lam
@@ -222,25 +292,102 @@ def faster_kernel_ridge(
 ) -> jnp.ndarray:
     """Exact-Gram KRR solved by preconditioned CG with the random-features
     SMW preconditioner (ref: ml/krr.hpp:400-499). ``s == 0`` falls back to
-    unpreconditioned CG. Returns A = (K + λI)⁻¹·Y."""
+    unpreconditioned CG. Returns A = (K + λI)⁻¹·Y.
+
+    The whole solve — feature-map apply, SMW factor, Gram build, and the
+    PCG ``lax.while_loop`` — is one compiled program: convergence state
+    lives on device and no scalar crosses the host boundary per
+    iteration."""
     params = params or KrrParams()
     X = jnp.asarray(X)
     Y = jnp.asarray(Y)
     if Y.ndim == 1:
         Y = Y[:, None]
-    n = X.shape[0]
-    K = k.symmetric_gram(X) + lam * jnp.eye(n, dtype=X.dtype)
-
-    P = (
-        IdPrecond()
-        if s == 0
-        else FeatureMapPrecond(k, lam, X, s, context, use_fast=params.use_fast)
-    )
+    S = (None if s == 0
+         else k.create_rft(s, context, _feature_tag(params)))
     cg_params = KrylovParams(
         tolerance=params.tolerance, iter_lim=params.iter_lim
     )
-    A, _ = cg(K, Y, cg_params, precond=P)
-    return A
+
+    def solve(X, Y, lam):
+        from libskylark_tpu import sketch as sk
+
+        n = X.shape[0]
+        K = k.symmetric_gram(X) + lam * jnp.eye(n, dtype=X.dtype)
+        if S is None:
+            P = IdPrecond()
+        else:
+            P = FeatureMapPrecond.from_features(
+                S.apply(X, sk.ROWWISE).T, lam)
+        A, _ = cg(K, Y, cg_params, precond=P)
+        return A
+
+    return _run_compiled(
+        solve, "faster_kernel_ridge",
+        (engine.digest(k), None if S is None else engine.digest(S),
+         cg_params.tolerance, cg_params.iter_lim),
+        X, Y, lam)
+
+
+def _bcd_program(transforms, iter_lim: int, tolerance: float):
+    """The block-coordinate-descent solve (ref: ml/krr.hpp:500-690) as
+    one traceable program ``run(X, Y, lam) -> (W, iters, reldel)``;
+    ``lam`` is a runtime argument (executables serve every λ).
+
+    First sweep builds and caches the per-block Cholesky factors; the
+    remaining sweeps are a ``lax.while_loop`` whose carry holds the
+    block solutions, the residual, the sweep counter, and the
+    relative-update scalar — the convergence test happens on device, so
+    the loop makes zero host round-trips (the regression test traces
+    this program end-to-end to prove it)."""
+    from libskylark_tpu import sketch as sk
+
+    def run(X, Y, lam):
+        dt = X.dtype
+        t = Y.shape[1]
+        W0 = tuple(jnp.zeros((S.sketch_dim, t), dtype=dt)
+                   for S in transforms)
+
+        # First sweep: build + cache Cholesky factors (ref: :568-612).
+        Ls = []
+        W, R = [], Y
+        for c, S in enumerate(transforms):
+            Z = S.apply(X, sk.ROWWISE)  # (n, s_c)
+            G = Z.T @ Z + lam * jnp.eye(Z.shape[1], dtype=dt)
+            L = jsl.cholesky(G, lower=True)
+            Ls.append(L)
+            ZR = Z.T @ R - lam * W0[c]
+            delW = jsl.cho_solve((L, True), ZR)
+            W.append(W0[c] + delW)
+            R = R - Z @ delW
+        W = tuple(W)
+
+        # More sweeps with cached factors (ref: :625-682), device-resident.
+        def body(state):
+            W, R, it, _ = state
+            delsize = jnp.zeros((), dt)
+            out = []
+            for c, S in enumerate(transforms):
+                Z = S.apply(X, sk.ROWWISE)
+                ZR = Z.T @ R - lam * W[c]
+                delW = jsl.cho_solve((Ls[c], True), ZR)
+                out.append(W[c] + delW)
+                R = R - Z @ delW
+                delsize = delsize + jnp.sum(delW * delW)
+            wnorm = jnp.sqrt(sum(jnp.sum(w * w) for w in out))
+            reldel = jnp.sqrt(delsize) / jnp.maximum(wnorm, 1e-30)
+            return (tuple(out), R, it + 1, reldel)
+
+        def cond(state):
+            _, _, it, reldel = state
+            return (it < iter_lim) & (reldel >= tolerance)
+
+        W, R, it, reldel = lax.while_loop(
+            cond, body,
+            (W, R, jnp.int32(1), jnp.asarray(jnp.inf, dt)))
+        return jnp.concatenate(W, axis=0), it, reldel
+
+    return run
 
 
 @with_solver_precision
@@ -262,54 +409,32 @@ def large_scale_kernel_ridge(
     — the reference's memory-saving trick, which the counter-based RNG makes
     free. Returns (transforms, W) with W the concatenated block solution;
     predict by applying each map in order and multiplying the stacked
-    features with W."""
-    from libskylark_tpu import sketch as sk
+    features with W.
 
+    The sweeps run as one compiled ``lax.while_loop`` program
+    (:func:`_bcd_program`) — convergence is decided on device and only
+    the final (solution, iteration count) crosses back to the host."""
     params = params or KrrParams()
     X = jnp.asarray(X)
     Y = jnp.asarray(Y)
     if Y.ndim == 1:
         Y = Y[:, None]
     n, d = X.shape
-    t = Y.shape[1]
 
     transforms = [
         k.create_rft(thiss, context, _feature_tag(params))
         for thiss in _split_sizes(s, d, params.max_split)
     ]
 
-    W_blocks = [
-        jnp.zeros((S.sketch_dim, t), dtype=X.dtype) for S in transforms
-    ]
-    R = Y
-    Ls = []
-
-    # First sweep: build + cache Cholesky factors (ref: :568-612).
-    for c, S in enumerate(transforms):
-        Z = S.apply(X, sk.ROWWISE)  # (n, s_c)
-        G = Z.T @ Z + lam * jnp.eye(Z.shape[1], dtype=Z.dtype)
-        L = jsl.cholesky(G, lower=True)
-        Ls.append(L)
-        ZR = Z.T @ R - lam * W_blocks[c]
-        delW = jsl.cho_solve((L, True), ZR)
-        W_blocks[c] = W_blocks[c] + delW
-        R = R - Z @ delW
-
-    # More sweeps with cached factors (ref: :625-682).
-    for it in range(1, params.iter_lim):
-        delsize = 0.0
-        for c, S in enumerate(transforms):
-            Z = S.apply(X, sk.ROWWISE)
-            ZR = Z.T @ R - lam * W_blocks[c]
-            delW = jsl.cho_solve((Ls[c], True), ZR)
-            W_blocks[c] = W_blocks[c] + delW
-            R = R - Z @ delW
-            delsize += float(jnp.sum(delW * delW))
-        wnorm = math.sqrt(sum(float(jnp.sum(w * w)) for w in W_blocks))
-        reldel = math.sqrt(delsize) / max(wnorm, 1e-30)
-        params.log(2, f"large_scale_krr: iter {it}, relupdate = {reldel:.2e}")
-        if reldel < params.tolerance:
-            params.log(2, "large_scale_krr: convergence!")
-            break
-
-    return transforms, jnp.concatenate(W_blocks, axis=0)
+    run = _bcd_program(transforms, int(params.iter_lim),
+                       float(params.tolerance))
+    W, it, reldel = _run_compiled(
+        run, "large_scale_kernel_ridge",
+        tuple(engine.digest(S) for S in transforms)
+        + (int(params.iter_lim), float(params.tolerance)),
+        X, Y, lam)
+    if not _is_tracer(it):
+        # post-solve reporting only — the loop itself never synced
+        params.log(2, f"large_scale_krr: {int(it)} sweeps, "
+                      f"relupdate = {float(reldel):.2e}")
+    return transforms, W

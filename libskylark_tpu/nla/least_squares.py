@@ -56,7 +56,12 @@ def fast_least_squares(
     params: Optional[regression.AcceleratedParams] = None,
 ):
     """Accurate sketch-preconditioned solve — Blendenpik with condition
-    fallback (ref: nla/least_squares.hpp:216-236). Returns (X, lsqr_iters)."""
+    fallback (ref: nla/least_squares.hpp:216-236). Returns (X, lsqr_iters).
+
+    Dense operands dispatch as two engine-compiled executables (precond
+    build + the LSQR while_loop) with a single host sync for the
+    condition-fallback branch — see
+    :func:`libskylark_tpu.algorithms.regression.solve_l2_accelerated`."""
     return regression.solve_l2_accelerated(
         A, B, context, method="blendenpik", params=params
     )

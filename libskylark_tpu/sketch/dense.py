@@ -40,6 +40,17 @@ from libskylark_tpu.sketch.transform import (OperatorCache,
 BLOCK_COLS = 256
 
 
+def virtual_panel(key, dist, s_dim: int, col_start: int, col_stop: int,
+                  scale: float, dtype=jnp.float32) -> jnp.ndarray:
+    """Columns [col_start, col_stop) of the scaled virtual (s_dim × N)
+    operator in the dense-block stream format. THE one definition of
+    the stream (BLOCK_COLS included): ``DenseTransform.s_panel`` and
+    the engine-fused solver pipelines (nla/svd.py) both call this, so
+    their operator bits cannot drift apart."""
+    return scale * randgen.dense_panel(
+        key, dist, s_dim, col_start, col_stop, BLOCK_COLS, dtype)
+
+
 def pallas_ambient_ok(A) -> bool:
     """True when the fused kernel may run on ``A`` in the ambient context:
     use_pallas is on AND the array is single-device. Sharded applies keep
@@ -126,9 +137,8 @@ class DenseTransform(OperatorCache, SketchTransform):
 
     def s_panel(self, col_start: int, col_stop: int, dtype=jnp.float32) -> jnp.ndarray:
         """Materialize S[:, col_start:col_stop] (static bounds)."""
-        return self.scale * randgen.dense_panel(
-            self._alloc.key, self.dist, self._S, col_start, col_stop, BLOCK_COLS, dtype
-        )
+        return virtual_panel(self._alloc.key, self.dist, self._S,
+                             col_start, col_stop, self.scale, dtype)
 
     def s_block(self, block_id, dtype=jnp.float32) -> jnp.ndarray:
         """Materialize column block ``block_id`` (traced id ok; for scan loops)."""
@@ -336,9 +346,15 @@ class JLT(DenseTransform):
     sketch_type = "JLT"
     dist = randgen.Normal()
 
+    @staticmethod
+    def scale_for(s_dim: int) -> float:
+        """The JLT scale convention, callable without an instance (the
+        fused solver pipelines rebuild the operator from a bare key)."""
+        return math.sqrt(1.0 / s_dim)
+
     @property
     def scale(self) -> float:
-        return math.sqrt(1.0 / self._S)
+        return self.scale_for(self._S)
 
 
 @register

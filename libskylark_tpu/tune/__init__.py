@@ -40,9 +40,19 @@ __all__ = [
     "bucket_dim", "current_device_kind", "default_cache_path",
     "dense_workload", "enumerate_candidates", "fastfood_workload",
     "get_cache", "normalize_device_kind", "plan_cost", "plan_for",
-    "rank_candidates", "rank_plans", "record_measurement", "set_cache",
-    "RATES",
+    "plan_fingerprint", "rank_candidates", "rank_plans",
+    "record_measurement", "set_cache", "RATES",
 ]
+
+
+def plan_fingerprint() -> str:
+    """Content fingerprint of the global plan cache's *plans* — the
+    component the solver engine folds into its executable cache keys
+    (see :meth:`PlanCache.fingerprint`). Never raises."""
+    try:
+        return get_cache().fingerprint()
+    except Exception:
+        return "no-plan-cache"
 
 
 # -- workload constructors (the dispatchers' vocabulary) --

@@ -76,6 +76,7 @@ class PlanCache:
         self.path = path
         self.entries: dict[str, dict] = dict(entries or {})
         self._lock = threading.Lock()
+        self._fingerprint: Optional[str] = None
         self.load_error: Optional[str] = None
 
     # -- persistence --
@@ -158,6 +159,7 @@ class PlanCache:
                         else:
                             self.entries[key] = self._prefer(
                                 self.entries[key], ent)
+                    self._fingerprint = None  # merge may have changed plans
                 except Exception:
                     pass  # absent or unreadable: safe to (re)create
                 doc = {"schema": SCHEMA, "entries": self.entries}
@@ -179,6 +181,38 @@ class PlanCache:
                     lock_fh.close()
 
     # -- lookup / record --
+
+    def fingerprint(self) -> str:
+        """Content hash over the *plans* in the cache (not the
+        measurement metadata): the static-key component the solver
+        engine (libskylark_tpu/engine) folds into every executable key.
+        Hashing only the plan part means re-recording a better
+        measurement of the SAME plan leaves every executable valid,
+        while editing a cached plan invalidates the engine-served
+        pipelines (conservatively: the fingerprint is global, so an
+        unrelated-workload plan write also recompiles — over-
+        invalidation is a wasted compile, a stale serve would be a
+        wrong dispatch).
+
+        Memoized — this sits on the engine's per-call key path — and
+        invalidated by :meth:`put` (which every write funnels through).
+        Code that mutates ``entries`` directly must call
+        :meth:`invalidate_fingerprint`."""
+        with self._lock:
+            if self._fingerprint is not None:
+                return self._fingerprint
+            plans = {k: ent.get("plan") for k, ent in
+                     sorted(self.entries.items())}
+            doc = json.dumps(plans, sort_keys=True, default=str)
+            import hashlib
+
+            self._fingerprint = hashlib.sha256(
+                doc.encode()).hexdigest()[:16]
+            return self._fingerprint
+
+    def invalidate_fingerprint(self) -> None:
+        with self._lock:
+            self._fingerprint = None
 
     def lookup(self, w: Workload) -> Optional[Plan]:
         ent = self.entries.get(w.key())
@@ -204,6 +238,7 @@ class PlanCache:
             ent.update(extra)
         with self._lock:
             self.entries[w.key()] = ent
+            self._fingerprint = None
         return ent
 
     def record_measurement(self, w: Workload, plan: Plan, value: float,

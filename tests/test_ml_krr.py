@@ -223,3 +223,99 @@ def test_model_materialize_predict_unchanged():
                                atol=1e-5)
     model.dematerialize()
     assert maps[0]._op_cache is None
+
+
+class TestDeviceResidentLoops:
+    """r7: the iterative KRR regimes keep convergence state on device —
+    zero host round-trips per iteration. The proof is structural: the
+    whole solve traces end-to-end (any per-iteration ``float()``/
+    ``block_until_ready``-style sync would raise a concretization error
+    under trace), and the sweep/PCG loop is a single ``lax.while_loop``
+    in the traced program."""
+
+    def test_bcd_sweeps_have_no_host_syncs(self):
+        import jax
+
+        from libskylark_tpu.ml.krr import _bcd_program
+
+        X, Y = _regression_data(n=50, seed=4)
+        k = ml.Gaussian(X.shape[1], sigma=2.0)
+        transforms, _ = ml.large_scale_kernel_ridge(
+            k, X, Y, 0.2, 16, Context(seed=21),
+            ml.KrrParams(max_split=8, iter_lim=5))
+        run = _bcd_program(transforms, 5, 1e-3)
+        # tracing IS the no-sync assertion; the loop must be a while
+        jaxpr = jax.make_jaxpr(run)(
+            jnp.asarray(X), jnp.asarray(Y), jnp.float32(0.2))
+        prims = [e.primitive.name for e in jaxpr.jaxpr.eqns]
+        assert prims.count("while") == 1
+
+    def test_large_scale_single_executable(self):
+        from libskylark_tpu import engine
+
+        engine.reset()
+        try:
+            X, Y = _regression_data(n=50, seed=4)
+            k = ml.Gaussian(X.shape[1], sigma=2.0)
+            ml.large_scale_kernel_ridge(
+                k, X, Y, 0.2, 16, Context(seed=21),
+                ml.KrrParams(max_split=8, iter_lim=5))
+            s = engine.stats()
+            assert s.executions == 1 and s.misses == 1
+        finally:
+            engine.reset()
+
+    def test_faster_krr_single_executable_and_serve_many(self):
+        from libskylark_tpu import engine
+
+        engine.reset()
+        try:
+            X, Y = _regression_data(n=60, seed=7)
+            k = ml.Gaussian(X.shape[1], sigma=2.0)
+            p = ml.KrrParams(tolerance=1e-6, iter_lim=100)
+            A1 = ml.faster_kernel_ridge(k, X, Y, 0.5, 32,
+                                        Context(seed=7), p)
+            assert engine.stats().executions == 1
+            # same feature-map allocation => cache hit, no new compile
+            A2 = ml.faster_kernel_ridge(k, X, Y, 0.5, 32,
+                                        Context(seed=7), p)
+            s = engine.stats()
+            assert (s.misses, s.hits) == (1, 1)
+            np.testing.assert_allclose(np.asarray(A1), np.asarray(A2),
+                                       rtol=1e-6, atol=1e-6)
+        finally:
+            engine.reset()
+
+    def test_large_scale_matches_eager_reference(self):
+        """The while_loop rewrite reproduces the pre-r7 eager sweep
+        algebra: run the same recurrence in numpy and compare."""
+        X, Y = _regression_data(n=40, d=4, seed=12)
+        k = ml.Gaussian(X.shape[1], sigma=2.0)
+        lam, s = 0.3, 12
+        params = ml.KrrParams(max_split=8, tolerance=1e-7, iter_lim=50)
+        transforms, W = ml.large_scale_kernel_ridge(
+            k, X, Y, lam, s, Context(seed=31), params)
+        Zs = [np.asarray(t.apply(jnp.asarray(X), sk.ROWWISE))
+              for t in transforms]
+        Wb = [np.zeros((Z.shape[1], Y.shape[1]), np.float32) for Z in Zs]
+        R, Ls = Y.copy(), []
+        import scipy.linalg as sl
+
+        for it in range(50):
+            delsize = 0.0
+            for c, Z in enumerate(Zs):
+                if it == 0:
+                    G = Z.T @ Z + lam * np.eye(Z.shape[1], dtype=np.float32)
+                    Ls.append(sl.cholesky(G, lower=True))
+                ZR = Z.T @ R - lam * Wb[c]
+                delW = sl.cho_solve((Ls[c], True), ZR)
+                Wb[c] = Wb[c] + delW
+                R = R - Z @ delW
+                delsize += float(np.sum(delW * delW))
+            if it > 0:
+                wnorm = np.sqrt(sum(float(np.sum(w * w)) for w in Wb))
+                if np.sqrt(delsize) / max(wnorm, 1e-30) < params.tolerance:
+                    break
+        W_ref = np.concatenate(Wb, axis=0)
+        np.testing.assert_allclose(np.asarray(W), W_ref, rtol=1e-3,
+                                   atol=1e-4)
