@@ -16,7 +16,16 @@ TPU backend init on a wedged tunnel): every backend touch happens in a
 *subprocess* with a bounded timeout — first a cheap probe, retried with
 backoff, then the measurement itself — under one global deadline. On
 exhaustion the script still prints the JSON line, with an explicit
-``error`` field, instead of hanging the round.
+``error`` field, instead of hanging the round. A FIRST probe that exits
+with a hard error (backend init raised — dead tunnel, absent hardware)
+fails fast: no retries, straight to the committed-capture fallback
+(r4/r5 burned ~450s of escalating probe timeouts learning nothing).
+``SKYLARK_BENCH_MAX_WALL`` caps the whole orchestration below the
+retry deadline.
+
+Other modes: ``--solver`` (engine compile-vs-execute split),
+``--serve`` (microbatch serving throughput A/B, batched vs sequential
+dispatch), ``--stamp`` (oracle certification line).
 
 Each timed iteration consumes the FULL sketch output (the loop carries
 sum(abs(SA)) back into the next input), so XLA cannot dead-code-eliminate
@@ -487,6 +496,226 @@ def _solver(m: int = 1024, n: int = 512, rank: int = 8) -> None:
 
 
 # ---------------------------------------------------------------------------
+# serve-level measurement: microbatch coalescing vs sequential dispatch
+# ---------------------------------------------------------------------------
+
+
+def _serve(n_requests: int = 64, max_batch: int = 16,
+           rounds: int = 5) -> None:
+    """Throughput A/B for the microbatch serving layer (``python
+    bench.py --serve``; backend-agnostic — run with JAX_PLATFORMS=cpu
+    for the hardware-free record).
+
+    Workload: ``n_requests`` in-flight small ragged requests per round.
+    *Sequential* dispatches each request as its own engine-compiled
+    exact-shape executable (the r7 status quo: N requests = N
+    dispatches); *batched* submits the same requests to a
+    :class:`MicrobatchExecutor` that coalesces them into padded
+    ``vmap``-batched flushes. Both sides are fully warmed before the
+    measured rounds, so the comparison is steady-state dispatch — the
+    record carries the engine's miss/recompile deltas across the
+    measured window to prove it (zero compiles after per-bucket
+    warmup). Prints exactly one JSON line."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from libskylark_tpu import Context, engine, ml
+    from libskylark_tpu import sketch as sk
+    from libskylark_tpu.algorithms import regression as reg
+    from libskylark_tpu.base import randgen
+    from libskylark_tpu.ml import krr as krr_mod
+    from libskylark_tpu.sketch import dense as sk_dense
+
+    rng = np.random.default_rng(0)
+    ctx = Context(seed=0)
+    s_dim = 32
+
+    # ragged shapes inside ONE pow2 bucket class: (48..60, 112..128)
+    # all pad to (64, 128) — padding waste is part of the measurement
+    reqs = []
+    for i in range(n_requests):
+        m = 48 + (i % 4) * 4
+        n = 112 + (i % 3) * 8
+        T = sk.JLT(n, s_dim, ctx)
+        A = rng.standard_normal((m, n)).astype(np.float32)
+        kd = np.asarray(jax.random.key_data(T.allocation.key),
+                        dtype=np.uint32)
+        reqs.append((T, A, kd, np.float32(T.scale)))
+
+    engine.reset()
+
+    # -- sequential baseline: one exact-shape executable per request --
+    def seq_one(kd, scale, A):
+        return sk_dense.serve_apply(kd, scale, A, dist=randgen.Normal(),
+                                    s_dim=s_dim, rowwise=True)
+
+    cf_seq = engine.compiled(seq_one, name="serve_bench.sequential",
+                             key_fn=lambda *a: ("seq", s_dim))
+
+    def run_sequential():
+        outs = [cf_seq(kd, scale, A) for (_, A, kd, scale) in reqs]
+        jax.block_until_ready(outs)
+        return outs
+
+    run_sequential()                       # warm every exact shape
+    seq_best = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        run_sequential()
+        seq_best = min(seq_best, time.perf_counter() - t0)
+    rps_seq = n_requests / seq_best
+
+    # -- batched: the microbatch executor --
+    ex = engine.MicrobatchExecutor(max_batch=max_batch, linger_us=5000,
+                                   max_queue=4 * n_requests, workers=2)
+
+    def warm_capacities(submit_one, n_caps=max_batch):
+        """Compile every pow2 capacity class of a bucket up front, so
+        the measured window is provably compile-free no matter how the
+        linger deadline fragments a round's cohorts."""
+        cap = 1
+        while cap <= n_caps:
+            futs = [submit_one(i) for i in range(cap)]
+            ex.flush()
+            jax.block_until_ready([f.result(timeout=120) for f in futs])
+            cap *= 2
+
+    def run_batched():
+        futs = [ex.submit_sketch(T, A, dimension=sk.ROWWISE)
+                for (T, A, _, _) in reqs]
+        outs = [f.result(timeout=60) for f in futs]
+        jax.block_until_ready(outs)
+        return outs
+
+    warm_capacities(
+        lambda i: ex.submit_sketch(reqs[i][0], reqs[i][1],
+                                   dimension=sk.ROWWISE))
+    b_out = run_batched()
+    # engine.stats() is the LIVE counter block — capture ints, not the
+    # object, and read the deltas before the secondary endpoints add
+    # their own warmup compiles
+    st = engine.stats()
+    warm = (st.misses, st.recompiles)
+    bat_best = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        run_batched()
+        bat_best = min(bat_best, time.perf_counter() - t0)
+    measured_misses = engine.stats().misses - warm[0]
+    measured_recompiles = engine.stats().recompiles - warm[1]
+    rps_bat = n_requests / bat_best
+
+    # correctness spot-check: a batched flush is bit-equal to the serve
+    # layer's own capacity-1 sequential dispatch (lane invariance), and
+    # numerically tight against the exact-shape sequential executables
+    # (XLA's batched contraction may legitimately reorder f32 sums)
+    ex1 = engine.MicrobatchExecutor(max_batch=1, linger_us=100)
+    seq1 = [ex1.submit_sketch(T, A, dimension=sk.ROWWISE)
+            for (T, A, _, _) in reqs]
+    lane_equal = all(
+        np.array_equal(np.asarray(b), np.asarray(f.result(timeout=60)))
+        for b, f in zip(b_out, seq1))
+    ex1.shutdown()
+    seq_out = run_sequential()
+    close = all(
+        np.allclose(np.asarray(b), np.asarray(s), rtol=1e-4, atol=1e-5)
+        for b, s in zip(b_out, seq_out))
+
+    # -- secondary endpoints: solve + krr predict ride the same path --
+    def endpoint_ab(submit_fn, seq_cf, seq_args, n_sub, timeout=60.0):
+        warm_capacities(submit_fn)
+        futs = [submit_fn(i) for i in range(n_sub)]
+        jax.block_until_ready([f.result(timeout=timeout) for f in futs])
+        for i in range(n_sub):
+            seq_cf(*seq_args(i))
+        t0 = time.perf_counter()
+        futs = [submit_fn(i) for i in range(n_sub)]
+        jax.block_until_ready([f.result(timeout=timeout) for f in futs])
+        t_bat = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        jax.block_until_ready([seq_cf(*seq_args(i))
+                               for i in range(n_sub)])
+        t_seq = time.perf_counter() - t0
+        return {"rps_batched": round(n_sub / t_bat, 1),
+                "rps_sequential": round(n_sub / t_seq, 1),
+                "speedup": round(t_seq / t_bat, 2)}
+
+    n_sub = max_batch * 2
+    solve_reqs = []
+    for i in range(n_sub):
+        n = 100 + (i % 4) * 5
+        Ts = sk.JLT(n, 24, ctx)
+        As = rng.standard_normal((n, 6)).astype(np.float32)
+        Bs = rng.standard_normal((n, 1)).astype(np.float32)
+        kds = np.asarray(jax.random.key_data(Ts.allocation.key),
+                         dtype=np.uint32)
+        solve_reqs.append((Ts, As, Bs, kds, np.float32(Ts.scale)))
+
+    def solve_seq(kd, scale, A, B):
+        return reg.sketched_solve_serve(kd, scale, A, B,
+                                        sketch_type="JLT", s_dim=24,
+                                        method="qr")
+
+    cf_solve = engine.compiled(solve_seq, name="serve_bench.seq_solve",
+                               key_fn=lambda *a: ("seq-solve",))
+    solve_ab = endpoint_ab(
+        lambda i: ex.submit_solve(solve_reqs[i][1], solve_reqs[i][2],
+                                  transform=solve_reqs[i][0]),
+        cf_solve,
+        lambda i: (solve_reqs[i][3], solve_reqs[i][4],
+                   solve_reqs[i][1], solve_reqs[i][2]),
+        n_sub)
+
+    X = jnp.asarray(rng.standard_normal((64, 8)).astype(np.float32))
+    Y = jnp.asarray(rng.standard_normal((64, 1)).astype(np.float32))
+    kern = ml.Gaussian(8, sigma=2.0)
+    coef = ml.kernel_ridge(kern, X, Y, 0.1)
+    krr_queries = [
+        rng.standard_normal((5 + (i % 8), 8)).astype(np.float32)
+        for i in range(n_sub)
+    ]
+
+    def krr_seq(Xq, Xtr, C):
+        return krr_mod.krr_predict_kernel(kern, Xq, Xtr, C)
+
+    cf_krr = engine.compiled(krr_seq, name="serve_bench.seq_krr",
+                             key_fn=lambda *a: ("seq-krr",))
+    krr_ab = endpoint_ab(
+        lambda i: ex.submit_krr_predict(kern, krr_queries[i], X, coef),
+        cf_krr, lambda i: (krr_queries[i], X, coef), n_sub)
+
+    st = ex.stats()
+    ex.shutdown()
+    rec = {
+        "metric": "serve_microbatch_throughput",
+        "platform": jax.default_backend(),
+        "n_requests": n_requests,
+        "max_batch": max_batch,
+        "rps_batched": round(rps_bat, 1),
+        "rps_sequential": round(rps_seq, 1),
+        "speedup": round(rps_bat / rps_seq, 2),
+        "bit_equal_to_capacity1_dispatch": lane_equal,
+        "allclose_to_exact_sequential": close,
+        # compiles across the measured window: zero proves steady-state
+        # traffic never leaves the per-bucket warmed executables
+        "misses_after_warmup": measured_misses,
+        "recompiles_after_warmup": measured_recompiles,
+        "padding_waste_ratio": st["padding_waste_ratio"],
+        "batch_capacity_hist": st["batch_capacity_hist"],
+        "latency_ms": {
+            "p50": round(st["latency_s"]["p50"] * 1e3, 3)
+            if st["latency_s"]["p50"] is not None else None,
+            "p99": round(st["latency_s"]["p99"] * 1e3, 3)
+            if st["latency_s"]["p99"] is not None else None,
+        },
+        "endpoints": {"solve_l2_sketched": solve_ab,
+                      "krr_predict": krr_ab},
+    }
+    print(json.dumps(rec), flush=True)
+
+
+# ---------------------------------------------------------------------------
 # parent: bounded orchestration
 # ---------------------------------------------------------------------------
 
@@ -605,8 +834,20 @@ def main() -> None:
     t_start = time.monotonic()
     errors: list[str] = []
 
+    # SKYLARK_BENCH_MAX_WALL: a hard wall budget below the retry
+    # deadline — r4/r5 burned ~450s of escalating probe timeouts on a
+    # dead tunnel before reaching the committed-capture fallback; the
+    # budget caps the whole orchestration regardless of retry policy
+    budget = DEADLINE
+    mw = os.environ.get("SKYLARK_BENCH_MAX_WALL")
+    if mw:
+        try:
+            budget = min(budget, float(mw))
+        except ValueError:
+            pass
+
     def time_left() -> float:
-        return DEADLINE - (time.monotonic() - t_start)
+        return budget - (time.monotonic() - t_start)
 
     attempt = 0
     probe_timeout = PROBE_TIMEOUT
@@ -653,6 +894,20 @@ def main() -> None:
         else:
             errors.append(f"attempt {attempt}: probe failed rc={rc}: "
                           f"{out[-300:]}")
+            if attempt == 1 and rc > 0:
+                # fail-fast: the FIRST probe exited with a hard error
+                # (backend init raised — unreachable/absent hardware),
+                # not a timeout. Retrying cannot revive it; emit the
+                # committed-capture record immediately instead of
+                # burning the deadline on escalating probe timeouts.
+                # Only rc > 0 qualifies: negative returncodes are
+                # signal kills (OOM, SIGHUP — possibly transient) and
+                # -1 is _sub's own timeout sentinel; both keep the
+                # retry ladder.
+                errors.append("fail-fast: backend unreachable on first "
+                              "probe (hard error, not timeout); "
+                              "skipping retries")
+                break
         time.sleep(min(10.0, max(0.0, time_left() - 20)))
 
     extra = {"error": " | ".join(e.replace("\n", " ") for e in errors)
@@ -729,6 +984,10 @@ if __name__ == "__main__":
         # (no wedge-proofing needed: run it with JAX_PLATFORMS=cpu for
         # the hardware-free record, or inside a live window for TPU)
         _solver()
+    elif "--serve" in sys.argv:
+        # microbatch serving throughput A/B (batched vs sequential
+        # dispatch); backend-agnostic, in-process like --solver
+        _serve()
     elif "--stamp" in sys.argv:
         # the certification line for benchmarks/.tpu_oracle_recert_r*:
         # steps scripts append `$(python bench.py --stamp)` so the stamp

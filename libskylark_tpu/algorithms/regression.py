@@ -116,6 +116,38 @@ def solve_l2_sketched(
     return X[:, 0] if squeeze else X
 
 
+def sketched_solve_serve(key_data, scale, A, B, *, sketch_type: str,
+                         s_dim: int, method: str = "qr") -> jnp.ndarray:
+    """Pure, vmap-batchable sketch-and-solve for the microbatch serving
+    layer (:mod:`libskylark_tpu.engine.serve`): rebuilds the row sketch
+    from the transform's raw key data and solves the compressed problem
+    — the whole request is one traceable function of
+    ``(key_data, scale, A, B)`` with the sketch family and method
+    static. Zero-padding the row dimension of A/B is exact (padded rows
+    contribute nothing through either sketch family); the feature and
+    target dimensions are NOT paddable (a zero feature column makes the
+    small problem singular), so the serving bucket keys them exactly."""
+    from libskylark_tpu.base import randgen
+    from libskylark_tpu.sketch import dense, hash as sketch_hash
+
+    if sketch_type == "CWT":
+        SA = sketch_hash.cwt_serve_apply(key_data, A, s_dim=s_dim,
+                                         rowwise=False)
+        SB = sketch_hash.cwt_serve_apply(key_data, B, s_dim=s_dim,
+                                         rowwise=False)
+    elif sketch_type == "JLT":
+        SA = dense.serve_apply(key_data, scale, A,
+                               dist=randgen.Normal(), s_dim=s_dim,
+                               rowwise=False)
+        SB = dense.serve_apply(key_data, scale, B,
+                               dist=randgen.Normal(), s_dim=s_dim,
+                               rowwise=False)
+    else:
+        raise errors.InvalidParametersError(
+            f"serve path supports JLT/CWT sketches, got {sketch_type!r}")
+    return solve_l2_exact(SA, SB, method=method)
+
+
 # -- accelerated solvers (ref: accelerated_linearl2_regression_solver_*) --
 
 

@@ -16,6 +16,8 @@ Public surface::
     donation_enabled() / maybe_donate(argnums)
     enable_persistent_cache()  # jax.experimental.compilation_cache wiring
     dump_stats(path)           # the CI jit-leak gate's exit artifact
+    MicrobatchExecutor(...)    # shape-bucketed microbatch serving
+    serve_stats()              # aggregate serving counters (docs/serving)
 
 Environment: ``SKYLARK_EXEC_CACHE_SIZE`` (LRU capacity, default 128),
 ``SKYLARK_EXEC_CACHE_DIR`` (persistent cross-process cache dir),
@@ -23,6 +25,7 @@ Environment: ``SKYLARK_EXEC_CACHE_SIZE`` (LRU capacity, default 128),
 ``SKYLARK_ENGINE_STATS_DUMP`` (write counters JSON at process exit).
 """
 
+from libskylark_tpu.engine import bucket
 from libskylark_tpu.engine.cache import (CacheEntry, EngineStats,
                                          ExecutableCache)
 from libskylark_tpu.engine.compiled import (CompiledFn, cache, code_version,
@@ -31,10 +34,13 @@ from libskylark_tpu.engine.compiled import (CompiledFn, cache, code_version,
                                             enable_persistent_cache,
                                             maybe_donate, plan_fingerprint,
                                             reset, stats)
+from libskylark_tpu.engine.serve import (MicrobatchExecutor,
+                                         ServeOverloadedError, serve_stats)
 
 __all__ = [
-    "CacheEntry", "CompiledFn", "EngineStats", "ExecutableCache", "cache",
+    "CacheEntry", "CompiledFn", "EngineStats", "ExecutableCache",
+    "MicrobatchExecutor", "ServeOverloadedError", "bucket", "cache",
     "code_version", "compiled", "digest", "donation_enabled", "dump_stats",
     "enable_persistent_cache", "maybe_donate", "plan_fingerprint", "reset",
-    "stats",
+    "serve_stats", "stats",
 ]

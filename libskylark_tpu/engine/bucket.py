@@ -1,0 +1,96 @@
+"""Shape classes for microbatch serving: pow2 pad-and-mask policy.
+
+The serving executor (:mod:`libskylark_tpu.engine.serve`) coalesces
+concurrent requests into one vmapped executable per *bucket*. A bucket
+is the set of requests that can share a compiled program: same endpoint
+statics (sketch family, sketch dim, solve method, kernel digest, ...),
+same dtype, and the same **shape class** — every paddable dimension
+rounded up to the next power of two (with a floor, so tiny requests
+don't fragment into one-off buckets). Two ragged requests in one class
+are padded to the class shape with zeros; the endpoints' virtual random
+streams are positional, so zero-padding is *bit-exact*, not just
+masked-approximate (see ``sketch.dense.serve_apply``).
+
+The batch dimension gets the same treatment: a cohort of k requests
+runs at the pow2 **capacity class** ≥ k (clamped to ``max_batch``,
+rounded to the mesh's device count when the batch is sharded), with
+filler lanes replicating the last real request. Steady-state traffic
+therefore compiles one executable per (bucket, capacity class) and
+never again — the zero-recompile property the CI serve gate asserts.
+
+The cost of padding is wasted MXU work, tracked by the executor as
+``padding_waste`` (1 − real elements / padded elements over the primary
+operand). Halving the pow2 growth (``geometric=√2``-style classes)
+would halve worst-case waste at the price of ~2× more buckets; the
+pow2 default keeps the executable population small, which is what
+bounds compile time and cache pressure in a serve-many process.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+# Smallest padded extent: dimensions below this share one class, so a
+# flood of tiny requests (the microbatching sweet spot) lands in a
+# single bucket instead of one per exact shape.
+PAD_FLOOR = 8
+
+
+def pow2_pad(n: int, floor: int = PAD_FLOOR) -> int:
+    """The shape class of extent ``n``: next power of two ≥ max(n, floor)."""
+    n = max(int(n), int(floor))
+    return 1 << (n - 1).bit_length()
+
+
+def pad_shape(shape: Sequence[int], pad_axes: Sequence[int],
+              floor: int = PAD_FLOOR) -> tuple[int, ...]:
+    """Round the extents named by ``pad_axes`` up to their pow2 class;
+    other extents are exact-match bucket components (e.g. the feature
+    dimension of a solve, which cannot be zero-padded without making
+    the compressed problem singular)."""
+    pad_axes = set(int(a) for a in pad_axes)
+    return tuple(
+        pow2_pad(e, floor) if i in pad_axes else int(e)
+        for i, e in enumerate(shape)
+    )
+
+
+def capacity_class(k: int, max_batch: int, multiple: int = 1) -> int:
+    """Batch capacity for a cohort of ``k`` requests: pow2 ≥ k, clamped
+    to ``max_batch``, then rounded up to ``multiple`` (the mesh device
+    count when the batch dimension is sharded — every shard must get
+    the same lane count)."""
+    cap = min(1 << (max(int(k), 1) - 1).bit_length(), int(max_batch))
+    m = max(int(multiple), 1)
+    cap = ((cap + m - 1) // m) * m
+    return max(cap, 1)
+
+
+def stack_pad(arrays: Sequence[np.ndarray], padded_shape: Sequence[int],
+              capacity: int, dtype) -> np.ndarray:
+    """One host-side (capacity, *padded_shape) buffer holding every
+    request's operand zero-padded into its top-left corner, filler
+    lanes replicating the last real request (replication, not zeros:
+    a zero operand can hit degenerate branches — a singular QR, a NaN
+    cond — and a filler lane must cost exactly one real lane, never
+    poison the flush). The buffer is freshly allocated per flush: the
+    executor donates it to the executable, so reuse across flushes
+    would re-read a deleted buffer."""
+    padded_shape = tuple(int(e) for e in padded_shape)
+    out = np.zeros((int(capacity),) + padded_shape, dtype=dtype)
+    for i, a in enumerate(arrays):
+        a = np.asarray(a)
+        out[(i,) + tuple(slice(0, e) for e in a.shape)] = a
+    for i in range(len(arrays), int(capacity)):
+        out[i] = out[len(arrays) - 1]
+    return out
+
+
+def padded_elements(padded_shape: Sequence[int], capacity: int) -> int:
+    return int(capacity) * int(np.prod([int(e) for e in padded_shape]))
+
+
+def real_elements(shapes: Sequence[Sequence[int]]) -> int:
+    return int(sum(int(np.prod([int(e) for e in s])) for s in shapes))

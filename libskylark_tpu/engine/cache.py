@@ -82,20 +82,31 @@ class ExecutableCache:
     """Thread-safe LRU of :class:`CacheEntry` keyed on the engine's
     static key tuples. ``seen`` remembers every key ever compiled in
     this process so a re-compile of a previously-compiled key (thrash)
-    is distinguishable from a first compile."""
+    is distinguishable from a first compile.
+
+    Concurrency contract (the serve executor calls ``CompiledFn`` from
+    multiple worker threads): every counter increment and every LRU
+    order mutation happens under ``_lock``, and a miss is single-flight
+    — :meth:`acquire` hands the compile to exactly one thread while
+    the others wait on an in-flight event, so N racing threads on a
+    cold key produce ONE miss + one compile + N−1 hits, never N
+    compiles of the same executable."""
 
     def __init__(self, maxsize: int = 128):
         self.maxsize = int(maxsize)
         self._entries: "OrderedDict[Hashable, CacheEntry]" = OrderedDict()
         self._seen: set = set()
         self._lock = threading.Lock()
+        # key -> Event for compiles in flight (single-flight discipline)
+        self._inflight: dict = {}
         self.stats = EngineStats()
         # counters folded in at every reset(): the process-lifetime view
         # the CI jit-leak gate reads, immune to tests zeroing `stats`
         self.lifetime = EngineStats()
 
     def __len__(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     def lookup(self, key: Hashable) -> Optional[CacheEntry]:
         with self._lock:
@@ -109,6 +120,29 @@ class ExecutableCache:
                 self.stats.recompiles += 1
             return None
 
+    def acquire(self, key: Hashable) -> Optional[CacheEntry]:
+        """Single-flight lookup: an entry on hit, else ``None`` exactly
+        once per cold key — the calling thread owns the compile and MUST
+        finish with :meth:`insert` or :meth:`abort`. Concurrent callers
+        of the same cold key block until the owner resolves it, then
+        take the hit path (or inherit the compile if the owner
+        aborted)."""
+        while True:
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None:
+                    self._entries.move_to_end(key)
+                    self.stats.hits += 1
+                    return entry
+                ev = self._inflight.get(key)
+                if ev is None:
+                    self._inflight[key] = threading.Event()
+                    self.stats.misses += 1
+                    if key in self._seen:
+                        self.stats.recompiles += 1
+                    return None
+            ev.wait()
+
     def insert(self, key: Hashable, entry: CacheEntry) -> None:
         with self._lock:
             self._seen.add(key)
@@ -118,6 +152,25 @@ class ExecutableCache:
             while len(self._entries) > self.maxsize:
                 self._entries.popitem(last=False)
                 self.stats.evictions += 1
+            ev = self._inflight.pop(key, None)
+        if ev is not None:
+            ev.set()
+
+    def abort(self, key: Hashable) -> None:
+        """Release an :meth:`acquire`-owned compile that failed; blocked
+        waiters re-race, and the next one inherits the compile."""
+        with self._lock:
+            ev = self._inflight.pop(key, None)
+        if ev is not None:
+            ev.set()
+
+    def note_execution(self, entry: CacheEntry, seconds: float) -> None:
+        """Record one executable dispatch (entry call count + global
+        execution counters) atomically."""
+        with self._lock:
+            entry.calls += 1
+            self.stats.executions += 1
+            self.stats.execute_seconds += seconds
 
     def clear(self) -> None:
         """Drop all executables (the ``seen`` set survives — a post-clear
@@ -129,12 +182,17 @@ class ExecutableCache:
     def reset(self) -> None:
         """Full reset: entries, seen-keys, and counters (tests). The
         window's counters roll into ``lifetime`` first — thrash cannot
-        be erased by resetting."""
+        be erased by resetting. In-flight compile events are released so
+        a reset mid-compile cannot strand waiters."""
         with self._lock:
             self._entries.clear()
             self._seen.clear()
             self.lifetime.merge(self.stats)
             self.stats.reset()
+            inflight = list(self._inflight.values())
+            self._inflight.clear()
+        for ev in inflight:
+            ev.set()
 
     def keys(self) -> list:
         with self._lock:

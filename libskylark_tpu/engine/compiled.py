@@ -40,6 +40,7 @@ import hashlib
 import inspect
 import json
 import os
+import threading
 import time
 from typing import Callable, Optional, Sequence
 
@@ -253,6 +254,9 @@ class CompiledFn:
         self._key_fn = key_fn
         self.name = name or getattr(fn, "__qualname__", repr(fn))
         self.stats = EngineStats()
+        # per-wrapper counters are bumped from serve worker threads too;
+        # bare += on a dataclass field is a read-modify-write race
+        self._stats_lock = threading.Lock()
         self._code_version = None
         functools.update_wrapper(self, fn)
 
@@ -304,32 +308,40 @@ class CompiledFn:
         )
         donate_argnums = self._effective_donate()
         key = self._key(args, statics, kwargs, donate_argnums)
-        entry = _CACHE.lookup(key)
+        # single-flight: on a cold key exactly one thread compiles while
+        # concurrent callers of the same key block in acquire()
+        entry = _CACHE.acquire(key)
         if entry is None:
-            self.stats.misses += 1
+            with self._stats_lock:
+                self.stats.misses += 1
             _maybe_wire_persistent()
             t0 = time.perf_counter()
-            jitted = jax.jit(
-                self._fn,
-                static_argnames=self._static_argnames or None,
-                donate_argnums=donate_argnums or None,
-            )
-            executable = jitted.lower(*args, **kwargs).compile()
+            try:
+                jitted = jax.jit(
+                    self._fn,
+                    static_argnames=self._static_argnames or None,
+                    donate_argnums=donate_argnums or None,
+                )
+                executable = jitted.lower(*args, **kwargs).compile()
+            except BaseException:
+                _CACHE.abort(key)
+                raise
             dt = time.perf_counter() - t0
-            self.stats.compile_seconds += dt
+            with self._stats_lock:
+                self.stats.compile_seconds += dt
             entry = CacheEntry(executable=executable, name=self.name,
                                compile_seconds=dt)
             _CACHE.insert(key, entry)
         else:
-            self.stats.hits += 1
+            with self._stats_lock:
+                self.stats.hits += 1
         t0 = time.perf_counter()
         out = entry.executable(*args)
         dt = time.perf_counter() - t0  # dispatch wall; async past this
-        entry.calls += 1
-        self.stats.executions += 1
-        self.stats.execute_seconds += dt
-        _CACHE.stats.executions += 1
-        _CACHE.stats.execute_seconds += dt
+        with self._stats_lock:
+            self.stats.executions += 1
+            self.stats.execute_seconds += dt
+        _CACHE.note_execution(entry, dt)
         return out
 
 
@@ -367,6 +379,12 @@ def dump_stats(path: str) -> None:
            "lifetime": lifetime.to_dict(),
            "entries": _CACHE.snapshot(),
            "cache_size": len(_CACHE)}
+    try:
+        from libskylark_tpu.engine.serve import serve_stats
+
+        doc["serve"] = serve_stats()
+    except Exception:
+        pass
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as fh:
         json.dump(doc, fh, indent=1, sort_keys=True)

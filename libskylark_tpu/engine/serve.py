@@ -1,0 +1,763 @@
+"""Shape-bucketed microbatch serving: coalesce concurrent requests into
+one vmapped executable per flush.
+
+The r7 engine made a *single* request run as one compiled program; this
+layer makes *N concurrent small requests* run as ``N / batch`` compiled
+programs. Requests enter through a future-returning :meth:`submit` on
+the hot endpoints — dense/CWT sketch-apply, sketched least squares, KRR
+predict — and are grouped by **bucket**: (endpoint statics, dtype, pow2
+shape class, sharding) as defined in :mod:`libskylark_tpu.engine.bucket`.
+A cohort flushes as ONE ``jax.vmap``-batched executable when it reaches
+``max_batch`` or its oldest request has lingered ``linger_us``; past
+``max_queue`` pending requests, ``submit`` blocks (backpressure) and
+eventually raises :class:`ServeOverloadedError`.
+
+Batched executables route through the same process-global executable
+cache as the r7 solver pipelines (:mod:`libskylark_tpu.engine.compiled`)
+— the bucket statics ride the ``key_fn`` extras and the padded batch
+shape rides the avals, so steady-state traffic is zero-recompile after
+one warmup per (bucket, capacity class). The stacked per-flush operand
+buffers are **donated**: the executor owns them (freshly allocated each
+flush, never re-read), so XLA may reuse their memory for the batch
+output regardless of the global ``SKYLARK_ENGINE_DONATE`` opt-in, which
+continues to govern only user-owned operands.
+
+Exactness: padding is bit-exact, not approximate. The sketch operators
+are positional virtual streams, so zero-padded coordinates contribute
+exact zeros (``sketch.dense.serve_apply`` / ``sketch.hash
+.cwt_serve_apply``); batch lanes are invariant to the capacity class
+(a cohort of 3 padded to capacity 4 returns the same bits per lane as
+capacity 8). Filler lanes replicate the last real request rather than
+feeding zeros into factorizations.
+
+Counters (``MicrobatchExecutor.stats()`` / ``engine.serve_stats()``):
+submitted / completed / failed / rejected, queued gauge, coalesced
+(requests that shared a flush), flushes, batch-capacity and cohort-size
+histograms, padding-waste ratio, and p50/p99/mean request latency.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import threading
+import time
+import warnings
+import weakref
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from libskylark_tpu.engine import bucket as bucketing
+from libskylark_tpu.engine.compiled import compiled as engine_compile
+from libskylark_tpu.engine.compiled import digest as engine_digest
+
+ENDPOINTS = ("sketch_apply", "solve_l2_sketched", "krr_predict")
+
+
+class ServeOverloadedError(RuntimeError):
+    """Backpressure bound hit: the executor's queue stayed at
+    ``max_queue`` for longer than the submit timeout."""
+
+
+@dataclasses.dataclass
+class _Request:
+    endpoint: str
+    arrays: dict            # per-request operands (host np, stack-padded)
+    true_shapes: dict       # name -> original shape (for unpad/waste)
+    meta: dict              # endpoint bits: squeeze flags, true extents
+    future: Future = dataclasses.field(default_factory=Future)
+    t_submit: float = dataclasses.field(default_factory=time.monotonic)
+
+
+@dataclasses.dataclass
+class _Bucket:
+    key: tuple              # full bucket identity (statics + model ids)
+    statics: tuple          # engine key_fn extras (no object ids)
+    ctx: dict               # closure objects: dist/kernel/model arrays
+    reqs: list = dataclasses.field(default_factory=list)
+
+    @property
+    def oldest(self) -> float:
+        return self.reqs[0].t_submit if self.reqs else float("inf")
+
+
+def _percentile(sorted_vals: list, q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    i = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+class MicrobatchExecutor:
+    """Thread-safe microbatching executor over the serve endpoints.
+
+    ::
+
+        ex = engine.MicrobatchExecutor(max_batch=8, linger_us=2000)
+        fut = ex.submit_sketch(transform, A, dimension=sk.ROWWISE)
+        fut2 = ex.submit_solve(A, b, transform=T, method="qr")
+        fut3 = ex.submit_krr_predict(kernel, Xq, X_train, coef)
+        SA = fut.result()
+        ex.shutdown()
+
+    ``mesh`` (optional ``jax.sharding.Mesh``) shards every flush's batch
+    dimension across the mesh — capacity classes round up to the device
+    count so each device gets equal lanes; model operands (KRR's
+    training set and coefficients) are replicated.
+
+    ``workers`` flush cohorts concurrently; the executable cache is
+    single-flight, so concurrent cold flushes of one bucket compile
+    once. Submission itself is cheap (a host-side pack + queue append)
+    and safe from any thread.
+    """
+
+    def __init__(self, max_batch: int = 8, linger_us: int = 2000,
+                 max_queue: int = 1024, workers: int = 1,
+                 mesh=None, pad_floor: int = bucketing.PAD_FLOOR):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.max_batch = int(max_batch)
+        self.linger = float(linger_us) * 1e-6
+        self.max_queue = int(max_queue)
+        self.pad_floor = int(pad_floor)
+        self._mesh = mesh
+        self._batch_axis = None
+        self._ndev = 1
+        if mesh is not None:
+            self._batch_axis = tuple(mesh.shape.keys())[0]
+            self._ndev = int(mesh.shape[self._batch_axis])
+
+        self._lock = threading.Lock()
+        self._work_cv = threading.Condition(self._lock)   # flusher wakeups
+        self._space_cv = threading.Condition(self._lock)  # backpressure
+        self._buckets: "dict[tuple, _Bucket]" = {}
+        self._pending = 0
+        self._stop = False
+
+        self._compiled: dict = {}          # bucket key -> CompiledFn
+        self._compiled_lock = threading.Lock()
+
+        self._stats_lock = threading.Lock()
+        self._counts = collections.Counter()
+        self._batch_hist: "collections.Counter" = collections.Counter()
+        self._cohort_hist: "collections.Counter" = collections.Counter()
+        self._pad_real = 0
+        self._pad_total = 0
+        self._latency = collections.deque(maxlen=8192)
+
+        import queue as _queue
+
+        self._workq: "_queue.Queue" = _queue.Queue()
+        self._workers = [
+            threading.Thread(target=self._worker_loop,
+                             name=f"skylark-serve-worker-{i}", daemon=True)
+            for i in range(max(int(workers), 1))
+        ]
+        for t in self._workers:
+            t.start()
+        self._flusher = threading.Thread(
+            target=self._flusher_loop, name="skylark-serve-flusher",
+            daemon=True)
+        self._flusher.start()
+        _EXECUTORS.add(self)
+
+    # ------------------------------------------------------------------
+    # submit: request intake
+    # ------------------------------------------------------------------
+
+    def submit(self, endpoint: str, /, **kwargs) -> Future:
+        """Queue one request; returns a future resolving to exactly what
+        the endpoint's sequential API returns. ``timeout`` (seconds,
+        default 30) bounds the backpressure wait."""
+        timeout = kwargs.pop("timeout", 30.0)
+        if endpoint == "sketch_apply":
+            key, statics, ctx, req = self._prep_sketch(**kwargs)
+        elif endpoint == "solve_l2_sketched":
+            key, statics, ctx, req = self._prep_solve(**kwargs)
+        elif endpoint == "krr_predict":
+            key, statics, ctx, req = self._prep_krr(**kwargs)
+        else:
+            raise ValueError(f"unknown serve endpoint {endpoint!r}; "
+                             f"expected one of {ENDPOINTS}")
+        self._enqueue(key, statics, ctx, req, timeout)
+        return req.future
+
+    def submit_sketch(self, transform, A, dimension=None, **kw) -> Future:
+        return self.submit("sketch_apply", transform=transform, A=A,
+                           dimension=dimension, **kw)
+
+    def submit_solve(self, A, B, transform, method: str = "qr",
+                     **kw) -> Future:
+        return self.submit("solve_l2_sketched", A=A, B=B,
+                           transform=transform, method=method, **kw)
+
+    def submit_krr_predict(self, kernel, X_new, X_train, coef,
+                           **kw) -> Future:
+        return self.submit("krr_predict", kernel=kernel, X_new=X_new,
+                           X_train=X_train, coef=coef, **kw)
+
+    # -- per-endpoint packing -----------------------------------------
+
+    @staticmethod
+    def _key_data(transform) -> np.ndarray:
+        """Raw key data of the transform's allocation, cached on the
+        transform — submit is on the request hot path and the key
+        derivation is a (host-synced) jax op worth paying once per
+        transform, not once per request."""
+        kd = getattr(transform, "_serve_key_data", None)
+        if kd is None:
+            import jax.random as jr
+
+            kd = np.asarray(jr.key_data(transform.allocation.key),
+                            dtype=np.uint32)
+            try:
+                transform._serve_key_data = kd
+            except Exception:
+                pass
+        return kd
+
+    def _sketch_family(self, transform):
+        """(family tag, dist instance) for a serve-able transform."""
+        from libskylark_tpu.sketch.dense import DenseTransform
+        from libskylark_tpu.sketch.hash import CWT
+
+        if isinstance(transform, CWT):
+            return "CWT", None
+        if isinstance(transform, DenseTransform):
+            return transform.sketch_type, transform.dist
+        raise TypeError(
+            "serve endpoints batch dense (JLT/CT) and CWT transforms; "
+            f"got {type(transform).__name__}")
+
+    def _prep_sketch(self, transform, A, dimension=None):
+        from libskylark_tpu.sketch import COLUMNWISE, Dimension
+
+        dimension = dimension or COLUMNWISE
+        rowwise = Dimension(dimension) == Dimension.ROWWISE
+        A = np.asarray(A)
+        if A.ndim == 1:
+            A = A[None, :] if rowwise else A[:, None]
+        n = A.shape[1] if rowwise else A.shape[0]
+        if n != transform.input_dim:
+            raise ValueError(
+                f"operand dim {n} != transform input dim "
+                f"{transform.input_dim}")
+        family, dist = self._sketch_family(transform)
+        pad_axes = (0, 1)  # both extents paddable: N is stream-exact,
+        #                    the other axis is sliced off the output
+        padded = bucketing.pad_shape(A.shape, pad_axes, self.pad_floor)
+        statics = ("sketch_apply", family, repr(dist),
+                   transform.sketch_dim, rowwise, str(A.dtype), padded)
+        ctx = {"dist": dist, "family": family,
+               "s_dim": transform.sketch_dim, "rowwise": rowwise}
+        req = _Request(
+            endpoint="sketch_apply",
+            arrays={"kd": self._key_data(transform),
+                    "scale": np.asarray(getattr(transform, "scale", 1.0),
+                                        dtype=A.dtype),
+                    "A": A},
+            true_shapes={"A": A.shape},
+            meta={"padded": padded, "rowwise": rowwise,
+                  "s_dim": transform.sketch_dim},
+        )
+        return statics, statics, ctx, req
+
+    def _prep_solve(self, A, B, transform, method: str = "qr"):
+        A = np.asarray(A)
+        B = np.asarray(B)
+        squeeze = B.ndim == 1
+        if squeeze:
+            B = B[:, None]
+        if A.ndim != 2 or B.shape[0] != A.shape[0]:
+            raise ValueError(f"solve expects (n,d) A and (n,t) B, got "
+                             f"{A.shape} / {B.shape}")
+        if A.shape[0] != transform.input_dim:
+            raise ValueError(
+                f"operand rows {A.shape[0]} != transform input dim "
+                f"{transform.input_dim}")
+        family, dist = self._sketch_family(transform)
+        if family not in ("JLT", "CWT"):
+            raise TypeError(f"solve serve path supports JLT/CWT, "
+                            f"got {family}")
+        n_pad = bucketing.pow2_pad(A.shape[0], self.pad_floor)
+        # d and t are exact bucket components: zero feature/target
+        # columns would make the compressed problem singular
+        statics = ("solve_l2_sketched", family, transform.sketch_dim,
+                   method, A.shape[1], B.shape[1], str(A.dtype), n_pad)
+        ctx = {"family": family, "s_dim": transform.sketch_dim,
+               "method": method}
+        req = _Request(
+            endpoint="solve_l2_sketched",
+            arrays={"kd": self._key_data(transform),
+                    "scale": np.asarray(getattr(transform, "scale", 1.0),
+                                        dtype=A.dtype),
+                    "A": A, "B": B.astype(A.dtype, copy=False)},
+            true_shapes={"A": A.shape, "B": B.shape},
+            meta={"padded_A": (n_pad, A.shape[1]),
+                  "padded_B": (n_pad, B.shape[1]), "squeeze": squeeze},
+        )
+        return statics, statics, ctx, req
+
+    def _prep_krr(self, kernel, X_new, X_train, coef):
+        import jax.numpy as jnp
+
+        X_new = np.asarray(X_new)
+        squeeze_q = X_new.ndim == 1
+        if squeeze_q:
+            X_new = X_new[None, :]
+        # model identity is taken from the objects the CALLER holds,
+        # before any conversion: a server submitting the same numpy
+        # model on every request must keep coalescing into one bucket
+        # (the converted arrays would have a fresh id per submit)
+        model_ids = (id(X_train), id(coef))
+        model_refs = (X_train, coef)
+        X_train = jnp.asarray(X_train)
+        coef = jnp.asarray(coef)
+        squeeze_t = coef.ndim == 1
+        if squeeze_t:
+            coef = coef[:, None]
+        if X_new.shape[1] != X_train.shape[1]:
+            raise ValueError(
+                f"query dim {X_new.shape[1]} != train dim "
+                f"{X_train.shape[1]}")
+        q_pad = bucketing.pow2_pad(X_new.shape[0], self.pad_floor)
+        statics = ("krr_predict", engine_digest(kernel),
+                   X_train.shape, coef.shape, str(X_new.dtype), q_pad)
+        # model identity separates buckets (cohorts must not mix
+        # models) but stays OUT of the engine key: two models with the
+        # same shapes share one executable. The bucket ctx pins the
+        # caller's original objects so their ids stay valid for the
+        # bucket's lifetime.
+        key = statics + model_ids
+        ctx = {"kernel": kernel, "X_train": X_train, "coef": coef,
+               "model_refs": model_refs}
+        req = _Request(
+            endpoint="krr_predict",
+            arrays={"Xq": X_new},
+            true_shapes={"Xq": X_new.shape},
+            meta={"padded": (q_pad, X_new.shape[1]),
+                  "q": X_new.shape[0],
+                  "squeeze_q": squeeze_q, "squeeze_t": squeeze_t},
+        )
+        return key, statics, ctx, req
+
+    # ------------------------------------------------------------------
+    # queueing + flushing
+    # ------------------------------------------------------------------
+
+    def _enqueue(self, key, statics, ctx, req, timeout) -> None:
+        deadline = time.monotonic() + (timeout if timeout else 0)
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("MicrobatchExecutor is shut down")
+            while self._pending >= self.max_queue:
+                wait = deadline - time.monotonic() if timeout else None
+                if timeout and wait <= 0:
+                    with self._stats_lock:
+                        self._counts["rejected"] += 1
+                    raise ServeOverloadedError(
+                        f"serve queue at bound ({self.max_queue}) for "
+                        f"{timeout}s")
+                if not self._space_cv.wait(timeout=wait):
+                    with self._stats_lock:
+                        self._counts["rejected"] += 1
+                    raise ServeOverloadedError(
+                        f"serve queue at bound ({self.max_queue}) for "
+                        f"{timeout}s")
+                if self._stop:
+                    raise RuntimeError("MicrobatchExecutor is shut down")
+            b = self._buckets.get(key)
+            if b is None:
+                b = self._buckets[key] = _Bucket(key=key, statics=statics,
+                                                ctx=ctx)
+            b.reqs.append(req)
+            self._pending += 1
+            with self._stats_lock:
+                self._counts["submitted"] += 1
+                self._counts["queued_peak"] = max(
+                    self._counts["queued_peak"], self._pending)
+            self._work_cv.notify_all()
+
+    def _pop_cohort_locked(self, key) -> Optional[tuple]:
+        b = self._buckets.get(key)
+        if b is None or not b.reqs:
+            return None
+        cohort = b.reqs[: self.max_batch]
+        b.reqs = b.reqs[self.max_batch:]
+        if not b.reqs:
+            del self._buckets[key]
+        self._pending -= len(cohort)
+        self._space_cv.notify_all()
+        return (b, cohort)
+
+    def _flusher_loop(self) -> None:
+        while True:
+            work = None
+            with self._lock:
+                if self._stop and not self._buckets:
+                    break
+                now = time.monotonic()
+                wait = None
+                for key in list(self._buckets):
+                    b = self._buckets[key]
+                    full = len(b.reqs) >= self.max_batch
+                    expired = now - b.oldest >= self.linger
+                    if full or expired or self._stop:
+                        work = self._pop_cohort_locked(key)
+                        break
+                    w = b.oldest + self.linger - now
+                    wait = w if wait is None else min(wait, w)
+                if work is None:
+                    if self._stop:
+                        continue
+                    self._work_cv.wait(timeout=wait)
+                    continue
+            self._workq.put(work)
+        for _ in self._workers:
+            self._workq.put(None)
+
+    def _worker_loop(self) -> None:
+        while True:
+            work = self._workq.get()
+            if work is None:
+                return
+            bucket_obj, cohort = work
+            try:
+                self._execute(bucket_obj, cohort)
+            except BaseException as e:  # noqa: BLE001 — fanned to futures
+                for r in cohort:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                with self._stats_lock:
+                    self._counts["failed"] += len(cohort)
+
+    def flush(self) -> None:
+        """Synchronously flush every pending cohort from the calling
+        thread (tests/bench warmup; normal traffic never needs it)."""
+        while True:
+            with self._lock:
+                work = None
+                for key in list(self._buckets):
+                    work = self._pop_cohort_locked(key)
+                    if work:
+                        break
+            if not work:
+                return
+            bucket_obj, cohort = work
+            try:
+                self._execute(bucket_obj, cohort)
+            except BaseException as e:  # noqa: BLE001
+                for r in cohort:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                with self._stats_lock:
+                    self._counts["failed"] += len(cohort)
+
+    # ------------------------------------------------------------------
+    # cohort execution: pad → stack → one vmapped executable → unpad
+    # ------------------------------------------------------------------
+
+    def _compiled_for(self, b: _Bucket):
+        # keyed on the engine statics, NOT the full bucket key: model
+        # ids separate buckets only to keep cohorts unmixed, but every
+        # same-shaped model shares one wrapper (and one executable) —
+        # and the dict stays bounded by the shape-class space instead of
+        # growing with model churn
+        with self._compiled_lock:
+            cf = self._compiled.get(b.statics)
+            if cf is None:
+                cf = self._build_batched(b)
+                self._compiled[b.statics] = cf
+            return cf
+
+    def _build_batched(self, b: _Bucket):
+        import jax
+
+        statics = b.statics
+        ctx = b.ctx
+        endpoint = statics[0]
+        if endpoint == "sketch_apply":
+            s_dim, rowwise = ctx["s_dim"], ctx["rowwise"]
+            if ctx["family"] == "CWT":
+                from libskylark_tpu.sketch.hash import cwt_serve_apply
+
+                def one(kd, scale, A):
+                    return cwt_serve_apply(kd, A, s_dim=s_dim,
+                                           rowwise=rowwise)
+            else:
+                from libskylark_tpu.sketch.dense import serve_apply
+
+                dist = ctx["dist"]
+
+                def one(kd, scale, A):
+                    return serve_apply(kd, scale, A, dist=dist,
+                                       s_dim=s_dim, rowwise=rowwise)
+
+            inner = jax.vmap(one)
+
+            def batched_sketch(kd, scale, A):
+                return inner(kd, scale, A)
+
+            return engine_compile(
+                batched_sketch, name="serve.sketch_apply",
+                donate_argnums=(0, 1, 2),
+                key_fn=lambda *a: statics)
+        if endpoint == "solve_l2_sketched":
+            from libskylark_tpu.algorithms.regression import (
+                sketched_solve_serve,
+            )
+
+            family, s_dim, method = (ctx["family"], ctx["s_dim"],
+                                     ctx["method"])
+
+            def one(kd, scale, A, B):
+                return sketched_solve_serve(
+                    kd, scale, A, B, sketch_type=family, s_dim=s_dim,
+                    method=method)
+
+            inner = jax.vmap(one)
+
+            def batched_solve(kd, scale, A, B):
+                return inner(kd, scale, A, B)
+
+            return engine_compile(
+                batched_solve, name="serve.solve_l2_sketched",
+                donate_argnums=(0, 1, 2, 3),
+                key_fn=lambda *a: statics)
+        # krr_predict: model operands broadcast, never donated (they
+        # are bucket-lived and re-read by every flush)
+        from libskylark_tpu.ml.krr import krr_predict_kernel
+
+        kernel = ctx["kernel"]
+
+        def one(Xq, X_train, coef):
+            return krr_predict_kernel(kernel, Xq, X_train, coef)
+
+        inner = jax.vmap(one, in_axes=(0, None, None))
+
+        def batched_krr(Xq, X_train, coef):
+            return inner(Xq, X_train, coef)
+
+        return engine_compile(
+            batched_krr, name="serve.krr_predict", donate_argnums=(0,),
+            key_fn=lambda *a: statics)
+
+    def _device_put_batch(self, arr):
+        """Shard a stacked (capacity, ...) host buffer's batch dimension
+        across the executor mesh (no-op without one)."""
+        if self._mesh is None:
+            return arr
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = PartitionSpec(self._batch_axis,
+                             *([None] * (arr.ndim - 1)))
+        return jax.device_put(arr, NamedSharding(self._mesh, spec))
+
+    def _device_put_replicated(self, arr):
+        if self._mesh is None:
+            return arr
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(
+            arr, NamedSharding(self._mesh, PartitionSpec()))
+
+    def _execute(self, b: _Bucket, cohort: list) -> None:
+        k = len(cohort)
+        capacity = bucketing.capacity_class(k, self.max_batch,
+                                            multiple=self._ndev)
+        endpoint = b.statics[0]
+        if endpoint == "sketch_apply":
+            padded = cohort[0].meta["padded"]
+            args = self._stack_common(cohort, padded, capacity,
+                                      with_b=False)
+            primary = "A"
+        elif endpoint == "solve_l2_sketched":
+            padded = cohort[0].meta["padded_A"]
+            args = self._stack_common(
+                cohort, padded, capacity, with_b=True,
+                padded_b=cohort[0].meta["padded_B"])
+            primary = "A"
+        else:
+            padded = cohort[0].meta["padded"]
+            Xq = bucketing.stack_pad(
+                [r.arrays["Xq"] for r in cohort], padded, capacity,
+                cohort[0].arrays["Xq"].dtype)
+            args = (self._device_put_batch(Xq),
+                    self._device_put_replicated(b.ctx["X_train"]),
+                    self._device_put_replicated(b.ctx["coef"]))
+            primary = "Xq"
+
+        cf = self._compiled_for(b)
+        from libskylark_tpu.base.precision import solver_precision
+
+        # the sequential solve/KRR endpoints trace under
+        # solver_precision() (full-f32 matmuls on TPU); the batched
+        # program must bake in the SAME regime or a served result would
+        # silently diverge from its sequential twin on MXU backends.
+        # Sketch-apply stays at the fast ambient default, also matching
+        # its sequential path (base/precision.py policy).
+        prec = (contextlib.nullcontext() if endpoint == "sketch_apply"
+                else solver_precision())
+        with prec, warnings.catch_warnings():
+            # the donated stacked buffers rarely alias the batch output
+            # — jax's unusable-donation warning is this layer's expected
+            # steady state, silenced ONLY around the serve dispatch so
+            # user donation sites keep their diagnostic
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            out = cf(*args)
+        # resolve futures from ONE host view of the batch output: a
+        # per-request eager device slice would cost a dispatched XLA op
+        # per lane — at microbatch request sizes that's comparable to
+        # the whole flush. Serving results terminate at the client, so
+        # they come back as host arrays (near zero-copy on CPU).
+        out = np.asarray(out)
+
+        now = time.monotonic()
+        for i, r in enumerate(cohort):
+            try:
+                r.future.set_result(self._unpad(endpoint, out, i, r))
+            except BaseException as e:  # noqa: BLE001
+                if not r.future.done():
+                    r.future.set_exception(e)
+        with self._stats_lock:
+            self._counts["flushes"] += 1
+            self._counts["completed"] += k
+            if k > 1:
+                self._counts["coalesced"] += k
+            self._batch_hist[capacity] += 1
+            self._cohort_hist[k] += 1
+            self._pad_total += bucketing.padded_elements(padded, capacity)
+            self._pad_real += bucketing.real_elements(
+                [r.true_shapes[primary] for r in cohort])
+            for r in cohort:
+                self._latency.append(now - r.t_submit)
+
+    def _stack_common(self, cohort, padded, capacity, *, with_b,
+                      padded_b=None) -> tuple:
+        dtype = cohort[0].arrays["A"].dtype
+        kd = bucketing.stack_pad([r.arrays["kd"] for r in cohort], (2,),
+                                 capacity, np.uint32)
+        scale = bucketing.stack_pad(
+            [np.asarray(r.arrays["scale"]).reshape(()) for r in cohort],
+            (), capacity, dtype)
+        A = bucketing.stack_pad([r.arrays["A"] for r in cohort], padded,
+                                capacity, dtype)
+        args = [self._device_put_batch(kd), self._device_put_batch(scale),
+                self._device_put_batch(A)]
+        if with_b:
+            B = bucketing.stack_pad([r.arrays["B"] for r in cohort],
+                                    padded_b, capacity, dtype)
+            args.append(self._device_put_batch(B))
+        return tuple(args)
+
+    @staticmethod
+    def _unpad(endpoint: str, out, lane: int, r: _Request):
+        if endpoint == "sketch_apply":
+            if r.meta["rowwise"]:
+                return out[lane, : r.true_shapes["A"][0], :]
+            return out[lane, :, : r.true_shapes["A"][1]]
+        if endpoint == "solve_l2_sketched":
+            x = out[lane]
+            return x[:, 0] if r.meta["squeeze"] else x
+        p = out[lane, : r.meta["q"], :]
+        if r.meta["squeeze_t"]:
+            p = p[:, 0]
+        if r.meta["squeeze_q"]:
+            p = p[0]
+        return p
+
+    # ------------------------------------------------------------------
+    # stats + lifecycle
+    # ------------------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Snapshot of the serving counters (see module docstring)."""
+        with self._stats_lock:
+            lat = sorted(self._latency)
+            c = dict(self._counts)
+            batch_hist = dict(sorted(self._batch_hist.items()))
+            cohort_hist = dict(sorted(self._cohort_hist.items()))
+            pad_real, pad_total = self._pad_real, self._pad_total
+        with self._lock:
+            queued = self._pending
+        return {
+            "submitted": c.get("submitted", 0),
+            "completed": c.get("completed", 0),
+            "failed": c.get("failed", 0),
+            "rejected": c.get("rejected", 0),
+            "queued": queued,
+            "queued_peak": c.get("queued_peak", 0),
+            "coalesced": c.get("coalesced", 0),
+            "flushes": c.get("flushes", 0),
+            "batch_capacity_hist": batch_hist,
+            "cohort_size_hist": cohort_hist,
+            "padding_waste_ratio": (
+                round(1.0 - pad_real / pad_total, 4) if pad_total else None
+            ),
+            "latency_s": {
+                "p50": _percentile(lat, 0.50),
+                "p99": _percentile(lat, 0.99),
+                "mean": (sum(lat) / len(lat)) if lat else None,
+                "n": len(lat),
+            },
+        }
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Stop intake, flush everything pending, join the threads."""
+        with self._lock:
+            if self._stop:
+                return
+            self._stop = True
+            self._work_cv.notify_all()
+            self._space_cv.notify_all()
+        if wait:
+            self._flusher.join()
+            for t in self._workers:
+                t.join()
+
+    def __enter__(self) -> "MicrobatchExecutor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+
+_EXECUTORS: "weakref.WeakSet[MicrobatchExecutor]" = weakref.WeakSet()
+
+
+def serve_stats() -> dict:
+    """Aggregate counters across every live executor in the process
+    (the serve analog of ``engine.stats()``; folded into
+    ``engine.dump_stats`` under ``"serve"``)."""
+    agg: dict = {"executors": 0}
+    sums = collections.Counter(
+        {k: 0 for k in ("submitted", "completed", "failed", "rejected",
+                        "queued", "coalesced", "flushes")})
+    lat_all: list = []
+    waste_real = waste_total = 0
+    for ex in list(_EXECUTORS):
+        s = ex.stats()
+        agg["executors"] += 1
+        for k in ("submitted", "completed", "failed", "rejected",
+                  "queued", "coalesced", "flushes"):
+            sums[k] += s[k]
+        if s["padding_waste_ratio"] is not None:
+            with ex._stats_lock:
+                waste_real += ex._pad_real
+                waste_total += ex._pad_total
+        with ex._stats_lock:
+            lat_all.extend(ex._latency)
+    agg.update(sums)
+    agg["padding_waste_ratio"] = (
+        round(1.0 - waste_real / waste_total, 4) if waste_total else None)
+    lat_all.sort()
+    agg["latency_s"] = {"p50": _percentile(lat_all, 0.50),
+                        "p99": _percentile(lat_all, 0.99),
+                        "n": len(lat_all)}
+    return agg

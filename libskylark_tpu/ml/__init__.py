@@ -48,6 +48,7 @@ from libskylark_tpu.ml.krr import (
     approximate_kernel_ridge,
     faster_kernel_ridge,
     kernel_ridge,
+    krr_predict,
     large_scale_kernel_ridge,
     sketched_approximate_kernel_ridge,
 )
@@ -99,6 +100,7 @@ __all__ = [
     "KrrParams",
     "FeatureMapPrecond",
     "kernel_ridge",
+    "krr_predict",
     "approximate_kernel_ridge",
     "sketched_approximate_kernel_ridge",
     "faster_kernel_ridge",

@@ -141,6 +141,44 @@ def kernel_ridge(
                          X, Y, lam)
 
 
+def krr_predict_kernel(k: Kernel, X_new: jnp.ndarray,
+                       X_train: jnp.ndarray, A: jnp.ndarray) -> jnp.ndarray:
+    """The KRR predict program — cross-gram times the fitted Gram
+    coefficients — as one pure traceable function. Rows of ``X_new``
+    are independent (the cross-gram is computed row-by-row), so
+    zero-padding the query rows only appends garbage predictions that
+    the caller slices off: the serving layer vmaps THIS function over a
+    padded query batch with the model (``X_train``, ``A``) broadcast."""
+    return k.gram(X_new, X_train) @ A
+
+
+@with_solver_precision
+def krr_predict(k: Kernel, X_new: jnp.ndarray, X_train: jnp.ndarray,
+                A: jnp.ndarray) -> jnp.ndarray:
+    """Predict with a :func:`kernel_ridge` model: gram(X_new, X) @ A
+    (ref: ml/krr.hpp:47-90 — the serving half of the exact regime).
+    Eager calls run as one engine-compiled executable keyed on the
+    kernel's hyperparameter digest; inside a user jit the program
+    inlines into the outer trace."""
+    X_new = jnp.asarray(X_new)
+    X_train = jnp.asarray(X_train)
+    A = jnp.asarray(A)
+    squeeze = A.ndim == 1
+    if squeeze:
+        A = A[:, None]
+
+    def run(X_new, X_train, A):
+        return krr_predict_kernel(k, X_new, X_train, A)
+
+    if _is_tracer(X_new, X_train, A):
+        out = run(X_new, X_train, A)
+    else:
+        cf = engine.compiled(run, name="krr_predict",
+                             key_fn=lambda *a: (engine.digest(k),))
+        out = cf(X_new, X_train, A)
+    return out[:, 0] if squeeze else out
+
+
 @with_solver_precision
 def approximate_kernel_ridge(
     k: Kernel,

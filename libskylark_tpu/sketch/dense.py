@@ -51,6 +51,31 @@ def virtual_panel(key, dist, s_dim: int, col_start: int, col_stop: int,
         key, dist, s_dim, col_start, col_stop, BLOCK_COLS, dtype)
 
 
+def serve_apply(key_data, scale, A, *, dist, s_dim: int,
+                rowwise: bool) -> jnp.ndarray:
+    """Pure, vmap-batchable dense sketch apply for the microbatch
+    serving layer (:mod:`libskylark_tpu.engine.serve`): one request's
+    S·A (or A·Sᵀ) as a function of the transform's raw key data, with
+    every knob static. The operator bits come from :func:`virtual_panel`
+    — the same positional stream ``DenseTransform`` applies — so a
+    request whose operand is zero-padded past the transform's true N
+    produces the exact bits of the unpadded apply: padded coordinates
+    multiply zero rows/columns, and the stream's first N positions are
+    invariant to the padded width.
+
+    ``key_data`` is ``jax.random.key_data(transform.allocation.key)``
+    ((2,) uint32), which the executor can stack host-side; ``scale`` is
+    traced so transforms differing only by scale (CT's C) share one
+    executable."""
+    import jax.random as jr
+
+    key = jr.wrap_key_data(jnp.asarray(key_data))
+    n = A.shape[1] if rowwise else A.shape[0]
+    S = virtual_panel(key, dist, s_dim, 0, n,
+                      jnp.asarray(scale, A.dtype), A.dtype)
+    return (A @ S.T) if rowwise else (S @ A)
+
+
 def pallas_ambient_ok(A) -> bool:
     """True when the fused kernel may run on ``A`` in the ambient context:
     use_pallas is on AND the array is single-device. Sharded applies keep

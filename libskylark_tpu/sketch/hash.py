@@ -26,6 +26,31 @@ from libskylark_tpu.base import errors, randgen
 from libskylark_tpu.sketch.transform import SketchTransform, register
 
 
+def cwt_serve_apply(key_data, A, *, s_dim: int, rowwise: bool) -> jnp.ndarray:
+    """Pure, vmap-batchable CWT apply for the microbatch serving layer
+    (:mod:`libskylark_tpu.engine.serve`): one request's CountSketch as a
+    function of the transform's raw key data ((2,) uint32 from
+    ``jax.random.key_data``). The bucket/value streams are positional —
+    identical to :meth:`HashTransform.bucket_indices` /
+    :meth:`CWT.values` over the first N coordinates — so zero-padding
+    the operand past the transform's true N leaves the result bit-equal:
+    padded coordinates scatter-add exact zeros."""
+    import jax.random as jr
+
+    key = jr.wrap_key_data(jnp.asarray(key_data))
+    n = A.shape[1] if rowwise else A.shape[0]
+    h = randgen.stream_slice(
+        jax.random.fold_in(key, 0), randgen.UniformInt(0, s_dim - 1),
+        0, n, dtype=jnp.int32)
+    v = randgen.stream_slice(
+        jax.random.fold_in(key, 1), randgen.Rademacher(), 0, n,
+        dtype=A.dtype)
+    if rowwise:
+        return jax.ops.segment_sum(v[:, None] * A.T, h,
+                                   num_segments=s_dim).T
+    return jax.ops.segment_sum(v[:, None] * A, h, num_segments=s_dim)
+
+
 class HashTransform(SketchTransform):
     """Base: SA[h[j], :] += v[j] * A[j, :] (columnwise)."""
 

@@ -55,12 +55,13 @@ def tree(tmp_path):
             content = f"2026-07-31T00:00:00Z {out.stdout.strip()}"
         p.write_text(content)
 
-    def run():
+    def run(extra_env=None, timeout=60):
         env = dict(os.environ)
         env["SKYLARK_BENCH_DEADLINE"] = "25"  # below the 30s loop gate
+        env.update(extra_env or {})
         out = subprocess.run(
             [sys.executable, str(tmp_path / "bench.py")],
-            capture_output=True, text=True, timeout=60, env=env,
+            capture_output=True, text=True, timeout=timeout, env=env,
             cwd=str(tmp_path))
         assert out.returncode == 0, out.stderr[-500:]
         return json.loads(out.stdout.strip().splitlines()[-1])
@@ -114,6 +115,37 @@ def test_stale_closure_blocks_promotion(tree, rel):
     rec = run()
     assert rec["value"] is None
     assert rec["verified_committed"]["oracle_fresh"] is False
+
+
+def test_dead_backend_fails_fast_to_fallback(tree):
+    """A FIRST probe that exits with a hard error (backend init raised
+    — dead tunnel / absent hardware) must skip the escalating-retry
+    ladder entirely and emit the committed-capture record immediately
+    (r4/r5 burned ~450s of probe timeouts learning nothing)."""
+    import time
+
+    _, _, run = tree
+    t0 = time.monotonic()
+    rec = run(extra_env={"SKYLARK_BENCH_DEADLINE": "600",
+                         "JAX_PLATFORMS": "not_a_backend"},
+              timeout=120)
+    wall = time.monotonic() - t0
+    assert rec["value"] is None            # no oracle stamp: no promote
+    assert "fail-fast" in rec["error"]
+    assert rec["verified_committed"]["value"] == 123.4
+    # one probe's worth of wall, not the 600s deadline or a 75s+ ladder
+    assert wall < 60
+
+
+def test_max_wall_budget_caps_orchestration(tree):
+    """SKYLARK_BENCH_MAX_WALL bounds the whole orchestration below the
+    retry deadline: a 5s budget goes straight to the fallback."""
+    _, _, run = tree
+    rec = run(extra_env={"SKYLARK_BENCH_DEADLINE": "600",
+                         "SKYLARK_BENCH_MAX_WALL": "5"})
+    assert rec["value"] is None
+    assert "deadline exhausted" in rec["error"]
+    assert rec["verified_committed"]["value"] == 123.4
 
 
 def test_pre_closure_stamp_does_not_promote(tree):

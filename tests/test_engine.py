@@ -8,6 +8,8 @@ framework-level witness that a cache hit really compiles nothing; (c)
 donation observable through jax's deleted-buffer error.
 """
 
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -238,6 +240,115 @@ class TestExecutableCacheLRU:
         c.reset()
         assert c.lookup("a") is None
         assert c.stats.recompiles == 0          # fresh slate, not thrash
+
+
+class TestCacheThreadSafety:
+    """The serve executor made the cache multi-threaded for the first
+    time: misses must be single-flight (N racing threads on one cold
+    key = ONE compile), counter increments must never be lost, and the
+    LRU order must survive concurrent mutation."""
+
+    def test_concurrent_calls_single_flight(self, fresh_engine):
+        @engine.compiled
+        def f(A):
+            return A * 2.0 + 1.0
+
+        A = jnp.ones((32, 32))
+        n_threads, per = 8, 25
+        barrier = threading.Barrier(n_threads)
+        errs = []
+
+        def worker():
+            try:
+                barrier.wait()
+                for _ in range(per):
+                    f(A)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errs and not any(t.is_alive() for t in threads)
+        s = engine.stats()
+        total = n_threads * per
+        # single-flight: exactly one compile; no increment was lost
+        assert s.misses == 1
+        assert s.hits == total - 1
+        assert s.executions == total
+        assert s.recompiles == 0
+        assert len(engine.cache()) == 1
+
+    def test_concurrent_distinct_keys_lru_integrity(self):
+        c = ExecutableCache(maxsize=4)
+        n_threads, per, n_keys = 8, 200, 16
+        barrier = threading.Barrier(n_threads)
+
+        def worker(tid):
+            barrier.wait()
+            for i in range(per):
+                k = (tid * per + i) % n_keys
+                entry = c.acquire(k)
+                if entry is None:
+                    c.insert(k, CacheEntry(executable=None, name=str(k),
+                                           compile_seconds=0.0))
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not any(t.is_alive() for t in threads)
+        assert len(c) <= 4
+        s = c.stats
+        # every lookup resolved to a hit or an owned miss, none dropped
+        assert s.hits + s.misses == n_threads * per
+        # every miss became exactly one insert; evictions account for
+        # all inserts beyond capacity — a corrupted OrderedDict would
+        # break this identity
+        assert s.evictions == s.misses - len(c)
+
+    def test_compile_failure_releases_waiters(self, fresh_engine):
+        @engine.compiled
+        def bad(A):
+            raise ValueError("boom at trace time")
+
+        A = jnp.ones((8,))
+        n_threads = 6
+        barrier = threading.Barrier(n_threads)
+        outcomes = []
+
+        def worker():
+            barrier.wait()
+            try:
+                bad(A)
+                outcomes.append("ok")
+            except ValueError:
+                outcomes.append("raised")
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        # an aborted compile must release its waiters (no deadlock) and
+        # every caller sees the failure
+        assert not any(t.is_alive() for t in threads)
+        assert outcomes == ["raised"] * n_threads
+        # a failed compile never enters `seen`: retries are plain
+        # misses, not thrash
+        assert engine.stats().recompiles == 0
+
+        @engine.compiled
+        def good(A):
+            return A + 1
+
+        assert float(good(A)[0]) == 2.0   # cache still serviceable
 
 
 class TestPersistentCacheWiring:
