@@ -685,7 +685,58 @@ def _serve(n_requests: int = 64, max_batch: int = 16,
         lambda i: ex.submit_krr_predict(kern, krr_queries[i], X, coef),
         cf_krr, lambda i: (krr_queries[i], X, coef), n_sub)
 
+    # -- degraded-mode A/B: 1-in-64 injected flush faults ----------------
+    # Same workload under a deterministic fault plan: every 64th flush
+    # attempt raises, the executor's bisection re-executes the halves,
+    # and the record captures what that isolation overhead costs in
+    # throughput (the BENCH trajectory's resilience-tax row). The faults
+    # are attempt-counted, not request-pinned, so bisection absorbs every
+    # one — client-visible failures stay 0 (recorded to prove it).
+    from libskylark_tpu.resilience import faults as _faults
+
+    # snapshot the CLEAN stats first: the headline record's latency
+    # percentiles / padding-waste / counters must not absorb the
+    # isolation-retry traffic the degraded A/B is about to inject
     st = ex.stats()
+    # ~1-in-64 REQUESTS = every (n_requests/max_batch)th flush attempt
+    # for the 64-request rounds. Floor 3: after a failure at hit h ≡ 0
+    # (mod every), the bisection halves run at hits h+1 and h+2 — with
+    # every ≥ 3 neither is a multiple, so every injected fault is
+    # absorbed in one split with zero client-visible failures (every=2
+    # would fail a half, every=1 would fail every leaf)
+    deg_every = max(n_requests // max_batch, 3)
+    plan = {"seed": 0, "faults": [
+        {"site": "serve.flush", "error": "IOError_", "every": deg_every}]}
+    deg_failures = 0
+    with _faults.fault_plan(plan):
+        deg_best = float("inf")
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            futs = [ex.submit_sketch(T, A, dimension=sk.ROWWISE)
+                    for (T, A, _, _) in reqs]
+            outs = []
+            for f in futs:
+                try:
+                    outs.append(f.result(timeout=60))
+                except Exception:  # noqa: BLE001 — counted, not fatal
+                    deg_failures += 1
+            jax.block_until_ready(outs)
+            deg_best = min(deg_best, time.perf_counter() - t0)
+    st1 = ex.stats()
+    rps_deg = n_requests / deg_best
+    degraded_mode = {
+        "fault_rate": f"1/{deg_every} flush attempts "
+                      f"(~1/{deg_every * max_batch} requests)",
+        "rps_batched_degraded": round(rps_deg, 1),
+        "rps_batched_clean": round(rps_bat, 1),
+        "overhead_ratio": round(rps_bat / rps_deg, 3) if rps_deg else None,
+        "flush_failures": st1["flush_failures"] - st["flush_failures"],
+        "isolation_retries": (st1["isolation_retries"]
+                              - st["isolation_retries"]),
+        "client_visible_failures": deg_failures,
+        "state_after": ex.state,
+    }
+
     ex.shutdown()
     rec = {
         "metric": "serve_microbatch_throughput",
@@ -711,6 +762,7 @@ def _serve(n_requests: int = 64, max_batch: int = 16,
         },
         "endpoints": {"solve_l2_sketched": solve_ab,
                       "krr_predict": krr_ab},
+        "degraded_mode": degraded_mode,
     }
     print(json.dumps(rec), flush=True)
 

@@ -1,0 +1,230 @@
+"""Deterministic chaos battery — the CI chaos gate's driver.
+
+Runs a fixed serve storm under a seeded ``SKYLARK_FAULT_PLAN`` and
+asserts the resilience subsystem's contract end to end:
+
+- **zero orphaned futures**: every submitted request resolves (result
+  or exception) — a failure path that strands a future deadlocks a
+  real client;
+- **poison isolation**: the single tagged poison request in a *full*
+  cohort fails alone with the injected error class; every cohort-mate
+  re-coalesces and succeeds **bit-equal to the fault-free run**
+  (transform.apply is the clean oracle — the CWT serve path is
+  bit-exact against it);
+- **bounded convergence**: bisection pins the poison in
+  ≤ log2(max_batch) retry levels (the executor's
+  ``isolation_depth_peak`` counter);
+- **determinism**: two runs under the same plan seed produce the
+  identical injected-fault sequence (``faults.fired()``) and identical
+  surviving-request bits;
+- **zero leaked executables**: the engine's jit-leak counter
+  (``recompiles``) stays 0 and every miss is accounted
+  (``hits + misses == executions``) — chaos must not thrash the
+  executable cache;
+- **clean drain**: ``drain()`` after the storm reaches quiescence.
+
+Usage: ``python benchmarks/chaos_battery.py --gate`` (script/ci wires
+``JAX_PLATFORMS=cpu`` and the canned ``SKYLARK_FAULT_PLAN``). Prints
+one JSON record; exits nonzero on any violation. The storm uses forced
+flushes and an effectively-infinite linger, so cohort composition —
+and therefore the fault-hit sequence — is deterministic by
+construction, which is what makes the replay comparison meaningful.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Chaos runs are hardware-independent; default to CPU unless the
+# caller pinned a platform (the conftest discipline).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+N_REQUESTS = 48
+MAX_BATCH = 8
+POISON_INDEX = 11       # second cohort, middle lane — a FULL cohort
+S_DIM = 16
+N_FEAT = 40
+
+# The canned plan: a request-pinned poison plus a one-shot transient
+# flush fault landing on a known full-cohort attempt — bisection must
+# absorb it with zero client-visible failures (both halves re-execute
+# clean), in contrast to the poison, which must fail exactly one
+# future. The battery asserts the transient actually fired (an inert
+# plan is a gate bug, not a pass).
+DEFAULT_PLAN = {
+    "seed": 7,
+    "faults": [
+        {"site": "serve.flush", "error": "SketchError", "tag": "poison"},
+        {"site": "serve.flush", "error": "IOError_", "on_hit": 5},
+    ],
+}
+
+
+def _requests():
+    from libskylark_tpu import Context
+    from libskylark_tpu import sketch as sk
+
+    rng = np.random.default_rng(0)
+    ctx = Context(seed=0)
+    T = sk.CWT(N_FEAT, S_DIM, ctx)
+    ops = [rng.standard_normal((N_FEAT, 3 + i % 4)).astype(np.float32)
+           for i in range(N_REQUESTS)]
+    return T, ops
+
+
+def _clean_refs(T, ops):
+    import jax.numpy as jnp
+
+    from libskylark_tpu import sketch as sk
+
+    return [np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+            for A in ops]
+
+
+def _storm(T, ops):
+    """One deterministic storm: submit in cohort-sized groups (forced
+    flush each), poison one request, drain. Returns outcomes + logs."""
+    from libskylark_tpu import engine
+    from libskylark_tpu.resilience import faults
+
+    ex = engine.MicrobatchExecutor(max_batch=MAX_BATCH,
+                                   linger_us=10_000_000)
+    futs = []
+    for i, A in enumerate(ops):
+        if i == POISON_INDEX:
+            with faults.tag("poison"):
+                futs.append(ex.submit_sketch(T, A))
+        else:
+            futs.append(ex.submit_sketch(T, A))
+        if (i + 1) % MAX_BATCH == 0:
+            ex.flush()
+    ex.flush()
+    drained = ex.drain(timeout=60.0)
+    outcomes = []
+    for f in futs:
+        if not f.done():
+            outcomes.append(("ORPHANED", None))
+        elif f.exception() is not None:
+            outcomes.append(("ERROR", type(f.exception()).__name__))
+        else:
+            outcomes.append(("OK", np.asarray(f.result())))
+    return outcomes, faults.fired(), ex.stats(), drained
+
+
+def main() -> int:
+    from libskylark_tpu import engine
+    from libskylark_tpu.base import errors  # noqa: F401 — class names
+    from libskylark_tpu.resilience import faults
+
+    env = os.environ.get("SKYLARK_FAULT_PLAN")
+
+    def make_plan():
+        # fresh plan per run (counters/RNG at zero) — FaultPlan.parse
+        # owns the inline-JSON-or-path env convention
+        return (faults.FaultPlan.parse(env) if env
+                else faults.FaultPlan(DEFAULT_PLAN))
+
+    T, ops = _requests()
+    refs = _clean_refs(T, ops)
+
+    engine.reset()
+    violations = []
+    plan1 = make_plan()
+    with faults.fault_plan(plan1):
+        out1, fired1, stats1, drained1 = _storm(T, ops)
+    with faults.fault_plan(make_plan()):
+        out2, fired2, stats2, drained2 = _storm(T, ops)
+
+    # -- zero orphaned futures ------------------------------------------
+    orphans = sum(1 for s, _ in out1 + out2 if s == "ORPHANED")
+    if orphans:
+        violations.append(f"{orphans} orphaned future(s)")
+    if not (drained1 and drained2):
+        violations.append("drain did not reach quiescence")
+
+    # -- poison isolation + bit-equality of survivors -------------------
+    for run, out in (("run1", out1), ("run2", out2)):
+        for i, (status, val) in enumerate(out):
+            if i == POISON_INDEX:
+                if status != "ERROR" or val != "SketchError":
+                    violations.append(
+                        f"{run}: poison request got {status}/{val}, "
+                        f"expected the injected SketchError")
+            elif status != "OK":
+                violations.append(
+                    f"{run}: non-poison request {i} got {status}/{val}")
+            elif not np.array_equal(val, refs[i]):
+                violations.append(
+                    f"{run}: request {i} not bit-equal to fault-free run")
+
+    # -- determinism: identical fault sequence + identical bits ---------
+    if fired1 != fired2:
+        violations.append(
+            f"fired-fault sequences differ across same-seed runs: "
+            f"{fired1} vs {fired2}")
+    for i, ((s1, v1), (s2, v2)) in enumerate(zip(out1, out2)):
+        if s1 != s2 or (s1 == "OK" and not np.array_equal(v1, v2)):
+            violations.append(f"request {i} outcome differs across runs")
+    if not fired1:
+        violations.append("plan injected nothing — the battery is inert")
+    elif len({e[2] for e in fired1}) < 2 and env is None:
+        violations.append(
+            "canned plan fired only one error class — the transient-"
+            "absorption leg went inert (retune the on_hit)")
+
+    # -- bounded convergence --------------------------------------------
+    depth_bound = int(math.ceil(math.log2(MAX_BATCH)))
+    for run, st in (("run1", stats1), ("run2", stats2)):
+        if st["isolation_depth_peak"] > depth_bound:
+            violations.append(
+                f"{run}: isolation depth {st['isolation_depth_peak']} > "
+                f"log2(max_batch) = {depth_bound}")
+
+    # -- zero leaked executables (the jit-leak counter) -----------------
+    est = engine.stats()
+    if est.recompiles:
+        violations.append(f"{est.recompiles} executable recompile(s) "
+                          "under chaos — cache thrash")
+    if est.hits + est.misses != est.executions:
+        violations.append(
+            f"engine counters unbalanced: hits {est.hits} + misses "
+            f"{est.misses} != executions {est.executions}")
+
+    rec = {
+        "metric": "chaos_battery",
+        "plan_seed": plan1.seed,
+        "n_requests": N_REQUESTS,
+        "max_batch": MAX_BATCH,
+        "faults_fired": len(fired1),
+        "fired": [list(f) for f in fired1],
+        "poisoned": stats1["poisoned"],
+        "isolation_retries": stats1["isolation_retries"],
+        "isolation_depth_peak": stats1["isolation_depth_peak"],
+        "depth_bound": depth_bound,
+        "engine_recompiles": est.recompiles,
+        "deterministic": fired1 == fired2,
+        "violations": violations,
+    }
+    print(json.dumps(rec), flush=True)
+    if violations:
+        print("chaos battery FAILED:", file=sys.stderr)
+        for v in violations:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

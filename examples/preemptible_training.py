@@ -8,7 +8,7 @@ persists LIVE solver state: the ADMM consensus carry and the streaming
 sketch accumulators survive a SIGKILL and resume bit-identical to an
 uninterrupted run.
 
-This example simulates two preemptions:
+This example simulates three preemptions:
 
 1. A Block-ADMM training run "dies" after 4 of 12 iterations; a second
    invocation over the same checkpoint directory resumes at iteration 5
@@ -16,13 +16,23 @@ This example simulates two preemptions:
 2. A streaming ingestion+sketch job dies mid-stream; the rerun
    fast-forwards past the rows already folded in (re-reading but not
    re-sketching them) and completes to the same sketch.
+3. A REAL ``SIGTERM`` (the TPU/GCE eviction protocol) arrives with the
+   resilience handler installed: the live microbatch serving executor
+   drains (every queued future resolves; new submits are load-shed),
+   and the training loop notices the preemption flag at its next
+   iteration boundary, cuts a final synchronous checkpoint, and stops —
+   the rerun resumes from it and finishes bit-identical to the
+   uninterrupted run.
 """
 
+import os
+import signal
 import tempfile
 
 import numpy as np
 
-from libskylark_tpu import Context
+from libskylark_tpu import Context, engine, resilience
+from libskylark_tpu import sketch as sk
 from libskylark_tpu.algorithms.prox import L2Regularizer, SquaredLoss
 from libskylark_tpu.io.streaming import StreamingCWT
 from libskylark_tpu.ml.admm import BlockADMMSolver
@@ -82,7 +92,49 @@ def main() -> None:
     print(f"streaming resume vs one-shot sketch: max |diff| = {drift}")
     assert drift == 0.0, "streamed resume must equal the one-shot sketch"
 
-    print("preemptible training: both resume paths bit-identical")
+    # -- 3. a real SIGTERM: serve drain + final checkpoint + resume ------
+    resilience.install_preemption_handler()
+    try:
+        # a live serving executor with queued (un-flushed) requests...
+        ex = engine.MicrobatchExecutor(max_batch=8, linger_us=10_000_000)
+        T = sk.CWT(16, 8, Context(seed=7))
+        futs = [ex.submit_sketch(
+            T, rng.standard_normal((16, 2)).astype(np.float32))
+            for _ in range(5)]
+
+        # ...when the scheduler preempts us. CPython delivers the signal
+        # at the next bytecode boundary in the main thread: the handler
+        # sets the sticky preemption flag and kicks off the teardown
+        # (executor drain + checkpoint hooks) on its own thread — never
+        # blocking the interrupted frame, which may hold the very locks
+        # the drain needs.
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert resilience.wait_for_preemption_teardown(timeout=60.0)
+
+        with tempfile.TemporaryDirectory() as ck:
+            # the training loop polls the flag at each iteration
+            # boundary: it stops after iteration 1 and cuts a final
+            # checkpoint before returning
+            _solver(12).train(X, Y, regression=True,
+                              checkpoint=ck, checkpoint_every=0)
+            assert all(f.done() for f in futs), "drain left orphans"
+            assert ex.state == engine.STOPPED
+            print(f"SIGTERM: executor drained ({len(futs)} futures "
+                  f"resolved), training stopped at a checkpointed "
+                  f"iteration boundary")
+
+            # the replacement process clears the flag and resumes
+            resilience.reset_preemption()
+            resumed = _solver(12).train(X, Y, regression=True,
+                                        checkpoint=ck, checkpoint_every=0)
+        drift = np.abs(np.asarray(resumed.coef)
+                       - np.asarray(ref.coef)).max()
+        print(f"SIGTERM resume vs uninterrupted: max |diff| = {drift}")
+        assert drift == 0.0, "SIGTERM resume must be bit-identical"
+    finally:
+        resilience.uninstall_preemption_handler()
+
+    print("preemptible training: all three resume paths bit-identical")
 
 
 if __name__ == "__main__":

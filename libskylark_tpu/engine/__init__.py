@@ -18,6 +18,9 @@ Public surface::
     dump_stats(path)           # the CI jit-leak gate's exit artifact
     MicrobatchExecutor(...)    # shape-bucketed microbatch serving
     serve_stats()              # aggregate serving counters (docs/serving)
+    SERVING/DEGRADED/DRAINING/STOPPED   # executor health states; the
+                               # poison-isolation + drain story is
+                               # docs/resilience (r9)
 
 Environment: ``SKYLARK_EXEC_CACHE_SIZE`` (LRU capacity, default 128),
 ``SKYLARK_EXEC_CACHE_DIR`` (persistent cross-process cache dir),
@@ -34,12 +37,14 @@ from libskylark_tpu.engine.compiled import (CompiledFn, cache, code_version,
                                             enable_persistent_cache,
                                             maybe_donate, plan_fingerprint,
                                             reset, stats)
-from libskylark_tpu.engine.serve import (MicrobatchExecutor,
+from libskylark_tpu.engine.serve import (DEGRADED, DRAINING, SERVING,
+                                         STOPPED, MicrobatchExecutor,
                                          ServeOverloadedError, serve_stats)
 
 __all__ = [
-    "CacheEntry", "CompiledFn", "EngineStats", "ExecutableCache",
-    "MicrobatchExecutor", "ServeOverloadedError", "bucket", "cache",
+    "CacheEntry", "CompiledFn", "DEGRADED", "DRAINING", "EngineStats",
+    "ExecutableCache", "MicrobatchExecutor", "SERVING", "STOPPED",
+    "ServeOverloadedError", "bucket", "cache",
     "code_version", "compiled", "digest", "donation_enabled", "dump_stats",
     "enable_persistent_cache", "maybe_donate", "plan_fingerprint", "reset",
     "serve_stats", "stats",

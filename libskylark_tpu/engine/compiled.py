@@ -47,6 +47,7 @@ from typing import Callable, Optional, Sequence
 import jax
 
 from libskylark_tpu.engine.cache import CacheEntry, EngineStats, ExecutableCache
+from libskylark_tpu.resilience import faults as _faults
 
 # ---------------------------------------------------------------------------
 # global cache + policy switches
@@ -317,6 +318,10 @@ class CompiledFn:
             _maybe_wire_persistent()
             t0 = time.perf_counter()
             try:
+                # chaos seam: a compile-path fault takes the same abort
+                # route as a real XLA failure, so injection exercises
+                # the single-flight waiter-release contract too
+                _faults.check("engine.compile", detail=self.name)
                 jitted = jax.jit(
                     self._fn,
                     static_argnames=self._static_argnames or None,

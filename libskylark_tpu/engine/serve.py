@@ -34,6 +34,24 @@ Counters (``MicrobatchExecutor.stats()`` / ``engine.serve_stats()``):
 submitted / completed / failed / rejected, queued gauge, coalesced
 (requests that shared a flush), flushes, batch-capacity and cohort-size
 histograms, padding-waste ratio, and p50/p99/mean request latency.
+
+Resilience (r9, :mod:`libskylark_tpu.resilience`): a failed flush no
+longer fans its exception to the whole cohort — the executor retries
+**bisection-style**, splitting the cohort in half and re-executing each
+half, so a single poison request converges to its own capacity-1 flush
+in ≤ log2(max_batch) retries and receives the exception *alone* while
+every cohort-mate re-coalesces and succeeds (lane invariance makes the
+re-coalesced results bit-equal). The executor carries health states —
+``SERVING`` → ``DEGRADED`` (recent-flush failure ratio past
+``degraded_threshold``; submits load-shed at a reduced queue bound) →
+``DRAINING`` (:meth:`drain`: intake refused, queue flushed, in-flight
+futures resolved — what the preemption handler calls on SIGTERM) →
+``STOPPED``. Requests accept a ``deadline``; one that expires while
+queued resolves to :class:`ServeOverloadedError` and never consumes an
+isolation retry. The flush worker hosts the ``serve.flush`` fault-
+injection site (:mod:`libskylark_tpu.resilience.faults`), so all of the
+above is deterministically chaos-testable (``benchmarks/
+chaos_battery.py``, the CI chaos gate).
 """
 
 from __future__ import annotations
@@ -53,13 +71,22 @@ import numpy as np
 from libskylark_tpu.engine import bucket as bucketing
 from libskylark_tpu.engine.compiled import compiled as engine_compile
 from libskylark_tpu.engine.compiled import digest as engine_digest
+from libskylark_tpu.resilience import faults
+from libskylark_tpu.resilience.policy import Deadline
 
 ENDPOINTS = ("sketch_apply", "solve_l2_sketched", "krr_predict")
 
+# Executor health states (see the module docstring / docs/resilience).
+SERVING = "SERVING"
+DEGRADED = "DEGRADED"
+DRAINING = "DRAINING"
+STOPPED = "STOPPED"
+
 
 class ServeOverloadedError(RuntimeError):
-    """Backpressure bound hit: the executor's queue stayed at
-    ``max_queue`` for longer than the submit timeout."""
+    """Backpressure bound hit (the queue stayed at ``max_queue`` past
+    the submit timeout), load shed in a DEGRADED/DRAINING executor, or
+    a request deadline that expired while queued."""
 
 
 @dataclasses.dataclass
@@ -70,6 +97,8 @@ class _Request:
     meta: dict              # endpoint bits: squeeze flags, true extents
     future: Future = dataclasses.field(default_factory=Future)
     t_submit: float = dataclasses.field(default_factory=time.monotonic)
+    deadline: Optional[Deadline] = None   # expires-while-queued bound
+    tags: frozenset = frozenset()         # fault-injection tags (chaos)
 
 
 @dataclasses.dataclass
@@ -116,13 +145,22 @@ class MicrobatchExecutor:
 
     def __init__(self, max_batch: int = 8, linger_us: int = 2000,
                  max_queue: int = 1024, workers: int = 1,
-                 mesh=None, pad_floor: int = bucketing.PAD_FLOOR):
+                 mesh=None, pad_floor: int = bucketing.PAD_FLOOR,
+                 degraded_threshold: float = 0.5,
+                 failure_window: int = 32,
+                 shed_fraction: float = 0.25):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        if not 0.0 < degraded_threshold <= 1.0:
+            raise ValueError("degraded_threshold must be in (0, 1]")
+        if not 0.0 < shed_fraction <= 1.0:
+            raise ValueError("shed_fraction must be in (0, 1]")
         self.max_batch = int(max_batch)
         self.linger = float(linger_us) * 1e-6
         self.max_queue = int(max_queue)
         self.pad_floor = int(pad_floor)
+        self.degraded_threshold = float(degraded_threshold)
+        self.shed_fraction = float(shed_fraction)
         self._mesh = mesh
         self._batch_axis = None
         self._ndev = 1
@@ -133,9 +171,12 @@ class MicrobatchExecutor:
         self._lock = threading.Lock()
         self._work_cv = threading.Condition(self._lock)   # flusher wakeups
         self._space_cv = threading.Condition(self._lock)  # backpressure
+        self._idle_cv = threading.Condition(self._lock)   # drain quiescence
         self._buckets: "dict[tuple, _Bucket]" = {}
         self._pending = 0
+        self._inflight = 0                # popped cohorts being executed
         self._stop = False
+        self._draining = False
 
         self._compiled: dict = {}          # bucket key -> CompiledFn
         self._compiled_lock = threading.Lock()
@@ -147,6 +188,9 @@ class MicrobatchExecutor:
         self._pad_real = 0
         self._pad_total = 0
         self._latency = collections.deque(maxlen=8192)
+        # sliding window of flush-attempt outcomes (1.0 = failed): the
+        # DEGRADED detector's evidence
+        self._health = collections.deque(maxlen=max(int(failure_window), 4))
 
         import queue as _queue
 
@@ -171,8 +215,13 @@ class MicrobatchExecutor:
     def submit(self, endpoint: str, /, **kwargs) -> Future:
         """Queue one request; returns a future resolving to exactly what
         the endpoint's sequential API returns. ``timeout`` (seconds,
-        default 30) bounds the backpressure wait."""
+        default 30) bounds the backpressure wait. ``deadline`` (seconds
+        or a :class:`~libskylark_tpu.resilience.Deadline`) bounds the
+        request's whole queued life: one that expires before its flush
+        executes resolves to :class:`ServeOverloadedError` instead of
+        occupying a batch lane (or an isolation retry)."""
         timeout = kwargs.pop("timeout", 30.0)
+        deadline = Deadline.coerce(kwargs.pop("deadline", None))
         if endpoint == "sketch_apply":
             key, statics, ctx, req = self._prep_sketch(**kwargs)
         elif endpoint == "solve_l2_sketched":
@@ -182,6 +231,10 @@ class MicrobatchExecutor:
         else:
             raise ValueError(f"unknown serve endpoint {endpoint!r}; "
                              f"expected one of {ENDPOINTS}")
+        req.deadline = deadline
+        # capture the submitting thread's fault tags so chaos plans can
+        # pin a fault to THIS request wherever its cohort executes
+        req.tags = faults.current_tags()
         self._enqueue(key, statics, ctx, req, timeout)
         return req.future
 
@@ -348,11 +401,34 @@ class MicrobatchExecutor:
     # queueing + flushing
     # ------------------------------------------------------------------
 
+    def _refuse_if_unavailable_locked(self) -> None:
+        """Reject intake into a draining/stopped executor (caller holds
+        ``_lock``). Draining is a load-shed (the caller should
+        re-resolve to a healthy replica); a plain shutdown is a
+        programming error."""
+        if self._draining:
+            with self._stats_lock:
+                self._counts["shed"] += 1
+            raise ServeOverloadedError(
+                "executor is draining (preemption) — request refused")
+        if self._stop:
+            raise RuntimeError("MicrobatchExecutor is shut down")
+
     def _enqueue(self, key, statics, ctx, req, timeout) -> None:
         deadline = time.monotonic() + (timeout if timeout else 0)
+        degraded = self._is_degraded()
+        shed_bound = max(1, int(self.max_queue * self.shed_fraction))
         with self._lock:
-            if self._stop:
-                raise RuntimeError("MicrobatchExecutor is shut down")
+            self._refuse_if_unavailable_locked()
+            if degraded and self._pending >= shed_bound:
+                # DEGRADED load shed: reject immediately at the reduced
+                # bound instead of letting callers linger in a queue the
+                # failing flush path may never clear
+                with self._stats_lock:
+                    self._counts["shed"] += 1
+                raise ServeOverloadedError(
+                    f"load shed: executor DEGRADED and queue at "
+                    f"{self._pending} >= shed bound {shed_bound}")
             while self._pending >= self.max_queue:
                 wait = deadline - time.monotonic() if timeout else None
                 if timeout and wait <= 0:
@@ -367,8 +443,12 @@ class MicrobatchExecutor:
                     raise ServeOverloadedError(
                         f"serve queue at bound ({self.max_queue}) for "
                         f"{timeout}s")
-                if self._stop:
-                    raise RuntimeError("MicrobatchExecutor is shut down")
+                self._refuse_if_unavailable_locked()
+            # a waiter woken by the queue draining may reacquire the
+            # lock only AFTER a drain/shutdown completed — appending
+            # then would strand the future in a bucket no flusher will
+            # ever pop, so the availability check repeats at loop exit
+            self._refuse_if_unavailable_locked()
             b = self._buckets.get(key)
             if b is None:
                 b = self._buckets[key] = _Bucket(key=key, statics=statics,
@@ -390,8 +470,14 @@ class MicrobatchExecutor:
         if not b.reqs:
             del self._buckets[key]
         self._pending -= len(cohort)
+        self._inflight += 1
         self._space_cv.notify_all()
         return (b, cohort)
+
+    def _cohort_done_locked(self) -> None:
+        self._inflight -= 1
+        if self._pending == 0 and self._inflight == 0:
+            self._idle_cv.notify_all()
 
     def _flusher_loop(self) -> None:
         while True:
@@ -405,7 +491,7 @@ class MicrobatchExecutor:
                     b = self._buckets[key]
                     full = len(b.reqs) >= self.max_batch
                     expired = now - b.oldest >= self.linger
-                    if full or expired or self._stop:
+                    if full or expired or self._stop or self._draining:
                         work = self._pop_cohort_locked(key)
                         break
                     w = b.oldest + self.linger - now
@@ -419,20 +505,32 @@ class MicrobatchExecutor:
         for _ in self._workers:
             self._workq.put(None)
 
+    def _dispatch_cohort(self, bucket_obj, cohort) -> None:
+        """Run one popped cohort through the isolation-retrying
+        executor, with the last-resort exception fan and the in-flight
+        bookkeeping — the single dispatch path shared by the worker
+        threads and the synchronous :meth:`flush`."""
+        try:
+            self._run_cohort(bucket_obj, cohort)
+        except (KeyboardInterrupt, SystemExit):
+            raise       # a synchronous flush() on the main thread must
+            #             let Ctrl-C stop the process
+        except BaseException as e:  # noqa: BLE001 — last-resort fan
+            for r in cohort:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            with self._stats_lock:
+                self._counts["failed"] += len(cohort)
+        finally:
+            with self._lock:
+                self._cohort_done_locked()
+
     def _worker_loop(self) -> None:
         while True:
             work = self._workq.get()
             if work is None:
                 return
-            bucket_obj, cohort = work
-            try:
-                self._execute(bucket_obj, cohort)
-            except BaseException as e:  # noqa: BLE001 — fanned to futures
-                for r in cohort:
-                    if not r.future.done():
-                        r.future.set_exception(e)
-                with self._stats_lock:
-                    self._counts["failed"] += len(cohort)
+            self._dispatch_cohort(*work)
 
     def flush(self) -> None:
         """Synchronously flush every pending cohort from the calling
@@ -446,15 +544,89 @@ class MicrobatchExecutor:
                         break
             if not work:
                 return
-            bucket_obj, cohort = work
-            try:
-                self._execute(bucket_obj, cohort)
-            except BaseException as e:  # noqa: BLE001
-                for r in cohort:
-                    if not r.future.done():
-                        r.future.set_exception(e)
+            self._dispatch_cohort(*work)
+
+    # ------------------------------------------------------------------
+    # failure isolation: bisection converges on the poison request
+    # ------------------------------------------------------------------
+
+    def _drop_expired(self, cohort: list) -> list:
+        """Resolve deadline-expired requests to ServeOverloadedError and
+        return the survivors. Runs before EVERY execution attempt, so an
+        expired request never occupies a lane or an isolation retry."""
+        live = []
+        expired = 0
+        for r in cohort:
+            if r.deadline is not None and r.deadline.expired:
+                expired += 1
+                if not r.future.done():
+                    r.future.set_exception(ServeOverloadedError(
+                        f"request deadline expired after "
+                        f"{time.monotonic() - r.t_submit:.3f}s in queue"))
+            else:
+                live.append(r)
+        if expired:
+            with self._stats_lock:
+                self._counts["expired"] += expired
+        return live
+
+    def _run_cohort(self, b: _Bucket, cohort: list, depth: int = 0) -> None:
+        """Execute a cohort; on failure, bisect to isolate the poison.
+
+        A failed flush splits the cohort in half and re-executes each
+        half (lane invariance keeps the re-coalesced results bit-equal
+        to what the full flush would have produced), recursing until the
+        failure pins to a single request — only THAT future gets the
+        exception; every cohort-mate resolves successfully. Worst case
+        per request: ``ceil(log2(cohort))`` ≤ ``log2(max_batch)`` retry
+        levels, ~2× the flush work of the clean path for the one
+        afflicted cohort. Transient faults (that pass on re-execution)
+        cost one split and poison nobody.
+        """
+        cohort = self._drop_expired(cohort)
+        if not cohort:
+            return
+        try:
+            self._execute(b, cohort)
+        except (KeyboardInterrupt, SystemExit):
+            raise       # cancellation stops the process — it must not
+            #             be "isolated" into some request's future
+        except BaseException as e:  # noqa: BLE001 — taxonomy-agnostic
+            with self._stats_lock:
+                self._counts["flush_failures"] += 1
+                if depth == 0:
+                    # health evidence is per INCIDENT (root attempts
+                    # only): a bisection records log2(B)+1 correlated
+                    # failures, which would let ONE poison request in a
+                    # quiet executor flip the state to DEGRADED and shed
+                    # healthy traffic — contradicting "fails alone"
+                    self._health.append(1.0)
+            if len(cohort) == 1:
+                r = cohort[0]
+                if not r.future.done():
+                    r.future.set_exception(e)
                 with self._stats_lock:
-                    self._counts["failed"] += len(cohort)
+                    self._counts["failed"] += 1
+                    self._counts["poisoned"] += 1
+                return
+            mid = len(cohort) // 2
+            with self._stats_lock:
+                self._counts["isolation_retries"] += 2
+                self._counts["isolation_depth_peak"] = max(
+                    self._counts["isolation_depth_peak"], depth + 1)
+            self._run_cohort(b, cohort[:mid], depth + 1)
+            self._run_cohort(b, cohort[mid:], depth + 1)
+        else:
+            if depth == 0:
+                with self._stats_lock:
+                    self._health.append(0.0)
+
+    def _is_degraded(self) -> bool:
+        with self._stats_lock:
+            n = len(self._health)
+            if n < 4:
+                return False
+            return sum(self._health) / n >= self.degraded_threshold
 
     # ------------------------------------------------------------------
     # cohort execution: pad → stack → one vmapped executable → unpad
@@ -571,6 +743,12 @@ class MicrobatchExecutor:
         capacity = bucketing.capacity_class(k, self.max_batch,
                                             multiple=self._ndev)
         endpoint = b.statics[0]
+        # chaos seam: fires per execution ATTEMPT with the cohort's tag
+        # union, so a tag-pinned plan fails exactly the attempts that
+        # contain the poison request — which is what bisection needs
+        faults.check("serve.flush",
+                     tags=frozenset().union(*(r.tags for r in cohort)),
+                     detail=f"{endpoint} k={k} cap={capacity}")
         if endpoint == "sketch_apply":
             padded = cohort[0].meta["padded"]
             args = self._stack_common(cohort, padded, capacity,
@@ -673,6 +851,58 @@ class MicrobatchExecutor:
         return p
 
     # ------------------------------------------------------------------
+    # health + drain
+    # ------------------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        """``SERVING`` | ``DEGRADED`` | ``DRAINING`` | ``STOPPED``.
+
+        DEGRADED = the recent flush-attempt failure ratio (over the
+        ``failure_window`` sliding window) is at or past
+        ``degraded_threshold``; submits load-shed at ``max_queue *
+        shed_fraction`` instead of queueing behind a failing flush
+        path. The state self-heals: successful flushes push the ratio
+        back down."""
+        with self._lock:
+            if self._stop:
+                return STOPPED
+            if self._draining:
+                return DRAINING
+        return DEGRADED if self._is_degraded() else SERVING
+
+    def drain(self, timeout: Optional[float] = 30.0) -> bool:
+        """Preemption-safe drain: stop intake (new submits raise
+        :class:`ServeOverloadedError`), flush every queued cohort, and
+        wait until every in-flight future has resolved, then stop the
+        threads. Returns whether quiescence was reached inside
+        ``timeout`` (the executor is stopped either way — a SIGTERM
+        handler cannot wait forever). Idempotent; called by
+        :func:`libskylark_tpu.resilience.install_preemption_handler`
+        on SIGTERM for every live executor."""
+        dl = Deadline.after(timeout)
+        with self._lock:
+            if self._stop:
+                return True
+            self._draining = True
+            self._work_cv.notify_all()
+            self._space_cv.notify_all()
+            drained = True
+            while self._pending or self._inflight or self._buckets:
+                rem = dl.remaining()
+                if rem <= 0:
+                    drained = False
+                    break
+                self._idle_cv.wait(
+                    timeout=0.1 if rem == float("inf") else min(rem, 0.1))
+        # on timeout a cohort is wedged in execution — joining the
+        # threads would block past the deadline the caller (a SIGTERM
+        # grace window) budgeted, starving the checkpoint hooks that
+        # run after the drain; stop without waiting instead
+        self.shutdown(wait=drained)
+        return drained
+
+    # ------------------------------------------------------------------
     # stats + lifecycle
     # ------------------------------------------------------------------
 
@@ -687,10 +917,17 @@ class MicrobatchExecutor:
         with self._lock:
             queued = self._pending
         return {
+            "state": self.state,
             "submitted": c.get("submitted", 0),
             "completed": c.get("completed", 0),
             "failed": c.get("failed", 0),
             "rejected": c.get("rejected", 0),
+            "shed": c.get("shed", 0),
+            "expired": c.get("expired", 0),
+            "poisoned": c.get("poisoned", 0),
+            "flush_failures": c.get("flush_failures", 0),
+            "isolation_retries": c.get("isolation_retries", 0),
+            "isolation_depth_peak": c.get("isolation_depth_peak", 0),
             "queued": queued,
             "queued_peak": c.get("queued_peak", 0),
             "coalesced": c.get("coalesced", 0),
@@ -736,16 +973,16 @@ def serve_stats() -> dict:
     (the serve analog of ``engine.stats()``; folded into
     ``engine.dump_stats`` under ``"serve"``)."""
     agg: dict = {"executors": 0}
-    sums = collections.Counter(
-        {k: 0 for k in ("submitted", "completed", "failed", "rejected",
-                        "queued", "coalesced", "flushes")})
+    _SUM_KEYS = ("submitted", "completed", "failed", "rejected", "shed",
+                 "expired", "poisoned", "flush_failures",
+                 "isolation_retries", "queued", "coalesced", "flushes")
+    sums = collections.Counter({k: 0 for k in _SUM_KEYS})
     lat_all: list = []
     waste_real = waste_total = 0
     for ex in list(_EXECUTORS):
         s = ex.stats()
         agg["executors"] += 1
-        for k in ("submitted", "completed", "failed", "rejected",
-                  "queued", "coalesced", "flushes"):
+        for k in _SUM_KEYS:
             sums[k] += s[k]
         if s["padding_waste_ratio"] is not None:
             with ex._stats_lock:

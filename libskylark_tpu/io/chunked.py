@@ -34,8 +34,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from libskylark_tpu.base import errors
+from libskylark_tpu.resilience import faults
+from libskylark_tpu.resilience.policy import RetryPolicy
 
 ROWS = "rows"
+
+
+def _io_retry() -> RetryPolicy:
+    """Default policy for re-executable chunk reads (HDF5 slices):
+    transient failures back off and re-read. One-shot line streams
+    (libsvm over a socket) can't re-pull a batch — their recovery path
+    is upstream (the WebHDFS reconnect-resume) or checkpoint-resume
+    (``StreamingCWT.sketch(checkpoint=...)``)."""
+    return RetryPolicy(max_attempts=3, base_delay=0.05, max_delay=1.0)
 
 # Default prefetch depth for the double-buffered streaming overlap:
 # 2 slots = the classic double buffer (one batch on device computing,
@@ -225,6 +236,9 @@ def iter_libsvm_batches(
             lines.append(line)
         if not lines:
             break
+        # chaos seam: a parser/transport failure surfaces here, once per
+        # batch — no retry (the line iterator is one-shot; see _io_retry)
+        faults.check("io.chunked.batch", detail=f"batch@{seen}")
         targets, indices, values, _, nt = _parse_lines(lines, -1)
         n = len(targets)
         seen += n
@@ -259,21 +273,33 @@ def iter_libsvm_batches(
 
 
 def iter_hdf5_batches(
-    path, batch_rows: int, dtype=np.float32
+    path, batch_rows: int, dtype=np.float32,
+    retry: Optional[RetryPolicy] = None,
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Yield ``(X_batch, Y_batch)`` row slices off an HDF5 file written in
     the reference's dense layout (ref: ml/io.hpp:256-507 reads the file in
-    root-side chunks; h5py's partial reads provide the same bound)."""
+    root-side chunks; h5py's partial reads provide the same bound).
+
+    HDF5 slice reads are re-executable, so transient read failures
+    (``io.chunked.read`` fault site; NFS blips on real deployments)
+    retry under ``retry`` (default :func:`_io_retry`) instead of
+    killing the stream."""
     from libskylark_tpu.io.hdf5 import _require_h5py
 
     h5py = _require_h5py()
+    retry = retry or _io_retry()
+
+    def read_slice(ds, lo, hi, name):
+        faults.check("io.chunked.read", detail=f"{name}[{lo}:{hi}]")
+        return np.asarray(ds[lo:hi], dtype=dtype)
+
     with h5py.File(path, "r") as f:
         X, Y = f["X"], f["Y"]  # the reference's dense layout (io/hdf5.py)
         n = X.shape[0]
         for lo in range(0, n, batch_rows):
             hi = min(lo + batch_rows, n)
-            yield (np.asarray(X[lo:hi], dtype=dtype),
-                   np.asarray(Y[lo:hi], dtype=dtype))
+            yield (retry.call(read_slice, X, lo, hi, "X"),
+                   retry.call(read_slice, Y, lo, hi, "Y"))
 
 
 def read_libsvm_sharded(

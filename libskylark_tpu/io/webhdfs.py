@@ -17,6 +17,24 @@ the first block; ``urllib`` follows it transparently. Reads stream in
 stays O(buffer), matching the reference's bounded ``hdfsRead`` buffer
 discipline.
 
+Resilience (:mod:`libskylark_tpu.resilience`): both halves of the
+transport run under a :class:`~libskylark_tpu.resilience.RetryPolicy`
+(default: 4 attempts, decorrelated-jitter backoff, transient-error
+predicate; ``SKYLARK_WEBHDFS_RETRIES`` overrides the attempt count).
+
+- **OPEN** retries transient connection failures per attempt; the final
+  failure re-raises as :class:`~libskylark_tpu.base.errors.IOError_`
+  with the URL and the attempt count appended to its trace.
+- **read** failures *reconnect and resume*: WebHDFS OPEN takes a byte
+  ``offset``, and the streamer counts consumed bytes, so a dropped
+  datanode connection reopens at ``offset + consumed`` and continues —
+  the yielded line stream is bit-identical to an uninterrupted read
+  (the partial-line carry is host memory; it survives the reconnect).
+
+Fault-injection sites ``io.webhdfs.open`` (per connection attempt) and
+``io.webhdfs.read`` (per chunk) make both paths deterministically
+chaos-testable (tests/test_resilience.py).
+
 Offline environments: the transport is exercised against a local REST
 stub in tests/test_io_chunked.py (a real HDFS namenode is just the same
 protocol on another host).
@@ -24,16 +42,51 @@ protocol on another host).
 
 from __future__ import annotations
 
+import dataclasses
+import os
+import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Iterator, Optional
 
 from libskylark_tpu.base import errors
+from libskylark_tpu.resilience import faults
+from libskylark_tpu.resilience.policy import DeadlineExceededError, RetryPolicy
+
+
+def _is_transient(e: BaseException) -> bool:
+    """Worth a retry: connection/timeout trouble, short/dropped reads
+    (``http.client.IncompleteRead`` et al.), and server-side (5xx /
+    429) HTTP failures. Client errors (404, 403, ...) and logic errors
+    fail immediately — they would fail identically forever."""
+    import http.client
+
+    if isinstance(e, DeadlineExceededError):
+        return False     # exhausted budgets stop, never retry
+    if isinstance(e, urllib.error.HTTPError):
+        return e.code >= 500 or e.code == 429
+    return isinstance(
+        e, (urllib.error.URLError, ConnectionError, TimeoutError, OSError,
+            http.client.HTTPException, errors.IOError_))
+
+
+def default_retry() -> RetryPolicy:
+    """The transport's default policy (``SKYLARK_WEBHDFS_RETRIES``
+    bounds attempts, default 4)."""
+    try:
+        attempts = max(1, int(os.environ.get("SKYLARK_WEBHDFS_RETRIES",
+                                             "4")))
+    except ValueError:
+        attempts = 4
+    return RetryPolicy(max_attempts=attempts, base_delay=0.1,
+                       max_delay=2.0, retry_on=_is_transient)
 
 
 def _open_url(namenode: str, path: str, user: Optional[str],
               offset: int, length: Optional[int],
-              buffer_bytes: int, timeout: float):
+              buffer_bytes: int, timeout: float,
+              retry: Optional[RetryPolicy] = None):
+    retry = retry or default_retry()
     if not path.startswith("/"):
         path = "/" + path
     params = {"op": "OPEN", "buffersize": str(buffer_bytes)}
@@ -45,12 +98,33 @@ def _open_url(namenode: str, path: str, user: Optional[str],
         params["length"] = str(length)
     url = (namenode.rstrip("/") + "/webhdfs/v1" +
            urllib.parse.quote(path) + "?" + urllib.parse.urlencode(params))
-    try:
+    attempts = {"n": 0}
+
+    def attempt(timeout=timeout):
+        # default mirrors the caller's value: with timeout=None the
+        # policy injects no kwarg (urlopen treats None as "no timeout",
+        # same as before the retry wiring)
+        attempts["n"] += 1
+        faults.check("io.webhdfs.open", detail=url)
         return urllib.request.urlopen(url, timeout=timeout)
-    except Exception as e:  # pragma: no cover - network-specific messages
-        raise errors.IOError_(
-            f"webhdfs OPEN failed for {path!r} via {namenode!r}: {e}"
-        ) from e
+
+    try:
+        # per-attempt timeout = the caller's urlopen timeout; the policy
+        # threads it through so a hung connect consumes one attempt, not
+        # the whole budget
+        return dataclasses.replace(
+            retry, timeout_arg="timeout", attempt_timeout=timeout,
+        ).call(attempt)
+    except (KeyboardInterrupt, SystemExit):
+        raise               # cancellation is not an I/O failure — a
+        #                     rewrap would make Ctrl-C retryable upstream
+    except BaseException as e:  # noqa: BLE001 — rewrap with provenance
+        err = errors.IOError_(
+            f"webhdfs OPEN failed for {path!r} via {namenode!r}: {e}")
+        err.append_trace(f"url={url}")
+        err.append_trace(
+            f"attempts={attempts['n']}/{retry.max_attempts}")
+        raise err from e
 
 
 def webhdfs_lines(
@@ -62,28 +136,77 @@ def webhdfs_lines(
     buffer_bytes: int = 1 << 20,
     encoding: str = "utf-8",
     timeout: float = 60.0,
+    retry: Optional[RetryPolicy] = None,
 ) -> Iterator[str]:
     """Stream the lines of an HDFS file through WebHDFS.
 
     ``namenode`` is the REST endpoint (``http://host:9870``); ``path``
     the absolute HDFS path. Yields text lines (newline stripped by the
     consumer — same contract as a file handle). O(buffer_bytes) memory.
+
+    Transient mid-stream failures reconnect at the consumed byte offset
+    under ``retry`` (see module docstring) — the line stream is
+    bit-identical to an uninterrupted read.
     """
-    resp = _open_url(namenode, path, user, offset, length,
-                     buffer_bytes, timeout)
+    retry = retry or default_retry()
+    delays = retry.delays()
+    reconnects = 0
+    consumed = 0          # bytes successfully read off the wire
     carry = b""
-    try:
-        while True:
-            chunk = resp.read(buffer_bytes)
-            if not chunk:
-                break
-            carry += chunk
-            # split out complete lines; keep the partial tail
-            if b"\n" in carry:
-                complete, carry = carry.rsplit(b"\n", 1)
-                for line in complete.split(b"\n"):
-                    yield line.decode(encoding) + "\n"
-    finally:
-        resp.close()
+    while True:
+        want = None if length is None else length - consumed
+        if want is not None and want <= 0:
+            break
+        resp = _open_url(namenode, path, user, offset + consumed, want,
+                         buffer_bytes, timeout, retry=retry)
+        clean_eof = False
+        try:
+            while True:
+                faults.check("io.webhdfs.read", detail=path)
+                chunk = resp.read(buffer_bytes)
+                if not chunk:
+                    clean_eof = True
+                    break
+                consumed += len(chunk)
+                if reconnects:
+                    # progress after a reconnect: the retry budget is
+                    # per-INCIDENT, not per-stream — a week-long stream
+                    # must survive unlimited isolated blips, just never
+                    # max_attempts consecutive dead connections
+                    reconnects = 0
+                    delays = retry.delays()
+                carry += chunk
+                # split out complete lines; keep the partial tail
+                if b"\n" in carry:
+                    complete, carry = carry.rsplit(b"\n", 1)
+                    for line in complete.split(b"\n"):
+                        yield line.decode(encoding) + "\n"
+        except (GeneratorExit, KeyboardInterrupt, SystemExit):
+            raise                   # abandonment/cancellation — not a
+            #                         transport failure, never rewrapped
+        except BaseException as e:  # noqa: BLE001 — predicate decides
+            reconnects += 1
+            if not retry.retryable(e) or reconnects >= retry.max_attempts:
+                if isinstance(e, errors.SkylarkError):
+                    e.append_trace(
+                        f"webhdfs read of {path!r} died at byte "
+                        f"{offset + consumed} "
+                        f"(connection {reconnects}/{retry.max_attempts})")
+                    raise
+                err = errors.IOError_(
+                    f"webhdfs read failed for {path!r} at byte "
+                    f"{offset + consumed}: {e}")
+                err.append_trace(
+                    f"connections={reconnects}/{retry.max_attempts}")
+                raise err from e
+            retry.sleep(next(delays))
+            continue      # reopen at offset + consumed, carry intact
+        finally:
+            try:
+                resp.close()
+            except Exception:  # pragma: no cover - close-on-dead-socket
+                pass
+        if clean_eof:
+            break
     if carry:
         yield carry.decode(encoding)

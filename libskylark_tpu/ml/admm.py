@@ -38,6 +38,9 @@ from libskylark_tpu.base.context import Context
 from libskylark_tpu.base.precision import with_solver_precision
 from libskylark_tpu.ml.kernels import Kernel
 from libskylark_tpu.ml.model import HilbertModel
+from libskylark_tpu.resilience.preemption import (
+    preemption_requested as _preemption_requested,
+)
 from libskylark_tpu.sketch import ROWWISE, SketchTransform
 from libskylark_tpu.utility.timer import get_timer, timers_enabled
 
@@ -483,6 +486,14 @@ class BlockADMMSolver:
                 # force maxiter sweeps.)
                 if self.tol > 0 and it > 1 and float(reldel) <= self.tol:
                     converged = True
+                    break
+                if ckpt is not None and _preemption_requested():
+                    # preemption-safe drain: stop at this iteration
+                    # boundary; the post-loop final save cuts the
+                    # checkpoint and the finally's close() blocks until
+                    # it is durable — a rerun resumes at it+1, bit-
+                    # identical (see docs/resilience, the SIGTERM demo
+                    # in examples/preemptible_training.py)
                     break
                 if ckpt is not None and checkpoint_every > 0 \
                         and it % int(checkpoint_every) == 0 \

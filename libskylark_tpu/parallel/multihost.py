@@ -12,29 +12,109 @@ slice and DCN across slices (SURVEY.md §2.9).
 
 from __future__ import annotations
 
+import inspect
+import socket
+import time
 from typing import Optional
 
 import jax
+
+from libskylark_tpu.base import errors
+
+
+def _probe_coordinator(address: str, timeout: float) -> None:
+    """Bounded TCP reachability probe of the coordinator, retried until
+    ``timeout`` (the coordinator may start moments after its workers).
+
+    This runs BEFORE ``jax.distributed.initialize`` because the C++
+    distributed client does not raise on an unreachable coordinator —
+    its RegisterTask deadline trips a ``LOG(FATAL)`` that aborts the
+    whole process, which no Python ``except`` can intercept (observed
+    on this jax build). A plain socket connect is interceptable, so an
+    unreachable coordinator becomes a catchable
+    :class:`~libskylark_tpu.base.errors.CommunicationError` instead of
+    a SIGABRT (or, without any timeout, an indefinite hang)."""
+    host, _, port = address.rpartition(":")
+    try:
+        port_no = int(port)
+    except ValueError:
+        err = errors.CommunicationError(
+            f"malformed coordinator address {address!r} "
+            "(expected host:port)")
+        raise err
+    deadline = time.monotonic() + timeout
+    last: Optional[BaseException] = None
+    while True:
+        step = max(min(deadline - time.monotonic(), 1.0), 0.05)
+        try:
+            with socket.create_connection((host or "127.0.0.1", port_no),
+                                          timeout=step):
+                return
+        except OSError as e:
+            last = e
+        if time.monotonic() >= deadline:
+            err = errors.CommunicationError(
+                f"coordinator {address!r} unreachable after "
+                f"{timeout}s: {last}")
+            err.append_trace(f"coordinator={address!r} "
+                             f"connect_timeout={timeout}")
+            raise err from last
+        time.sleep(min(0.1, max(deadline - time.monotonic(), 0)))
 
 
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    connect_timeout: Optional[float] = None,
 ) -> None:
     """Join the multi-host pool (MPI_Init analog; idempotent).
 
     With no arguments, uses the cluster-environment auto-detection
     (TPU pods set the coordinator through the metadata environment).
     Call before any jax computation, once per host process.
+
+    ``connect_timeout`` (seconds) bounds the coordinator handshake —
+    without it jax's default wait is minutes (or a hard C++ abort once
+    the internal deadline trips), and an unreachable coordinator
+    (wrong address, dead pod slice) looks like a hang. With it, worker
+    processes with an *explicit* nonzero ``process_id`` TCP-probe the
+    coordinator first and raise
+    :class:`~libskylark_tpu.base.errors.CommunicationError` (the
+    taxonomy's MPI-exception analog) with the coordinator address in
+    the trace, never a raw ``RuntimeError``. Process 0 — which hosts
+    the coordinator service itself — and auto-detected processes
+    (``process_id=None``: this process might *be* the coordinator, so
+    a probe would deadlock the pod) skip the probe and get the bounded
+    ``initialization_timeout``; that bound still ends in jax's C++
+    ``LOG(FATAL)`` rather than a Python exception on this jax build,
+    so pass an explicit ``process_id`` where a catchable failure
+    matters.
     """
+    kw = {}
+    if connect_timeout is not None:
+        if coordinator_address and process_id not in (None, 0):
+            _probe_coordinator(coordinator_address, connect_timeout)
+        # jax >= 0.4.15 takes initialization_timeout; degrade gracefully
+        # (the default wait) on builds that predate it rather than dying
+        # on an unexpected-kwarg TypeError
+        params = inspect.signature(jax.distributed.initialize).parameters
+        if "initialization_timeout" in params:
+            kw["initialization_timeout"] = int(max(connect_timeout, 1))
     try:
         jax.distributed.initialize(
-            coordinator_address, num_processes, process_id)
+            coordinator_address, num_processes, process_id, **kw)
     except RuntimeError as e:  # already initialized — MPI_Init semantics
         msg = str(e).lower()
-        if "already" not in msg and "only be called once" not in msg:
-            raise
+        if "already" in msg or "only be called once" in msg:
+            return
+        err = errors.CommunicationError(
+            f"distributed initialization failed: {e}")
+        err.append_trace(
+            f"coordinator={coordinator_address!r} "
+            f"num_processes={num_processes} process_id={process_id} "
+            f"connect_timeout={connect_timeout}")
+        raise err from e
 
 
 def process_count() -> int:

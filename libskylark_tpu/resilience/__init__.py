@@ -1,0 +1,48 @@
+"""Resilience subsystem: retry policies, deterministic fault
+injection, and preemption-safe teardown.
+
+The reference library has no failure handling (SURVEY.md §5's "failure
+detection / checkpoint-resume" row is empty); this package makes the
+rebuild's failure paths first-class and — the part chaos testing lives
+or dies on — *deterministically testable*:
+
+- :mod:`~libskylark_tpu.resilience.policy` — composable
+  :class:`RetryPolicy` (exponential backoff + decorrelated jitter,
+  per-attempt timeouts, error-class predicates over the
+  :mod:`base.errors` taxonomy) and :class:`Deadline` budgets that
+  thread through call stacks.
+- :mod:`~libskylark_tpu.resilience.faults` — a seeded fault-injection
+  registry behind named sites in the serve flush worker, the engine
+  compile path, the WebHDFS/chunked readers and checkpoint saves;
+  activated by ``SKYLARK_FAULT_PLAN`` or ``with fault_plan(...)``,
+  replaying bit-identically for a fixed seed.
+- :mod:`~libskylark_tpu.resilience.preemption` —
+  :func:`install_preemption_handler` turns SIGTERM into serve drain
+  plus a final synchronous checkpoint for registered host-loop
+  solvers.
+
+Consumers: the microbatch executor's poison-isolation bisection and
+health states (:mod:`libskylark_tpu.engine.serve`), the WebHDFS
+transport's reconnect-and-resume (:mod:`libskylark_tpu.io.webhdfs`),
+the HDF5 batch reader, ``TrainCheckpointer.save_sync``, and
+``BlockADMMSolver.train``'s preemption poll. See ``docs/resilience``.
+"""
+
+from libskylark_tpu.resilience import faults
+from libskylark_tpu.resilience.faults import (FaultPlan, fault_plan,
+                                              fired)
+from libskylark_tpu.resilience.policy import (TRANSIENT_ERRORS, Deadline,
+                                              DeadlineExceededError,
+                                              RetryPolicy)
+from libskylark_tpu.resilience.preemption import (
+    drain_serving, install_preemption_handler, on_preemption,
+    preemption_requested, register_checkpoint, reset_preemption,
+    uninstall_preemption_handler, wait_for_preemption_teardown)
+
+__all__ = [
+    "Deadline", "DeadlineExceededError", "FaultPlan", "RetryPolicy",
+    "TRANSIENT_ERRORS", "drain_serving", "fault_plan", "faults", "fired",
+    "install_preemption_handler", "on_preemption", "preemption_requested",
+    "register_checkpoint", "reset_preemption",
+    "uninstall_preemption_handler", "wait_for_preemption_teardown",
+]

@@ -1,0 +1,304 @@
+"""Deterministic, seeded fault injection behind named sites.
+
+Chaos testing is only useful if a failure found once can be replayed
+bit-identically. This registry gives the repo's failure paths that
+property: production code plants cheap **named injection sites**
+(``faults.check("serve.flush", ...)`` — a no-op unless a plan is
+active) and a **fault plan** decides, deterministically, which hits of
+which sites raise which error class.
+
+Sites planted today:
+
+====================  ====================================================
+``serve.flush``       the microbatch flush worker, once per cohort
+                      execution attempt (:mod:`libskylark_tpu.engine
+                      .serve` — the poison-isolation bisection retries
+                      re-enter the site)
+``engine.compile``    the executable-cache cold-compile path
+                      (:mod:`libskylark_tpu.engine.compiled`)
+``io.webhdfs.open``   the WebHDFS OPEN request (per connection attempt)
+``io.webhdfs.read``   the WebHDFS chunk-read loop (per chunk)
+``io.chunked.read``   the HDF5 batch-slice reads
+``io.chunked.batch``  the libsvm batch parser, once per yielded batch
+``checkpoint.save``   :meth:`TrainCheckpointer.save` / ``save_sync``
+====================  ====================================================
+
+A plan is a JSON document (or the equivalent dict)::
+
+    {"seed": 7,
+     "faults": [
+       {"site": "serve.flush", "error": "SketchError", "tag": "poison"},
+       {"site": "io.webhdfs.read", "error": "IOError_", "on_hit": 3},
+       {"site": "serve.flush", "error": "IOError_", "every": 64},
+       {"site": "engine.compile", "error": "AllocationError",
+        "prob": 0.01, "times": 2}
+     ]}
+
+Spec fields (all optional except ``site``): ``error`` (a class name
+from :mod:`libskylark_tpu.base.errors`, or a builtin exception name;
+default ``IOError_``), ``message``, and the firing rule —
+
+``on_hit``  fire exactly on the Nth matching hit (1-indexed);
+``every``   fire on every Nth matching hit;
+``prob``    fire with probability p from a per-spec RNG seeded by
+            ``(plan seed, site, spec index)`` — same seed, same hit
+            sequence ⇒ same decisions, bit-identical replay;
+``after``   skip the first N matching hits;
+``times``   fire at most N times (default unlimited);
+``tag``     fire only when the check's ``tags`` contain this tag —
+            the hook that pins a fault to a *request* (a test submits
+            under ``with faults.tag("poison"):`` and only cohorts
+            containing that request fail, which is exactly what the
+            serve bisection needs to converge on the poison).
+
+Activation: ``with fault_plan(plan): ...`` (tests), or the
+``SKYLARK_FAULT_PLAN`` environment variable holding the JSON itself or
+a path to it (chaos CI). A context plan shadows the env plan. Every
+fired fault is recorded — ``fired()`` returns the
+``(site, hit, error_name)`` sequence, which the chaos gate compares
+across runs to prove determinism.
+"""
+
+from __future__ import annotations
+
+import builtins
+import contextlib
+import json
+import os
+import random
+import threading
+from typing import Iterable, Optional, Sequence
+
+from libskylark_tpu.base import errors
+
+_VALID_KEYS = {"site", "error", "message", "on_hit", "every", "prob",
+               "after", "times", "tag"}
+
+
+def _resolve_error(name: str) -> type:
+    cls = getattr(errors, name, None)
+    if isinstance(cls, type) and issubclass(cls, BaseException):
+        return cls
+    cls = getattr(builtins, name, None)
+    if isinstance(cls, type) and issubclass(cls, BaseException):
+        return cls
+    raise errors.InvalidParametersError(
+        f"fault plan names unknown error class {name!r} (expected a "
+        f"libskylark_tpu.base.errors class or a builtin exception)")
+
+
+class FaultSpec:
+    """One compiled plan entry; owns its hit counter and RNG stream."""
+
+    __slots__ = ("site", "error_name", "error_cls", "message", "on_hit",
+                 "every", "prob", "after", "times", "tag",
+                 "hits", "fires", "_rng")
+
+    def __init__(self, doc: dict, seed: int, index: int):
+        unknown = set(doc) - _VALID_KEYS
+        if unknown:
+            raise errors.InvalidParametersError(
+                f"fault spec has unknown field(s) {sorted(unknown)}")
+        if "site" not in doc:
+            raise errors.InvalidParametersError(
+                f"fault spec missing 'site': {doc!r}")
+        self.site = str(doc["site"])
+        self.error_name = str(doc.get("error", "IOError_"))
+        self.error_cls = _resolve_error(self.error_name)
+        self.message = doc.get("message")
+        self.on_hit = int(doc["on_hit"]) if "on_hit" in doc else None
+        self.every = int(doc["every"]) if "every" in doc else None
+        self.prob = float(doc["prob"]) if "prob" in doc else None
+        self.after = int(doc.get("after", 0))
+        self.times = int(doc["times"]) if "times" in doc else None
+        self.tag = doc.get("tag")
+        self.hits = 0
+        self.fires = 0
+        # per-spec stream: decisions depend only on (plan seed, site,
+        # spec position, matching-hit index) — replay is bit-identical
+        self._rng = random.Random(f"{seed}:{self.site}:{index}")
+
+    def decide(self, tags: frozenset) -> bool:
+        """Whether this check fires the spec. Caller holds the plan
+        lock; counters and the RNG advance only on *matching* hits so
+        tag-filtered specs replay independently of other traffic."""
+        if self.tag is not None and self.tag not in tags:
+            return False
+        self.hits += 1
+        if self.hits <= self.after:
+            return False
+        if self.times is not None and self.fires >= self.times:
+            return False
+        if self.on_hit is not None and self.hits != self.on_hit:
+            return False
+        if self.every is not None and self.hits % self.every != 0:
+            return False
+        if self.prob is not None and self._rng.random() >= self.prob:
+            return False
+        self.fires += 1
+        return True
+
+
+class FaultPlan:
+    """A compiled, activatable plan: specs + the fired-fault log."""
+
+    def __init__(self, doc: dict):
+        if not isinstance(doc, dict):
+            raise errors.InvalidParametersError(
+                f"fault plan must be a JSON object, got {type(doc).__name__}")
+        self.seed = int(doc.get("seed", 0))
+        self.specs = [FaultSpec(d, self.seed, i)
+                      for i, d in enumerate(doc.get("faults", []))]
+        self._sites = {s.site for s in self.specs}
+        self._lock = threading.Lock()
+        self.fired: list[tuple] = []      # (site, matching-hit, error name)
+
+    @classmethod
+    def parse(cls, text_or_path: str) -> "FaultPlan":
+        """JSON text, or a path to a JSON file (the env-var forms)."""
+        text = text_or_path.strip()
+        if not text.startswith("{") and os.path.exists(text_or_path):
+            with open(text_or_path) as fh:
+                text = fh.read()
+        try:
+            return cls(json.loads(text))
+        except json.JSONDecodeError as e:
+            raise errors.InvalidParametersError(
+                f"SKYLARK_FAULT_PLAN is neither valid JSON nor a "
+                f"readable path: {e}") from e
+
+    def check(self, site: str, tags: frozenset, detail: str) -> None:
+        if site not in self._sites:
+            return
+        with self._lock:
+            for spec in self.specs:
+                if spec.site != site:
+                    continue
+                if spec.decide(tags):
+                    self.fired.append((site, spec.hits, spec.error_name))
+                    err = spec.error_cls(
+                        spec.message
+                        or f"injected fault at {site} (hit {spec.hits})")
+                    if isinstance(err, errors.SkylarkError):
+                        err.append_trace(
+                            f"fault-injected: site={site} hit={spec.hits}"
+                            + (f" detail={detail}" if detail else ""))
+                    raise err
+
+    def reset(self) -> None:
+        """Zero every counter, RNG stream, and the fired log — the next
+        run under this plan replays from the beginning."""
+        with self._lock:
+            self.fired.clear()
+            for i, spec in enumerate(self.specs):
+                spec.hits = spec.fires = 0
+                spec._rng = random.Random(f"{self.seed}:{spec.site}:{i}")
+
+
+# ---------------------------------------------------------------------------
+# activation: context-manager stack shadowing the env plan
+# ---------------------------------------------------------------------------
+
+_STACK: list[FaultPlan] = []
+_STACK_LOCK = threading.Lock()
+_ENV_RAW: Optional[str] = None
+_ENV_PLAN: Optional[FaultPlan] = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The plan checks consult: the innermost context plan, else the
+    ``SKYLARK_FAULT_PLAN`` env plan (parsed once per distinct value),
+    else ``None`` (every site a no-op)."""
+    if _STACK:
+        return _STACK[-1]
+    env = os.environ.get("SKYLARK_FAULT_PLAN")
+    if not env:
+        return None
+    global _ENV_RAW, _ENV_PLAN
+    if env != _ENV_RAW:
+        # parse-and-cache under the lock: two threads racing the first
+        # check must end up counting hits on ONE plan instance, or the
+        # bit-identical-replay guarantee (and on_hit accounting) breaks
+        with _STACK_LOCK:
+            if env != _ENV_RAW:
+                _ENV_PLAN = FaultPlan.parse(env)
+                _ENV_RAW = env
+    return _ENV_PLAN
+
+
+@contextlib.contextmanager
+def fault_plan(plan):
+    """Activate ``plan`` (a dict, JSON string, or :class:`FaultPlan`)
+    for the dynamic extent of the block. Nests; the innermost wins."""
+    if isinstance(plan, str):
+        plan = FaultPlan.parse(plan)
+    elif isinstance(plan, dict):
+        plan = FaultPlan(plan)
+    elif not isinstance(plan, FaultPlan):
+        raise errors.InvalidParametersError(
+            f"fault_plan takes a dict / JSON string / FaultPlan, got "
+            f"{type(plan).__name__}")
+    with _STACK_LOCK:
+        _STACK.append(plan)
+    try:
+        yield plan
+    finally:
+        with _STACK_LOCK:
+            _STACK.remove(plan)
+
+
+def check(site: str, tags: Iterable[str] = (), detail: str = "") -> None:
+    """The injection-site entry point. Near-zero cost when no plan is
+    active (one attr read + one env lookup); under a plan, consults the
+    site's specs and raises the chosen error class when one fires."""
+    plan = active_plan()
+    if plan is None:
+        return
+    plan.check(site, frozenset(tags) | current_tags(), detail)
+
+
+def fired() -> list[tuple]:
+    """The active plan's fired-fault log ``[(site, hit, error), ...]``
+    — the determinism witness the chaos gate compares across runs."""
+    plan = active_plan()
+    return list(plan.fired) if plan is not None else []
+
+
+def reset() -> None:
+    """Reset the active plan's counters/log (chaos replay runs)."""
+    plan = active_plan()
+    if plan is not None:
+        plan.reset()
+
+
+# ---------------------------------------------------------------------------
+# request tagging: pin a fault to a request, not a call count
+# ---------------------------------------------------------------------------
+
+_TAGS = threading.local()
+
+
+def current_tags() -> frozenset:
+    """The calling thread's active fault tags (see :func:`tag`)."""
+    return getattr(_TAGS, "tags", frozenset())
+
+
+@contextlib.contextmanager
+def tag(*names: str):
+    """Tag everything submitted/executed in this block. The serve layer
+    captures the submitting thread's tags onto each request and replays
+    their union at every flush attempt — a spec with ``"tag":
+    "poison"`` then fires exactly when the tagged request is in the
+    executing cohort, which is what lets bisection converge on it."""
+    prev = current_tags()
+    _TAGS.tags = prev | frozenset(names)
+    try:
+        yield
+    finally:
+        _TAGS.tags = prev
+
+
+__all__ = [
+    "FaultPlan", "FaultSpec", "active_plan", "check", "current_tags",
+    "fault_plan", "fired", "reset", "tag",
+]

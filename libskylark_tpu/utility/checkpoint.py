@@ -76,6 +76,9 @@ class TrainCheckpointer:
         """Persist ``state`` (pytree of arrays) at ``step``. Returns
         immediately in async mode; the write is crash-consistent (orbax
         commits atomically per step directory)."""
+        from libskylark_tpu.resilience import faults
+
+        faults.check("checkpoint.save", detail=f"step={int(step)}")
         self._mngr.save(
             int(step),
             args=ocp.args.Composite(
@@ -83,6 +86,25 @@ class TrainCheckpointer:
                 metadata=ocp.args.JsonSave(metadata or {}),
             ),
         )
+
+    def save_sync(self, step: int, state: Any,
+                  metadata: Optional[dict] = None, retry=None) -> None:
+        """The preemption-handler save: blocks until the step is durable
+        on disk, retrying transient failures under ``retry`` (default: 3
+        attempts, short backoff — a SIGTERM grace window is seconds, not
+        minutes). Used by
+        :func:`libskylark_tpu.resilience.register_checkpoint`; a normal
+        training loop wants the async :meth:`save` instead."""
+        from libskylark_tpu.resilience.policy import RetryPolicy
+
+        retry = retry or RetryPolicy(max_attempts=3, base_delay=0.1,
+                                     max_delay=1.0)
+
+        def attempt():
+            self.save(step, state, metadata)
+            self._mngr.wait_until_finished()
+
+        retry.call(attempt)
 
     # -- read side --
 

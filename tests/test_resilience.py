@@ -1,0 +1,974 @@
+"""Resilience subsystem (libskylark_tpu/resilience/).
+
+Oracles:
+
+- *policy*: deterministic backoff given a seed; retry/give-up decisions
+  follow the error-class predicate; deadline budgets bound both the
+  attempt count and the per-attempt timeouts threaded into callables.
+- *faults*: a fixed plan seed replays a bit-identical injected-fault
+  sequence (the chaos-gate property); tags pin faults to requests; the
+  env activation path parses both inline JSON and files.
+- *serve isolation*: one poison request in a full cohort fails alone
+  with the injected class; every cohort-mate resolves bit-equal to the
+  fault-free run in ≤ log2(max_batch) bisection levels; health states
+  degrade/shed/recover; drain reaches quiescence with zero orphans.
+- *I/O*: WebHDFS OPEN retries transient failures (attempt count in the
+  trace), reads reconnect-and-resume at the consumed byte offset
+  bit-identically; HDF5 slice reads retry under the policy.
+- *engine*: a compile-path fault takes the abort route (single-flight
+  waiters released; a later call compiles clean with no recompile).
+- *preemption*: SIGTERM drains executors, runs registered synchronous
+  checkpoint hooks, and sets the sticky flag the ADMM loop polls.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import signal
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from libskylark_tpu import Context, engine, resilience
+from libskylark_tpu import sketch as sk
+from libskylark_tpu.base import errors
+from libskylark_tpu.resilience import (Deadline, DeadlineExceededError,
+                                       RetryPolicy, faults)
+
+
+@pytest.fixture()
+def fresh_engine():
+    engine.reset()
+    yield
+    engine.reset()
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+
+class TestDeadline:
+    def test_unbounded(self):
+        d = Deadline.after(None)
+        assert d.remaining() == math.inf and not d.expired
+        d.check("never raises")
+
+    def test_expiry_and_check(self):
+        d = Deadline.after(0.0)
+        assert d.expired
+        with pytest.raises(DeadlineExceededError, match="solve"):
+            d.check("solve")
+
+    def test_coerce(self):
+        assert Deadline.coerce(None) is None
+        d = Deadline.after(5)
+        assert Deadline.coerce(d) is d
+        assert isinstance(Deadline.coerce(0.5), Deadline)
+
+    def test_is_a_timeout_and_a_skylark_error(self):
+        e = DeadlineExceededError("x")
+        assert isinstance(e, TimeoutError)
+        assert isinstance(e, errors.SkylarkError)
+
+
+class TestRetryPolicy:
+    def test_deterministic_delays_given_seed(self):
+        a = RetryPolicy(seed=13)
+        b = RetryPolicy(seed=13)
+        da = [d for d, _ in zip(a.delays(), range(6))]
+        db = [d for d, _ in zip(b.delays(), range(6))]
+        assert da == db
+        assert all(0 < d <= a.max_delay for d in da)
+
+    def test_jitter_modes(self):
+        none = RetryPolicy(jitter="none", base_delay=0.1, multiplier=2.0,
+                           max_delay=10.0)
+        ds = [d for d, _ in zip(none.delays(), range(3))]
+        assert ds == [0.1, 0.2, 0.4]
+        with pytest.raises(errors.InvalidParametersError):
+            RetryPolicy(jitter="bogus")
+        with pytest.raises(errors.InvalidParametersError):
+            RetryPolicy(max_attempts=0)
+
+    def test_retries_transient_then_succeeds(self):
+        slept = []
+        p = RetryPolicy(max_attempts=4, seed=0, sleep=slept.append)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise errors.IOError_("blip")
+            return 42
+
+        assert p.call(flaky) == 42
+        assert calls["n"] == 3 and len(slept) == 2
+
+    def test_exhausts_with_trace(self):
+        p = RetryPolicy(max_attempts=3, seed=0, sleep=lambda s: None)
+
+        def always():
+            raise errors.CommunicationError("down")
+
+        with pytest.raises(errors.CommunicationError) as ei:
+            p.call(always)
+        assert any("attempt 3/3" in t for t in ei.value.trace)
+
+    def test_non_retryable_propagates_immediately(self):
+        p = RetryPolicy(max_attempts=5, sleep=lambda s: None)
+        calls = {"n": 0}
+
+        def logic_bug():
+            calls["n"] += 1
+            raise errors.InvalidParametersError("bad")
+
+        with pytest.raises(errors.InvalidParametersError):
+            p.call(logic_bug)
+        assert calls["n"] == 1
+
+    def test_predicate_retry_on(self):
+        p = RetryPolicy(max_attempts=3, sleep=lambda s: None,
+                        retry_on=lambda e: "yes" in str(e))
+        calls = {"n": 0}
+
+        def once():
+            calls["n"] += 1
+            raise RuntimeError("yes" if calls["n"] == 1 else "no")
+
+        with pytest.raises(RuntimeError, match="no"):
+            p.call(once)
+        assert calls["n"] == 2
+
+    def test_deadline_bounds_attempts(self):
+        p = RetryPolicy(max_attempts=50, base_delay=0.0, max_delay=0.0,
+                        sleep=lambda s: None)
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise errors.IOError_("blip")
+
+        with pytest.raises(DeadlineExceededError):
+            p.call(always, deadline=Deadline.after(0.0))
+        assert calls["n"] == 0          # budget gone before attempt 1
+
+    def test_timeout_arg_threading(self):
+        p = RetryPolicy(max_attempts=1, attempt_timeout=5.0,
+                        timeout_arg="timeout")
+        seen = {}
+
+        def fn(timeout=None):
+            seen["t"] = timeout
+            return "ok"
+
+        assert p.call(fn, deadline=Deadline.after(2.0)) == "ok"
+        assert seen["t"] == pytest.approx(2.0, abs=0.2)  # min(5, remaining)
+
+    def test_deadline_exceeded_is_never_retryable(self):
+        """Regression: DeadlineExceededError inherits TimeoutError (an
+        OSError), which every transient predicate matches — but an
+        exhausted budget must STOP, not back off and re-attempt."""
+        from libskylark_tpu.io.webhdfs import _is_transient
+
+        e = DeadlineExceededError("budget gone")
+        assert isinstance(e, OSError)       # the trap: OSError IS transient
+        assert not RetryPolicy().retryable(e)
+        assert not _is_transient(e)
+        # a nested call whose inner layer raises on its deadline check
+        # consumes exactly one attempt of an outer default policy
+        calls = {"n": 0}
+
+        def inner():
+            calls["n"] += 1
+            Deadline.after(0.0).check("inner work")
+
+        with pytest.raises(DeadlineExceededError):
+            RetryPolicy(max_attempts=5, sleep=lambda s: None).call(inner)
+        assert calls["n"] == 1
+
+    def test_decorator_form(self):
+        calls = {"n": 0}
+
+        @RetryPolicy(max_attempts=2, sleep=lambda s: None)
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise errors.IOError_("blip")
+            return "done"
+
+        assert flaky() == "done"
+
+
+# ---------------------------------------------------------------------------
+# faults
+# ---------------------------------------------------------------------------
+
+
+class TestFaultRegistry:
+    def test_inactive_is_noop(self):
+        faults.check("serve.flush")
+        assert faults.fired() == []
+
+    def test_on_hit_every_after_times(self):
+        plan = {"seed": 0, "faults": [
+            {"site": "a", "error": "IOError_", "on_hit": 2},
+            {"site": "b", "error": "MLError", "every": 3, "times": 2},
+            {"site": "c", "error": "NLAError", "after": 2},
+        ]}
+        with faults.fault_plan(plan) as fp:
+            seq_a = []
+            for _ in range(4):
+                try:
+                    faults.check("a")
+                    seq_a.append(0)
+                except errors.IOError_:
+                    seq_a.append(1)
+            assert seq_a == [0, 1, 0, 0]
+            seq_b = []
+            for _ in range(9):
+                try:
+                    faults.check("b")
+                    seq_b.append(0)
+                except errors.MLError:
+                    seq_b.append(1)
+            assert seq_b == [0, 0, 1, 0, 0, 1, 0, 0, 0]   # times=2 caps
+            seq_c = []
+            for _ in range(4):
+                try:
+                    faults.check("c")
+                    seq_c.append(0)
+                except errors.NLAError:
+                    seq_c.append(1)
+            assert seq_c == [0, 0, 1, 1]
+            assert [f[0] for f in fp.fired] == ["a", "b", "b", "c", "c"]
+
+    def test_prob_is_seed_deterministic(self):
+        plan = {"seed": 99, "faults": [
+            {"site": "p", "error": "IOError_", "prob": 0.5}]}
+
+        def run():
+            out = []
+            with faults.fault_plan(plan):
+                for _ in range(32):
+                    try:
+                        faults.check("p")
+                        out.append(0)
+                    except errors.IOError_:
+                        out.append(1)
+            return out
+
+        a, b = run(), run()
+        assert a == b
+        assert 0 < sum(a) < 32     # actually probabilistic, not const
+
+    def test_tag_pinning_and_trace(self):
+        plan = {"seed": 0, "faults": [
+            {"site": "t", "error": "SketchError", "tag": "poison"}]}
+        with faults.fault_plan(plan):
+            faults.check("t")                      # untagged: no fire
+            with pytest.raises(errors.SketchError) as ei:
+                with faults.tag("poison"):
+                    faults.check("t", detail="d1")
+            assert "fault-injected" in ei.value.trace[0]
+            assert "site=t" in ei.value.trace[0]
+
+    def test_reset_replays_identically(self):
+        plan = {"seed": 4, "faults": [
+            {"site": "r", "error": "IOError_", "prob": 0.4}]}
+        with faults.fault_plan(plan) as fp:
+            def burst():
+                got = []
+                for _ in range(16):
+                    try:
+                        faults.check("r")
+                        got.append(0)
+                    except errors.IOError_:
+                        got.append(1)
+                return got, list(fp.fired)
+
+            g1, f1 = burst()
+            fp.reset()
+            g2, f2 = burst()
+        assert g1 == g2 and f1 == f2
+
+    def test_env_activation_inline_and_file(self, tmp_path, monkeypatch):
+        doc = ('{"seed": 1, "faults": '
+               '[{"site": "e", "error": "IOError_"}]}')
+        monkeypatch.setenv("SKYLARK_FAULT_PLAN", doc)
+        with pytest.raises(errors.IOError_):
+            faults.check("e")
+        p = tmp_path / "plan.json"
+        p.write_text(doc)
+        monkeypatch.setenv("SKYLARK_FAULT_PLAN", str(p))
+        with pytest.raises(errors.IOError_):
+            faults.check("e")
+        monkeypatch.delenv("SKYLARK_FAULT_PLAN")
+        faults.check("e")            # back to no-op
+
+    def test_context_plan_shadows_env(self, monkeypatch):
+        monkeypatch.setenv(
+            "SKYLARK_FAULT_PLAN",
+            '{"seed": 0, "faults": [{"site": "s", "error": "IOError_"}]}')
+        with faults.fault_plan({"seed": 0, "faults": []}):
+            faults.check("s")        # inner empty plan wins
+
+    def test_bad_plans_refused(self):
+        with pytest.raises(errors.InvalidParametersError, match="unknown"):
+            faults.FaultPlan({"faults": [{"site": "x", "bogus": 1}]})
+        with pytest.raises(errors.InvalidParametersError,
+                           match="error class"):
+            faults.FaultPlan({"faults": [{"site": "x",
+                                          "error": "NopeError"}]})
+        with pytest.raises(errors.InvalidParametersError, match="site"):
+            faults.FaultPlan({"faults": [{"error": "IOError_"}]})
+        with pytest.raises(errors.InvalidParametersError):
+            faults.FaultPlan.parse("not json at all")
+
+
+# ---------------------------------------------------------------------------
+# serve: poison isolation, health states, drain
+# ---------------------------------------------------------------------------
+
+
+def _sketch_reqs(n, seed=0, n_feat=40, s_dim=16):
+    rng = np.random.default_rng(seed)
+    ctx = Context(seed=seed)
+    T = sk.CWT(n_feat, s_dim, ctx)
+    ops = [rng.standard_normal((n_feat, 3 + i % 4)).astype(np.float32)
+           for i in range(n)]
+    refs = [np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+            for A in ops]
+    return T, ops, refs
+
+
+POISON_PLAN = {"seed": 0, "faults": [
+    {"site": "serve.flush", "error": "SketchError", "tag": "poison"}]}
+
+
+class TestPoisonIsolation:
+    def test_poison_fails_alone_full_cohort(self, fresh_engine):
+        """The acceptance criterion: one poison in a FULL cohort fails
+        alone; every cohort-mate's future resolves bit-equal to the
+        fault-free run, within log2(max_batch) bisection levels."""
+        T, ops, refs = _sketch_reqs(8)
+        with faults.fault_plan(POISON_PLAN):
+            ex = engine.MicrobatchExecutor(max_batch=8,
+                                           linger_us=10_000_000)
+            futs = []
+            for i, A in enumerate(ops):
+                if i == 3:
+                    with faults.tag("poison"):
+                        futs.append(ex.submit_sketch(T, A))
+                else:
+                    futs.append(ex.submit_sketch(T, A))
+            ex.flush()
+            assert all(f.done() for f in futs), "orphaned futures"
+            assert isinstance(futs[3].exception(), errors.SketchError)
+            for i in (0, 1, 2, 4, 5, 6, 7):
+                assert np.array_equal(np.asarray(futs[i].result()),
+                                      refs[i]), i
+            st = ex.stats()
+            assert st["poisoned"] == 1 and st["failed"] == 1
+            assert st["completed"] == 7
+            assert st["isolation_depth_peak"] <= math.ceil(math.log2(8))
+            ex.shutdown()
+
+    def test_transient_fault_absorbed_no_client_failures(self,
+                                                         fresh_engine):
+        """An attempt-counted (not request-pinned) fault fails the full
+        flush once; the bisection halves re-execute clean — nobody's
+        future errors."""
+        T, ops, refs = _sketch_reqs(8, seed=5)
+        plan = {"seed": 0, "faults": [
+            {"site": "serve.flush", "error": "IOError_", "on_hit": 1}]}
+        with faults.fault_plan(plan):
+            ex = engine.MicrobatchExecutor(max_batch=8,
+                                           linger_us=10_000_000)
+            futs = [ex.submit_sketch(T, A) for A in ops]
+            ex.flush()
+            for f, r in zip(futs, refs):
+                assert np.array_equal(np.asarray(f.result(timeout=60)), r)
+            st = ex.stats()
+            assert st["poisoned"] == 0 and st["failed"] == 0
+            assert st["flush_failures"] == 1
+            assert st["isolation_retries"] == 2
+            ex.shutdown()
+
+    def test_chaos_replay_is_bit_identical(self, fresh_engine):
+        """Same plan seed ⇒ identical fired sequence and identical
+        surviving bits (the determinism acceptance criterion, at unit
+        scale — the full storm is benchmarks/chaos_battery.py)."""
+        T, ops, refs = _sketch_reqs(16, seed=9)
+
+        def run():
+            outs, firing = [], None
+            with faults.fault_plan(POISON_PLAN):
+                ex = engine.MicrobatchExecutor(max_batch=8,
+                                               linger_us=10_000_000)
+                futs = []
+                for i, A in enumerate(ops):
+                    if i == 5:
+                        with faults.tag("poison"):
+                            futs.append(ex.submit_sketch(T, A))
+                    else:
+                        futs.append(ex.submit_sketch(T, A))
+                    if (i + 1) % 8 == 0:
+                        ex.flush()
+                ex.flush()
+                for f in futs:
+                    e = f.exception(timeout=60)
+                    outs.append(("E", type(e).__name__) if e else
+                                ("OK", np.asarray(f.result())))
+                firing = faults.fired()
+                ex.shutdown()
+            return outs, firing
+
+        o1, f1 = run()
+        o2, f2 = run()
+        assert f1 == f2 and f1
+        for (s1, v1), (s2, v2), ref in zip(o1, o2, refs):
+            assert s1 == s2
+            if s1 == "OK":
+                assert np.array_equal(v1, v2)
+                assert np.array_equal(v1, ref)
+
+
+class TestHealthStates:
+    def test_serving_to_degraded_and_back(self, fresh_engine):
+        T, ops, _ = _sketch_reqs(12, seed=3)
+        plan = {"seed": 0, "faults": [
+            {"site": "serve.flush", "error": "IOError_", "tag": "bad"}]}
+        ex = engine.MicrobatchExecutor(max_batch=1, linger_us=10_000_000,
+                                       failure_window=8,
+                                       degraded_threshold=0.5)
+        try:
+            assert ex.state == engine.SERVING
+            with faults.fault_plan(plan):
+                with faults.tag("bad"):
+                    futs = [ex.submit_sketch(T, A) for A in ops[:6]]
+                ex.flush()
+                for f in futs:
+                    assert isinstance(f.exception(timeout=60),
+                                      errors.IOError_)
+            assert ex.state == engine.DEGRADED
+            # recovery: clean flushes push the window ratio back down
+            futs = [ex.submit_sketch(T, A) for A in ops[6:]]
+            ex.flush()
+            for f in futs:
+                f.result(timeout=60)
+            assert ex.state == engine.SERVING
+        finally:
+            ex.shutdown()
+
+    def test_degraded_sheds_immediately(self, fresh_engine):
+        T, ops, _ = _sketch_reqs(10, seed=4)
+        plan = {"seed": 0, "faults": [
+            {"site": "serve.flush", "error": "IOError_", "tag": "bad"}]}
+        ex = engine.MicrobatchExecutor(max_batch=1, linger_us=10_000_000,
+                                       max_queue=8, failure_window=8,
+                                       degraded_threshold=0.5,
+                                       shed_fraction=0.25)
+        try:
+            with faults.fault_plan(plan):
+                with faults.tag("bad"):
+                    futs = [ex.submit_sketch(T, A) for A in ops[:6]]
+                ex.flush()
+                [f.exception(timeout=60) for f in futs]
+            assert ex.state == engine.DEGRADED
+            # shed bound = max_queue * 0.25 = 2: the third queued submit
+            # is refused IMMEDIATELY (no backpressure linger)
+            f1 = ex.submit_sketch(T, ops[6])
+            f2 = ex.submit_sketch(T, ops[7])
+            with pytest.raises(engine.ServeOverloadedError, match="shed"):
+                ex.submit_sketch(T, ops[8], timeout=30.0)
+            assert ex.stats()["shed"] == 1
+            ex.flush()
+            f1.result(timeout=60), f2.result(timeout=60)
+        finally:
+            ex.shutdown()
+
+
+class TestDrain:
+    def test_drain_completes_pending_and_refuses_new(self, fresh_engine):
+        T, ops, refs = _sketch_reqs(5, seed=6)
+        ex = engine.MicrobatchExecutor(max_batch=8, linger_us=10_000_000)
+        futs = [ex.submit_sketch(T, A) for A in ops]
+        assert ex.drain(timeout=60.0)
+        assert ex.state == engine.STOPPED
+        for f, r in zip(futs, refs):
+            assert np.array_equal(np.asarray(f.result(timeout=1)), r)
+        with pytest.raises(engine.ServeOverloadedError, match="drain"):
+            ex.submit_sketch(T, ops[0])
+
+    def test_drain_idempotent_and_from_thread(self, fresh_engine):
+        T, ops, _ = _sketch_reqs(3, seed=7)
+        ex = engine.MicrobatchExecutor(max_batch=8, linger_us=10_000_000)
+        futs = [ex.submit_sketch(T, A) for A in ops]
+        t = threading.Thread(target=lambda: ex.drain(timeout=60.0))
+        t.start()
+        t.join(timeout=90)
+        assert not t.is_alive()
+        assert ex.drain() is True           # second drain: no-op
+        assert all(f.done() for f in futs)
+
+
+# ---------------------------------------------------------------------------
+# engine compile path
+# ---------------------------------------------------------------------------
+
+
+class TestEngineCompileFault:
+    def test_compile_fault_aborts_then_recovers(self, fresh_engine):
+        plan = {"seed": 0, "faults": [
+            {"site": "engine.compile", "error": "AllocationError",
+             "on_hit": 1}]}
+
+        def f(x):
+            return x * 2.0
+
+        cf = engine.compiled(f, name="resilience.compile_fault")
+        x = jnp.ones((4,), jnp.float32)
+        with faults.fault_plan(plan):
+            with pytest.raises(errors.AllocationError):
+                cf(x)
+            # the abort released the single-flight slot: the retry
+            # compiles clean (hit 2 ≠ on_hit) and it is NOT a recompile
+            # (the key was never inserted)
+            out = np.asarray(cf(x))
+        assert np.array_equal(out, np.full((4,), 2.0, np.float32))
+        assert engine.stats().recompiles == 0
+
+    def test_compile_fault_releases_concurrent_waiters(self,
+                                                       fresh_engine):
+        plan = {"seed": 0, "faults": [
+            {"site": "engine.compile", "error": "AllocationError",
+             "on_hit": 1}]}
+
+        def g(x):
+            return x + 1.0
+
+        cf = engine.compiled(g, name="resilience.waiter_release")
+        x = jnp.zeros((3,), jnp.float32)
+        results, errs = [], []
+
+        def call():
+            try:
+                results.append(np.asarray(cf(x)))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        with faults.fault_plan(plan):
+            threads = [threading.Thread(target=call) for _ in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "stranded waiter"
+        # exactly one thread ate the injected fault; the others
+        # inherited the compile and succeeded
+        assert len(errs) == 1 and isinstance(errs[0],
+                                             errors.AllocationError)
+        assert len(results) == 3
+        assert all(np.array_equal(r, np.ones((3,), np.float32))
+                   for r in results)
+
+
+# ---------------------------------------------------------------------------
+# I/O wiring
+# ---------------------------------------------------------------------------
+
+
+class TestWebHDFSResilience:
+    @staticmethod
+    def _stub(files, fail_after=None):
+        """Offset-aware WebHDFS stub; optionally kills the data
+        connection after ``fail_after`` bytes of each response (the
+        mid-stream datanode drop the resume path exists for)."""
+        import http.server
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                from urllib.parse import parse_qs, urlparse
+
+                u = urlparse(self.path)
+                q = parse_qs(u.query)
+                if u.path.startswith("/webhdfs/v1"):
+                    hdfs_path = u.path[len("/webhdfs/v1"):]
+                    loc = (f"http://127.0.0.1:{stub['port']}/data"
+                           f"{hdfs_path}?{u.query}")
+                    self.send_response(307)
+                    self.send_header("Location", loc)
+                    self.end_headers()
+                    return
+                body = files.get(u.path[len("/data"):])
+                if body is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                off = int(q.get("offset", ["0"])[0])
+                ln = q.get("length")
+                data = body[off:]
+                if ln is not None:
+                    data = data[: int(ln[0])]
+                stub["opens"] += 1
+                if fail_after is not None and len(data) > fail_after:
+                    # send a prefix then RST the socket (SO_LINGER 0):
+                    # the client's next read past its buffer raises
+                    # ConnectionResetError — the datanode-drop shape the
+                    # reconnect-resume path exists for (a clean FIN
+                    # would be indistinguishable from EOF)
+                    import socket
+                    import struct
+
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data[:fail_after])
+                    self.wfile.flush()
+                    self.connection.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+                    self.connection.close()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        stub = {"port": httpd.server_address[1], "opens": 0,
+                "httpd": httpd}
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return stub
+
+    def test_open_retries_injected_fault_then_succeeds(self):
+        from libskylark_tpu.io.webhdfs import webhdfs_lines
+
+        content = "".join(f"row {i}\n" for i in range(50)).encode()
+        stub = self._stub({"/d.txt": content})
+        try:
+            plan = {"seed": 0, "faults": [
+                {"site": "io.webhdfs.open", "error": "IOError_",
+                 "times": 2}]}
+            retry = RetryPolicy(max_attempts=4, base_delay=0.0,
+                                max_delay=0.0, sleep=lambda s: None,
+                                retry_on=(errors.IOError_,))
+            with faults.fault_plan(plan):
+                got = list(webhdfs_lines(
+                    f"http://127.0.0.1:{stub['port']}", "/d.txt",
+                    retry=retry))
+            assert got == content.decode().splitlines(keepends=True)
+        finally:
+            stub["httpd"].shutdown()
+            stub["httpd"].server_close()
+
+    def test_open_failure_trace_has_url_and_attempts(self):
+        from libskylark_tpu.io.webhdfs import webhdfs_lines
+
+        retry = RetryPolicy(max_attempts=2, base_delay=0.0,
+                            max_delay=0.0, sleep=lambda s: None)
+        with pytest.raises(errors.IOError_) as ei:
+            # unroutable port: connection refused on every attempt
+            list(webhdfs_lines("http://127.0.0.1:9", "/nope.txt",
+                               timeout=0.5, retry=retry))
+        trace = " | ".join(ei.value.trace)
+        assert "url=http://127.0.0.1:9/webhdfs/v1/nope.txt" in trace
+        assert "attempts=2/2" in trace
+
+    def test_read_resumes_at_offset_bit_identical(self):
+        """Mid-stream connection drops reconnect at the consumed byte
+        offset; the recomposed line stream equals the clean read."""
+        from libskylark_tpu.io.webhdfs import _is_transient, webhdfs_lines
+
+        content = "".join(
+            f"line {i} with some padding text\n" for i in range(200)
+        ).encode() + b"tail-without-newline"
+        stub = self._stub({"/big.txt": content}, fail_after=1024)
+        try:
+            retry = RetryPolicy(max_attempts=64, base_delay=0.0,
+                                max_delay=0.0, sleep=lambda s: None,
+                                retry_on=_is_transient)
+            got = list(webhdfs_lines(
+                f"http://127.0.0.1:{stub['port']}", "/big.txt",
+                buffer_bytes=256, retry=retry))
+            assert got == content.decode().splitlines(keepends=True)
+            assert stub["opens"] > 1, "resume path never exercised"
+        finally:
+            stub["httpd"].shutdown()
+            stub["httpd"].server_close()
+
+    def test_non_transient_http_error_fails_fast(self):
+        from libskylark_tpu.io.webhdfs import webhdfs_lines
+
+        stub = self._stub({})          # every path 404s
+        try:
+            # the transport's own default predicate: a 404 is not
+            # transient, so it consumes exactly one attempt
+            with pytest.raises(errors.IOError_) as ei:
+                list(webhdfs_lines(
+                    f"http://127.0.0.1:{stub['port']}", "/gone.txt"))
+            assert any("attempts=1/" in t for t in ei.value.trace)
+        finally:
+            stub["httpd"].shutdown()
+            stub["httpd"].server_close()
+
+
+class TestChunkedResilience:
+    def test_hdf5_slice_reads_retry(self, tmp_path):
+        h5py = pytest.importorskip("h5py")  # noqa: F841
+        from libskylark_tpu.io import chunked
+        from libskylark_tpu.io.hdf5 import write_hdf5
+
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((24, 5)).astype(np.float32)
+        Y = rng.standard_normal(24).astype(np.float32)
+        p = str(tmp_path / "d.h5")
+        write_hdf5(p, X, Y)
+        plan = {"seed": 0, "faults": [
+            {"site": "io.chunked.read", "error": "IOError_",
+             "on_hit": 2}]}
+        retry = RetryPolicy(max_attempts=3, base_delay=0.0,
+                            max_delay=0.0, sleep=lambda s: None)
+        with faults.fault_plan(plan):
+            xs, ys = zip(*chunked.iter_hdf5_batches(p, 8, retry=retry))
+        np.testing.assert_array_equal(np.concatenate(xs), X)
+        np.testing.assert_array_equal(np.concatenate(ys), Y)
+
+    def test_libsvm_batch_site_surfaces(self, tmp_path):
+        from libskylark_tpu.io import chunked
+
+        lines = [f"1 1:{i}.0 2:2.0\n" for i in range(10)]
+        plan = {"seed": 0, "faults": [
+            {"site": "io.chunked.batch", "error": "IOError_",
+             "on_hit": 2}]}
+        with faults.fault_plan(plan):
+            it = chunked.iter_libsvm_batches(iter(lines), 4, d=2)
+            next(it)
+            with pytest.raises(errors.IOError_):
+                next(it)
+
+
+# ---------------------------------------------------------------------------
+# multihost satellite
+# ---------------------------------------------------------------------------
+
+
+class TestMultihostInit:
+    def test_worker_probe_unreachable_coordinator_real_path(self):
+        """The REAL worker path, no mocks: a dead coordinator port
+        raises a catchable CommunicationError within the budget —
+        jax.distributed itself is never entered (its C++ client LOG-
+        FATALs the process on this failure, uncatchable)."""
+        from libskylark_tpu.parallel import multihost
+
+        t0 = __import__("time").monotonic()
+        with pytest.raises(errors.CommunicationError) as ei:
+            multihost.initialize_distributed(
+                "127.0.0.1:1", 2, 1, connect_timeout=1.0)
+        assert __import__("time").monotonic() - t0 < 30.0
+        assert "unreachable" in str(ei.value)
+        assert any("127.0.0.1:1" in t for t in ei.value.trace)
+
+    def test_malformed_coordinator_address(self):
+        from libskylark_tpu.parallel import multihost
+
+        with pytest.raises(errors.CommunicationError, match="malformed"):
+            multihost.initialize_distributed(
+                "no-port-here", 2, 1, connect_timeout=1.0)
+
+    def test_unreachable_coordinator_raises_communication_error(
+            self, monkeypatch):
+        import jax
+
+        from libskylark_tpu.parallel import multihost
+
+        def fake_init(coordinator_address=None, num_processes=None,
+                      process_id=None, initialization_timeout=None):
+            assert initialization_timeout == 3
+            raise RuntimeError("Barrier timed out: coordinator "
+                               "unreachable")
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        with pytest.raises(errors.CommunicationError) as ei:
+            multihost.initialize_distributed(
+                "10.0.0.1:8476", 2, 0, connect_timeout=3.0)
+        assert any("10.0.0.1:8476" in t for t in ei.value.trace)
+
+    def test_already_initialized_is_idempotent(self, monkeypatch):
+        import jax
+
+        from libskylark_tpu.parallel import multihost
+
+        def fake_init(*a, **kw):
+            raise RuntimeError("jax.distributed.initialize should only "
+                               "be called once")
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        multihost.initialize_distributed()     # no raise
+
+    def test_timeout_kwarg_dropped_on_old_jax(self, monkeypatch):
+        import jax
+
+        from libskylark_tpu.parallel import multihost
+
+        def old_init(coordinator_address=None, num_processes=None,
+                     process_id=None):
+            raise RuntimeError("refused")
+
+        monkeypatch.setattr(jax.distributed, "initialize", old_init)
+        with pytest.raises(errors.CommunicationError):
+            multihost.initialize_distributed(
+                "x:1", 2, 0, connect_timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# preemption
+# ---------------------------------------------------------------------------
+
+
+def _have_orbax():
+    try:
+        import orbax.checkpoint  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+class TestPreemption:
+    @pytest.fixture(autouse=True)
+    def _clean_handler(self):
+        yield
+        resilience.uninstall_preemption_handler()
+        resilience.reset_preemption()
+
+    def test_sigterm_drains_executors_and_sets_flag(self, fresh_engine):
+        T, ops, refs = _sketch_reqs(4, seed=8)
+        ex = engine.MicrobatchExecutor(max_batch=8, linger_us=10_000_000)
+        futs = [ex.submit_sketch(T, A) for A in ops]
+        resilience.install_preemption_handler(drain_timeout=60.0)
+        assert not resilience.preemption_requested()
+        os.kill(os.getpid(), signal.SIGTERM)
+        # CPython delivers at the next bytecode boundary in this
+        # thread; the teardown itself runs on a dedicated thread (the
+        # interrupted frame may hold locks the drain needs) — join it
+        assert resilience.preemption_requested()
+        assert resilience.wait_for_preemption_teardown(timeout=60.0)
+        assert ex.state == engine.STOPPED
+        for f, r in zip(futs, refs):
+            assert np.array_equal(np.asarray(f.result(timeout=1)), r)
+
+    def test_sigterm_while_main_thread_holds_executor_lock(
+            self, fresh_engine):
+        """Regression: the handler must never run the drain on the
+        interrupted thread — a SIGTERM landing while the main thread is
+        inside the serve layer (holding the non-reentrant executor
+        lock) would deadlock until SIGKILL. The teardown thread simply
+        waits for the lock to free."""
+        T, ops, refs = _sketch_reqs(3, seed=10)
+        ex = engine.MicrobatchExecutor(max_batch=8, linger_us=10_000_000)
+        futs = [ex.submit_sketch(T, A) for A in ops]
+        resilience.install_preemption_handler(drain_timeout=60.0)
+        with ex._lock:                  # the frame the signal interrupts
+            os.kill(os.getpid(), signal.SIGTERM)
+            # handler already returned (we are still executing) and the
+            # teardown is parked on the lock we hold — no deadlock
+            assert resilience.preemption_requested()
+            assert not resilience.wait_for_preemption_teardown(
+                timeout=0.2)
+        assert resilience.wait_for_preemption_teardown(timeout=60.0)
+        assert ex.state == engine.STOPPED
+        for f, r in zip(futs, refs):
+            assert np.array_equal(np.asarray(f.result(timeout=1)), r)
+
+    def test_hooks_run_and_failures_are_contained(self):
+        ran = []
+        resilience.install_preemption_handler(
+            drain_serving_executors=False)
+        resilience.on_preemption(lambda: ran.append("a"))
+        undo = resilience.on_preemption(
+            lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+        resilience.on_preemption(lambda: ran.append("b"))
+        with pytest.warns(RuntimeWarning, match="hook"):
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert resilience.wait_for_preemption_teardown(timeout=60.0)
+        assert ran == ["a", "b"]       # broken hook contained
+        undo()
+
+    @pytest.mark.skipif(not _have_orbax(), reason="needs orbax")
+    def test_register_checkpoint_final_synchronous_save(self, tmp_path):
+        from libskylark_tpu.utility.checkpoint import TrainCheckpointer
+
+        state = {"w": np.arange(6, dtype=np.float32)}
+        with TrainCheckpointer(str(tmp_path), async_save=False) as ckpt:
+            resilience.install_preemption_handler(
+                drain_serving_executors=False)
+            resilience.register_checkpoint(
+                ckpt, lambda: (7, state, {"run": "demo"}))
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert resilience.wait_for_preemption_teardown(timeout=60.0)
+            step, got, meta = ckpt.restore()
+        assert step == 7
+        np.testing.assert_array_equal(np.asarray(got["w"]), state["w"])
+        assert meta["preempted"] is True and meta["run"] == "demo"
+
+    @pytest.mark.skipif(not _have_orbax(), reason="needs orbax")
+    def test_save_sync_retries_injected_fault(self, tmp_path):
+        from libskylark_tpu.utility.checkpoint import TrainCheckpointer
+
+        plan = {"seed": 0, "faults": [
+            {"site": "checkpoint.save", "error": "IOError_",
+             "times": 1}]}
+        retry = RetryPolicy(max_attempts=3, base_delay=0.0,
+                            max_delay=0.0, sleep=lambda s: None)
+        with TrainCheckpointer(str(tmp_path), async_save=False) as ckpt:
+            with faults.fault_plan(plan):
+                ckpt.save_sync(3, {"w": np.ones(2, np.float32)},
+                               retry=retry)
+            step, got, _ = ckpt.restore()
+        assert step == 3
+        np.testing.assert_array_equal(np.asarray(got["w"]),
+                                      np.ones(2, np.float32))
+
+    @pytest.mark.skipif(not _have_orbax(), reason="needs orbax")
+    def test_admm_polls_flag_and_cuts_final_checkpoint(self, tmp_path):
+        """The host-loop wiring: a preempted train() stops at the next
+        iteration boundary with a durable checkpoint; the rerun resumes
+        bit-identical to the uninterrupted run."""
+        from libskylark_tpu.algorithms.prox import (L2Regularizer,
+                                                    SquaredLoss)
+        from libskylark_tpu.ml.admm import BlockADMMSolver
+
+        def solver(maxiter):
+            s = BlockADMMSolver(SquaredLoss(), L2Regularizer(), 0.01,
+                                num_features=8, num_partitions=2)
+            s.maxiter = maxiter
+            s.tol = 0.0
+            return s
+
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((64, 8)).astype(np.float32)
+        Y = np.sin(X[:, 0]).astype(np.float32)
+        ref = solver(6).train(X, Y, regression=True)
+
+        ck = str(tmp_path / "ck")
+        resilience.install_preemption_handler(
+            drain_serving_executors=False)
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert resilience.preemption_requested()
+        resilience.wait_for_preemption_teardown(timeout=60.0)
+        solver(6).train(X, Y, regression=True, checkpoint=ck,
+                        checkpoint_every=0)   # stops at iteration 1
+        resilience.reset_preemption()
+        resumed = solver(6).train(X, Y, regression=True, checkpoint=ck,
+                                  checkpoint_every=0)
+        np.testing.assert_array_equal(np.asarray(resumed.coef),
+                                      np.asarray(ref.coef))

@@ -35,8 +35,10 @@ import libskylark_tpu.parallel as par
 from libskylark_tpu import Context, engine, ml
 from libskylark_tpu import sketch as sk
 from libskylark_tpu.algorithms import regression as reg
+from libskylark_tpu.base import errors as sk_errors
 from libskylark_tpu.engine import bucket as bucketing
 from libskylark_tpu.engine import serve as serve_mod
+from libskylark_tpu.resilience import faults
 
 
 @pytest.fixture()
@@ -372,6 +374,73 @@ class TestBackpressureAndLifecycle:
             good = np.zeros((32, 3), np.float32)
             out2 = np.asarray(ex.submit_sketch(T, good).result(timeout=60))
             assert np.isfinite(out2).all()
+
+
+class TestDeadlineVsFlushFailure:
+    """Satellite: submit-timeout vs flush-failure interleavings. A
+    request whose deadline expires while queued must resolve to
+    ServeOverloadedError — never the flush's injected error, and never
+    by riding a poison-isolation retry (the broader chaos battery lives
+    in tests/test_resilience.py)."""
+
+    def test_expired_while_queued_gets_overloaded_not_retry(
+            self, fresh_engine):
+        ctx = Context(seed=21)
+        rng = np.random.default_rng(21)
+        T = sk.CWT(40, 16, ctx)
+        ops = [rng.standard_normal((40, 3)).astype(np.float32)
+               for _ in range(8)]
+        refs = [np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+                for A in ops]
+        plan = {"seed": 0, "faults": [
+            {"site": "serve.flush", "error": "SketchError",
+             "tag": "poison"}]}
+        ex = _executor(max_batch=8, linger_us=10_000_000)
+        try:
+            with faults.fault_plan(plan):
+                futs = {}
+                for i, A in enumerate(ops):
+                    if i == 2:
+                        # expires in the queue: the flush (poisoned, so
+                        # it retries bisection-style) happens after
+                        with faults.tag("expired-leg"):
+                            futs[i] = ex.submit_sketch(T, A, deadline=0.0)
+                    elif i == 5:
+                        with faults.tag("poison"):
+                            futs[i] = ex.submit_sketch(T, A)
+                    else:
+                        futs[i] = ex.submit_sketch(T, A)
+                ex.flush()
+            # the expired request: ServeOverloadedError, NOT the
+            # injected SketchError a retry pass would have fanned to it
+            exc = futs[2].exception(timeout=60)
+            assert isinstance(exc, engine.ServeOverloadedError)
+            assert "deadline expired" in str(exc)
+            # the poison request alone got the injected class
+            assert isinstance(futs[5].exception(timeout=60),
+                              sk_errors.SketchError)
+            # every other cohort-mate re-coalesced and matches the
+            # sequential oracle bitwise
+            for i in (0, 1, 3, 4, 6, 7):
+                assert np.array_equal(
+                    np.asarray(futs[i].result(timeout=60)), refs[i]), i
+            st = ex.stats()
+            assert st["expired"] == 1
+            assert st["poisoned"] == 1
+            assert st["completed"] == 6
+        finally:
+            ex.shutdown()
+
+    def test_deadline_satisfied_in_time_resolves_normally(
+            self, fresh_engine):
+        ctx = Context(seed=22)
+        T = sk.CWT(32, 8, ctx)
+        A = np.ones((32, 2), np.float32)
+        with _executor(linger_us=500) as ex:
+            out = ex.submit_sketch(T, A, deadline=60.0).result(timeout=60)
+            ref = np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+            assert np.array_equal(np.asarray(out), ref)
+            assert ex.stats()["expired"] == 0
 
 
 class TestConcurrentSubmission:
