@@ -491,6 +491,7 @@ def _solver(m: int = 1024, n: int = 512, rank: int = 8) -> None:
             "warm_s": round(krr_warm, 4),
         },
         "engine": dict(st.to_dict(), cache_entries=len(engine.cache())),
+        "telemetry": _telemetry_snapshot(),
     }
     print(json.dumps(rec), flush=True)
 
@@ -763,6 +764,7 @@ def _serve(n_requests: int = 64, max_batch: int = 16,
         "endpoints": {"solve_l2_sketched": solve_ab,
                       "krr_predict": krr_ab},
         "degraded_mode": degraded_mode,
+        "telemetry": _telemetry_snapshot(),
     }
     print(json.dumps(rec), flush=True)
 
@@ -864,6 +866,21 @@ def _verify_committed(here: str, path: str, raw: str, rec: dict,
     return out
 
 
+def _telemetry_snapshot():
+    """The unified registry snapshot every benchmarks record embeds, so
+    BENCH_*.json trajectories carry the cache/serve/resilience/tune/io
+    counters alongside the timings (docs/observability). Collectors
+    report with telemetry disabled too — they re-home counters the
+    subsystems maintain anyway — so this costs nothing extra in the
+    default (telemetry-off) bench run. Never raises."""
+    try:
+        from libskylark_tpu import telemetry
+
+        return telemetry.snapshot()
+    except Exception:  # noqa: BLE001 — a record beats a perfect record
+        return None
+
+
 def _emit(value, extra):
     prev = _previous_value()
     if value is None:
@@ -879,6 +896,7 @@ def _emit(value, extra):
         "vs_baseline": vs,
     }
     rec.update(extra)
+    rec["telemetry"] = _telemetry_snapshot()
     print(json.dumps(rec), flush=True)
 
 

@@ -38,8 +38,9 @@ from libskylark_tpu.base.context import Context
 from libskylark_tpu.base import errors
 from libskylark_tpu.base.sparse import SparseMatrix
 from libskylark_tpu.base.dist_sparse import DistSparseMatrix, distribute_sparse
+from libskylark_tpu import telemetry
 
 __all__ = [
-    "Context", "errors", "__version__",
+    "Context", "errors", "telemetry", "__version__",
     "SparseMatrix", "DistSparseMatrix", "distribute_sparse",
 ]

@@ -46,6 +46,7 @@ from typing import Callable, Optional, Sequence
 
 import jax
 
+from libskylark_tpu import telemetry as _telemetry
 from libskylark_tpu.engine.cache import CacheEntry, EngineStats, ExecutableCache
 from libskylark_tpu.resilience import faults as _faults
 
@@ -63,6 +64,34 @@ def _cache_size() -> int:
 
 
 _CACHE = ExecutableCache(maxsize=_cache_size())
+
+# telemetry re-homing (docs/observability): the cache's own counters are
+# the authoritative compile/hit/miss source — the collector snapshots
+# them instead of double-counting on the hot path. Only the cold compile
+# (already seconds-scale) opens a span + histogram observation.
+_COMPILE_HIST = _telemetry.histogram(
+    "engine.compile_seconds",
+    "Wall time of cold XLA compiles through the executable cache")
+
+
+def _lifetime_rollup() -> EngineStats:
+    """The reset-proof rollup (current window included) — ONE
+    implementation for both the telemetry snapshot and the
+    ``dump_stats`` artifact the CI jit-leak gate reads, so the two
+    views can never desynchronize."""
+    lifetime = EngineStats()
+    lifetime.merge(_CACHE.lifetime)
+    lifetime.merge(_CACHE.stats)
+    return lifetime
+
+
+def _telemetry_engine_block() -> dict:
+    return {"stats": _CACHE.stats.to_dict(),
+            "lifetime": _lifetime_rollup().to_dict(),
+            "cache_entries": len(_CACHE)}
+
+
+_telemetry.register_collector("engine", _telemetry_engine_block)
 
 
 def cache() -> ExecutableCache:
@@ -321,17 +350,23 @@ class CompiledFn:
                 # chaos seam: a compile-path fault takes the same abort
                 # route as a real XLA failure, so injection exercises
                 # the single-flight waiter-release contract too
-                _faults.check("engine.compile", detail=self.name)
-                jitted = jax.jit(
-                    self._fn,
-                    static_argnames=self._static_argnames or None,
-                    donate_argnums=donate_argnums or None,
-                )
-                executable = jitted.lower(*args, **kwargs).compile()
+                with _telemetry.span("engine.compile",
+                                     attrs={"name": self.name}):
+                    _faults.check("engine.compile", detail=self.name)
+                    jitted = jax.jit(
+                        self._fn,
+                        static_argnames=self._static_argnames or None,
+                        donate_argnums=donate_argnums or None,
+                    )
+                    executable = jitted.lower(*args, **kwargs).compile()
             except BaseException:
                 _CACHE.abort(key)
                 raise
             dt = time.perf_counter() - t0
+            # always recorded: compiles are seconds-scale (the
+            # histogram bump is noise) and the bench snapshot embeds
+            # compile-time data even with telemetry off
+            _COMPILE_HIST.observe_always(dt, name=self.name)
             with self._stats_lock:
                 self.stats.compile_seconds += dt
             entry = CacheEntry(executable=executable, name=self.name,
@@ -374,20 +409,25 @@ def compiled(fn: Optional[Callable] = None, *,
 
 
 def dump_stats(path: str) -> None:
-    """Write global counters + per-entry snapshot as JSON (atomic).
-    ``lifetime`` is the reset-proof rollup (current window included) —
-    what the CI jit-leak gate reads."""
-    lifetime = EngineStats()
-    lifetime.merge(_CACHE.lifetime)
-    lifetime.merge(_CACHE.stats)
+    """Write global counters + per-entry snapshot as JSON, atomically
+    (temp file + ``os.replace`` — the CI jit-leak gate reads this at
+    process exit and must never see a torn artifact). ``lifetime`` is
+    the reset-proof rollup (current window included) — what the gate
+    keys off; ``telemetry`` is the unified registry snapshot
+    (docs/observability) so the artifact carries the serve/resilience/
+    tune/io counters alongside the engine's own."""
     doc = {"stats": _CACHE.stats.to_dict(),
-           "lifetime": lifetime.to_dict(),
+           "lifetime": _lifetime_rollup().to_dict(),
            "entries": _CACHE.snapshot(),
            "cache_size": len(_CACHE)}
     try:
         from libskylark_tpu.engine.serve import serve_stats
 
         doc["serve"] = serve_stats()
+    except Exception:
+        pass
+    try:
+        doc["telemetry"] = _telemetry.snapshot()
     except Exception:
         pass
     tmp = f"{path}.tmp.{os.getpid()}"

@@ -68,11 +68,13 @@ from typing import Optional
 
 import numpy as np
 
+from libskylark_tpu import telemetry as _telemetry
 from libskylark_tpu.engine import bucket as bucketing
 from libskylark_tpu.engine.compiled import compiled as engine_compile
 from libskylark_tpu.engine.compiled import digest as engine_digest
 from libskylark_tpu.resilience import faults
 from libskylark_tpu.resilience.policy import Deadline
+from libskylark_tpu.telemetry import trace as _trace
 
 ENDPOINTS = ("sketch_apply", "solve_l2_sketched", "krr_predict")
 
@@ -99,6 +101,8 @@ class _Request:
     t_submit: float = dataclasses.field(default_factory=time.monotonic)
     deadline: Optional[Deadline] = None   # expires-while-queued bound
     tags: frozenset = frozenset()         # fault-injection tags (chaos)
+    request_id: Optional[str] = None      # telemetry request identity
+    tctx: Optional[object] = None         # telemetry SpanContext handoff
 
 
 @dataclasses.dataclass
@@ -219,23 +223,38 @@ class MicrobatchExecutor:
         or a :class:`~libskylark_tpu.resilience.Deadline`) bounds the
         request's whole queued life: one that expires before its flush
         executes resolves to :class:`ServeOverloadedError` instead of
-        occupying a batch lane (or an isolation retry)."""
+        occupying a batch lane (or an isolation retry). ``request_id``
+        names the request in the telemetry trace (docs/observability;
+        minted automatically when telemetry is on) — it survives the
+        cross-thread hop into the flush worker and appears on the flush
+        span and every bisection-isolation child span."""
         timeout = kwargs.pop("timeout", 30.0)
         deadline = Deadline.coerce(kwargs.pop("deadline", None))
-        if endpoint == "sketch_apply":
-            key, statics, ctx, req = self._prep_sketch(**kwargs)
-        elif endpoint == "solve_l2_sketched":
-            key, statics, ctx, req = self._prep_solve(**kwargs)
-        elif endpoint == "krr_predict":
-            key, statics, ctx, req = self._prep_krr(**kwargs)
-        else:
-            raise ValueError(f"unknown serve endpoint {endpoint!r}; "
-                             f"expected one of {ENDPOINTS}")
-        req.deadline = deadline
-        # capture the submitting thread's fault tags so chaos plans can
-        # pin a fault to THIS request wherever its cohort executes
-        req.tags = faults.current_tags()
-        self._enqueue(key, statics, ctx, req, timeout)
+        rid = kwargs.pop("request_id", None)
+        if rid is None and _telemetry.enabled():
+            rid = _trace.new_request_id()
+        # the submit span covers pack + enqueue; its context (trace id,
+        # span id, request id) rides the request into the flush thread
+        with _trace.span("serve.submit", attrs={"endpoint": endpoint},
+                         request_id=rid) as sp:
+            if endpoint == "sketch_apply":
+                key, statics, ctx, req = self._prep_sketch(**kwargs)
+            elif endpoint == "solve_l2_sketched":
+                key, statics, ctx, req = self._prep_solve(**kwargs)
+            elif endpoint == "krr_predict":
+                key, statics, ctx, req = self._prep_krr(**kwargs)
+            else:
+                raise ValueError(f"unknown serve endpoint {endpoint!r}; "
+                                 f"expected one of {ENDPOINTS}")
+            req.deadline = deadline
+            req.request_id = rid
+            if sp is not None:
+                req.tctx = sp.context()
+            # capture the submitting thread's fault tags so chaos plans
+            # can pin a fault to THIS request wherever its cohort
+            # executes
+            req.tags = faults.current_tags()
+            self._enqueue(key, statics, ctx, req, timeout)
         return req.future
 
     def submit_sketch(self, transform, A, dimension=None, **kw) -> Future:
@@ -586,40 +605,62 @@ class MicrobatchExecutor:
         cohort = self._drop_expired(cohort)
         if not cohort:
             return
-        try:
-            self._execute(b, cohort)
-        except (KeyboardInterrupt, SystemExit):
-            raise       # cancellation stops the process — it must not
-            #             be "isolated" into some request's future
-        except BaseException as e:  # noqa: BLE001 — taxonomy-agnostic
-            with self._stats_lock:
-                self._counts["flush_failures"] += 1
+        # Telemetry (docs/observability): the root attempt is the
+        # "serve.flush" span, parented — across the thread hop — under
+        # the first request's submit span, so the request id minted at
+        # submit() is on this span; bisection halves recurse INSIDE the
+        # span's extent, so every "serve.isolation" retry nests under
+        # it (and inherits the request id) with its own half's ids in
+        # ``request_ids``. Disabled telemetry: one no-op branch.
+        span_cm = _trace.span(
+            "serve.flush" if depth == 0 else "serve.isolation",
+            parent=cohort[0].tctx if depth == 0 else None)
+        with span_cm as sp:
+            if sp is not None:
+                sp.set_attr("endpoint", b.statics[0])
+                sp.set_attr("cohort", len(cohort))
+                sp.set_attr("depth", depth)
+                sp.set_attr("request_ids",
+                            [r.request_id for r in cohort
+                             if r.request_id is not None])
+            try:
+                self._execute(b, cohort)
+            except (KeyboardInterrupt, SystemExit):
+                raise   # cancellation stops the process — it must not
+                #         be "isolated" into some request's future
+            except BaseException as e:  # noqa: BLE001 — taxonomy-agnostic
+                if sp is not None:
+                    sp.status = "error"
+                    sp.error = repr(e)
+                with self._stats_lock:
+                    self._counts["flush_failures"] += 1
+                    if depth == 0:
+                        # health evidence is per INCIDENT (root attempts
+                        # only): a bisection records log2(B)+1 correlated
+                        # failures, which would let ONE poison request in
+                        # a quiet executor flip the state to DEGRADED and
+                        # shed healthy traffic — contradicting "fails
+                        # alone"
+                        self._health.append(1.0)
+                if len(cohort) == 1:
+                    r = cohort[0]
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                    with self._stats_lock:
+                        self._counts["failed"] += 1
+                        self._counts["poisoned"] += 1
+                    return
+                mid = len(cohort) // 2
+                with self._stats_lock:
+                    self._counts["isolation_retries"] += 2
+                    self._counts["isolation_depth_peak"] = max(
+                        self._counts["isolation_depth_peak"], depth + 1)
+                self._run_cohort(b, cohort[:mid], depth + 1)
+                self._run_cohort(b, cohort[mid:], depth + 1)
+            else:
                 if depth == 0:
-                    # health evidence is per INCIDENT (root attempts
-                    # only): a bisection records log2(B)+1 correlated
-                    # failures, which would let ONE poison request in a
-                    # quiet executor flip the state to DEGRADED and shed
-                    # healthy traffic — contradicting "fails alone"
-                    self._health.append(1.0)
-            if len(cohort) == 1:
-                r = cohort[0]
-                if not r.future.done():
-                    r.future.set_exception(e)
-                with self._stats_lock:
-                    self._counts["failed"] += 1
-                    self._counts["poisoned"] += 1
-                return
-            mid = len(cohort) // 2
-            with self._stats_lock:
-                self._counts["isolation_retries"] += 2
-                self._counts["isolation_depth_peak"] = max(
-                    self._counts["isolation_depth_peak"], depth + 1)
-            self._run_cohort(b, cohort[:mid], depth + 1)
-            self._run_cohort(b, cohort[mid:], depth + 1)
-        else:
-            if depth == 0:
-                with self._stats_lock:
-                    self._health.append(0.0)
+                    with self._stats_lock:
+                        self._health.append(0.0)
 
     def _is_degraded(self) -> bool:
         with self._stats_lock:
@@ -998,3 +1039,10 @@ def serve_stats() -> dict:
                         "p99": _percentile(lat_all, 0.99),
                         "n": len(lat_all)}
     return agg
+
+
+# telemetry re-homing (docs/observability): the executor's counters are
+# authoritative — the collector snapshots the cross-executor aggregate
+# (including the live ``queued`` queue-depth gauge) instead of double-
+# counting on the submit/flush hot paths.
+_telemetry.register_collector("serve", serve_stats)

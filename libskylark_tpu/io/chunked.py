@@ -36,8 +36,17 @@ import numpy as np
 from libskylark_tpu.base import errors
 from libskylark_tpu.resilience import faults
 from libskylark_tpu.resilience.policy import RetryPolicy
+from libskylark_tpu.telemetry import metrics as _metrics
+from libskylark_tpu.telemetry import trace as _trace
 
 ROWS = "rows"
+
+# telemetry (docs/observability): batch yields are counted when the
+# switch is on (the hot streaming loop stays one branch when off);
+# HDF5 slice reads open ``io.chunked.read`` spans so retries and NFS
+# blips are attributable on the trace timeline.
+_BATCHES = _metrics.counter(
+    "io.chunked.batches", "Batches yielded by the chunked readers")
 
 
 def _io_retry() -> RetryPolicy:
@@ -259,6 +268,9 @@ def iter_libsvm_batches(
                 raise errors.IOError_(
                     f"feature index {cols.max() + 1} exceeds declared d={d}"
                 )
+            # counted at the yield, not at intake: a parse/validation
+            # failure must not count a batch the consumer never got
+            _BATCHES.inc(source="libsvm")
             yield SparseMatrix.from_coo(rows, cols, vals, (n, d)), Yout
         else:
             X = np.zeros((n, d), dtype=dtype)
@@ -269,6 +281,7 @@ def iter_libsvm_batches(
                         f"d={d}"
                     )
                 X[i, ix] = v
+            _BATCHES.inc(source="libsvm")
             yield X, Yout
 
 
@@ -289,17 +302,29 @@ def iter_hdf5_batches(
     h5py = _require_h5py()
     retry = retry or _io_retry()
 
-    def read_slice(ds, lo, hi, name):
+    def read_once(ds, lo, hi, name):
         faults.check("io.chunked.read", detail=f"{name}[{lo}:{hi}]")
         return np.asarray(ds[lo:hi], dtype=dtype)
+
+    def read_slice(ds, lo, hi, name):
+        # span around the whole retry ladder, so per-attempt retry
+        # events (resilience.policy) attach to THIS span
+        with _trace.span("io.chunked.read",
+                         attrs={"dataset": name, "lo": lo, "hi": hi}):
+            return retry.call(read_once, ds, lo, hi, name)
 
     with h5py.File(path, "r") as f:
         X, Y = f["X"], f["Y"]  # the reference's dense layout (io/hdf5.py)
         n = X.shape[0]
         for lo in range(0, n, batch_rows):
             hi = min(lo + batch_rows, n)
-            yield (retry.call(read_slice, X, lo, hi, "X"),
-                   retry.call(read_slice, Y, lo, hi, "Y"))
+            batch = (read_slice(X, lo, hi, "X"),
+                     read_slice(Y, lo, hi, "Y"))
+            # counted after both slice reads survived their retry
+            # ladders: "batches yielded" must match what the consumer
+            # actually received
+            _BATCHES.inc(source="hdf5")
+            yield batch
 
 
 def read_libsvm_sharded(

@@ -52,6 +52,16 @@ from typing import Iterator, Optional
 from libskylark_tpu.base import errors
 from libskylark_tpu.resilience import faults
 from libskylark_tpu.resilience.policy import DeadlineExceededError, RetryPolicy
+from libskylark_tpu.telemetry import metrics as _metrics
+from libskylark_tpu.telemetry import trace as _trace
+
+# Unified-registry adapter (docs/observability): reconnects were
+# previously visible only in the final error's trace — the counter
+# makes every survived blip a first-class number. Always counted: a
+# reconnect already paid for a dropped connection + reopen.
+_RECONNECTS = _metrics.counter(
+    "io.webhdfs.reconnects",
+    "Mid-stream WebHDFS connection drops that reconnected and resumed")
 
 
 def _is_transient(e: BaseException) -> bool:
@@ -111,10 +121,13 @@ def _open_url(namenode: str, path: str, user: Optional[str],
     try:
         # per-attempt timeout = the caller's urlopen timeout; the policy
         # threads it through so a hung connect consumes one attempt, not
-        # the whole budget
-        return dataclasses.replace(
-            retry, timeout_arg="timeout", attempt_timeout=timeout,
-        ).call(attempt)
+        # the whole budget. The span covers the whole retry ladder —
+        # per-attempt retry events attach to it (resilience.policy).
+        with _trace.span("io.webhdfs.open",
+                         attrs={"path": path, "offset": offset}):
+            return dataclasses.replace(
+                retry, timeout_arg="timeout", attempt_timeout=timeout,
+            ).call(attempt)
     except (KeyboardInterrupt, SystemExit):
         raise               # cancellation is not an I/O failure — a
         #                     rewrap would make Ctrl-C retryable upstream
@@ -199,6 +212,7 @@ def webhdfs_lines(
                 err.append_trace(
                     f"connections={reconnects}/{retry.max_attempts}")
                 raise err from e
+            _RECONNECTS.inc_always()
             retry.sleep(next(delays))
             continue      # reopen at offset + consumed, carry intact
         finally:

@@ -42,7 +42,20 @@ from libskylark_tpu.resilience.preemption import (
     preemption_requested as _preemption_requested,
 )
 from libskylark_tpu.sketch import ROWWISE, SketchTransform
+from libskylark_tpu.telemetry import metrics as _telemetry_metrics
 from libskylark_tpu.utility.timer import get_timer, timers_enabled
+
+# Per-iteration training telemetry (docs/observability). Gated on the
+# global switch inside the loop: reading ``objective`` forces a host
+# sync, which only an observability-mode run should pay (the default
+# loop stays async — the phase timers' timing note applies here too).
+_ADMM_ITERS = _telemetry_metrics.counter(
+    "ml.admm.iterations", "BlockADMM training iterations executed")
+_ADMM_OBJECTIVE = _telemetry_metrics.gauge(
+    "ml.admm.objective", "Most recent BlockADMM training objective")
+_ADMM_RELDEL = _telemetry_metrics.gauge(
+    "ml.admm.reldel",
+    "Most recent relative consensus-iterate change (convergence signal)")
 
 # Resume-identity scheme version: bumped whenever the _identity() hash
 # inputs change (scheme 4 = byte-budgeted sample_digest with the
@@ -472,6 +485,10 @@ class BlockADMMSolver:
                         carry, X, Y, cache_mats, Zs)
                     if timers_enabled():
                         jax.block_until_ready(carry)  # device time here
+                if _telemetry_metrics.enabled():
+                    _ADMM_ITERS.inc()
+                    _ADMM_OBJECTIVE.set(float(objective))
+                    _ADMM_RELDEL.set(float(reldel))
                 model.coef = carry[0]
                 if verbose:
                     msg = f"iteration {it} objective {float(objective):.6g}"

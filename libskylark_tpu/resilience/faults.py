@@ -70,9 +70,17 @@ import threading
 from typing import Iterable, Optional, Sequence
 
 from libskylark_tpu.base import errors
+from libskylark_tpu.telemetry import metrics as _metrics
 
 _VALID_KEYS = {"site", "error", "message", "on_hit", "every", "prob",
                "after", "times", "tag"}
+
+# Unified-registry adapter (docs/observability): fired injections are
+# chaos-run events — always counted (a fire raises an exception; the
+# counter bump is noise) so the benchmarks snapshot carries them.
+_FIRED = _metrics.counter(
+    "resilience.faults_fired",
+    "Injected faults that fired, by site and error class")
 
 
 def _resolve_error(name: str) -> type:
@@ -176,6 +184,7 @@ class FaultPlan:
                     continue
                 if spec.decide(tags):
                     self.fired.append((site, spec.hits, spec.error_name))
+                    _FIRED.inc_always(site=site, error=spec.error_name)
                     err = spec.error_cls(
                         spec.message
                         or f"injected fault at {site} (hit {spec.hits})")
@@ -296,6 +305,18 @@ def tag(*names: str):
         yield
     finally:
         _TAGS.tags = prev
+
+
+def _telemetry_block() -> dict:
+    """Snapshot adapter: the active plan's determinism-witness state
+    (the process-lifetime fire counts live in the
+    ``resilience.faults_fired`` counter)."""
+    plan = active_plan()
+    return {"active_plan": plan is not None,
+            "fired_this_plan": len(plan.fired) if plan is not None else 0}
+
+
+_metrics.register_collector("resilience.faults", _telemetry_block)
 
 
 __all__ = [

@@ -39,6 +39,17 @@ import time
 from typing import Callable, Iterator, Optional, Sequence, Union
 
 from libskylark_tpu.base import errors
+from libskylark_tpu.telemetry import metrics as _metrics
+from libskylark_tpu.telemetry import trace as _trace
+
+# Unified-registry adapter (docs/observability): every retry attempt
+# under any policy bumps this counter — always, not gated on the
+# telemetry switch, because a retry already paid for a failure + a
+# backoff sleep and the benchmarks snapshot wants resilience counters
+# even in disabled-mode runs.
+_RETRIES = _metrics.counter(
+    "resilience.retries",
+    "Retry attempts under RetryPolicy, by error class")
 
 
 class DeadlineExceededError(errors.SkylarkError, TimeoutError):
@@ -217,6 +228,20 @@ class RetryPolicy:
                 d = next(delays)
                 if deadline is not None:
                     d = min(d, max(deadline.remaining(), 0.0))
+                _RETRIES.inc_always(error=type(e).__name__)
+                # the retry-attempt event lands on whatever span is
+                # executing (a webhdfs open inside an io span, a save
+                # inside a checkpoint span) and carries that span's id
+                # explicitly, so a JSONL consumer can correlate retries
+                # without re-walking the tree
+                cur = _trace.current_span()
+                if cur is not None:
+                    cur.add_event("resilience.retry", {
+                        "attempt": attempt,
+                        "error": type(e).__name__,
+                        "delay_s": round(d, 4),
+                        "span_id": cur.span_id,
+                    })
                 if on_retry is not None:
                     on_retry(attempt, e, d)
                 if d > 0:

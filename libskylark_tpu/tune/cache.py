@@ -38,9 +38,20 @@ import os
 import threading
 from typing import Optional
 
+from libskylark_tpu.telemetry import metrics as _metrics
 from libskylark_tpu.tune.plans import Plan, Workload
 
 SCHEMA = 1
+
+# Unified-registry adapter (docs/observability): the dispatchers'
+# cache-consultation outcomes, previously untracked. Always counted —
+# a lookup happens once per dispatch (host-side key build dwarfs it)
+# and the benchmarks snapshot carries tune counters even with
+# telemetry off.
+_LOOKUPS = _metrics.counter(
+    "tune.plan_cache_lookups",
+    "Plan-cache consultations by the sketch-apply dispatchers, "
+    "by outcome (hit / miss / malformed)")
 
 
 def _utcnow() -> str:
@@ -217,11 +228,15 @@ class PlanCache:
     def lookup(self, w: Workload) -> Optional[Plan]:
         ent = self.entries.get(w.key())
         if not ent:
+            _LOOKUPS.inc_always(outcome="miss")
             return None
         try:
-            return Plan.from_dict(ent["plan"])
+            plan = Plan.from_dict(ent["plan"])
         except Exception:
+            _LOOKUPS.inc_always(outcome="malformed")
             return None  # malformed entry: heuristic fallback
+        _LOOKUPS.inc_always(outcome="hit")
+        return plan
 
     def entry(self, w: Workload) -> Optional[dict]:
         return self.entries.get(w.key())
@@ -283,3 +298,18 @@ def set_cache(cache: Optional[PlanCache]) -> Optional[PlanCache]:
     with _global_lock:
         prev, _global = _global, cache
         return prev
+
+
+def _telemetry_block() -> dict:
+    """Snapshot adapter: the ALREADY-LOADED global cache's shape (no
+    lazy disk load at snapshot time — a snapshot must not have side
+    effects)."""
+    with _global_lock:
+        c = _global
+    if c is None:
+        return {"loaded": False}
+    return {"loaded": True, "entries": len(c.entries),
+            "load_error": c.load_error}
+
+
+_metrics.register_collector("tune.plan_cache", _telemetry_block)

@@ -15,6 +15,15 @@ Enablement mirrors the reference's compile-time SKYLARK_HAVE_PROFILER gate
 environment variable or :func:`set_enabled`. Disabled timers cost one dict
 lookup and one branch per phase.
 
+Since the telemetry subsystem landed, :class:`PhaseTimer` is a thin
+shim over :func:`libskylark_tpu.telemetry.span` — each phase IS a span
+(``force=True``: phase timers keep this module's own enablement gate,
+independent of the global ``SKYLARK_TELEMETRY`` switch), so phases
+flow to the JSONL exporter and nest under whatever span is active,
+while the ``TraceAnnotation`` mirroring this module always did now
+lives in the span layer. The public API (``phase`` / ``accumulate`` /
+``report`` / ``reset`` / the :func:`get_timer` registry) is unchanged.
+
 Timing note: phases measure *host* wall time. JAX dispatch is async — a
 phase that only enqueues device work appears near-free while the next
 synchronizing phase absorbs its cost. Phases that must attribute device
@@ -26,7 +35,6 @@ serializing the pipeline the rest of the time).
 from __future__ import annotations
 
 import os
-import time
 from contextlib import contextmanager
 from typing import Dict, Optional
 
@@ -60,13 +68,16 @@ class PhaseTimer:
         if not timers_enabled():
             yield
             return
-        import jax.profiler
+        from libskylark_tpu.telemetry import trace
 
-        t0 = time.perf_counter()
-        with jax.profiler.TraceAnnotation(label):
+        # force=True: the phase gate is THIS module's enablement, not
+        # the global telemetry switch; the span carries the
+        # TraceAnnotation mirroring (device-timeline alignment) and
+        # flows to any installed exporter
+        with trace.span(label, attrs={"phase_timer": self.name or
+                                      "default"}, force=True) as sp:
             yield
-        dt = time.perf_counter() - t0
-        self.totals[label] = self.totals.get(label, 0.0) + dt
+        self.totals[label] = self.totals.get(label, 0.0) + sp.duration_s
         self.counts[label] = self.counts.get(label, 0) + 1
 
     def accumulate(self, label: str, seconds: float) -> None:

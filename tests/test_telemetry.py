@@ -1,0 +1,481 @@
+"""Telemetry subsystem tests (libskylark_tpu/telemetry/).
+
+Covers the registry (counters/gauges/histograms, labels, the
+near-free-when-disabled contract, collector adapters), the span API
+(contextvar nesting, error status, the ``jax.profiler.TraceAnnotation``
+mirror, explicit cross-thread handoff), the exporters (JSONL schema,
+Prometheus text), and the serve-pipeline integration the issue's
+acceptance criteria name: a request id set at ``submit()`` must appear
+on the flush span and on every bisection-isolation child span —
+across the thread hop into the flush worker, including under an
+injected ``serve.flush`` fault plan.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from libskylark_tpu import Context, engine, telemetry
+from libskylark_tpu import sketch as sk
+from libskylark_tpu.resilience import faults
+from libskylark_tpu.telemetry import export as export_mod
+from libskylark_tpu.telemetry import metrics as mmod
+from libskylark_tpu.telemetry import trace as tmod
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_state():
+    prev = mmod._ENABLED
+    tmod.clear_finished()
+    yield
+    mmod._ENABLED = prev
+    tmod.clear_finished()
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+class TestMetrics:
+    def test_disabled_record_is_noop(self):
+        telemetry.set_enabled(False)
+        c = telemetry.counter("t.disabled_counter")
+        g = telemetry.gauge("t.disabled_gauge")
+        h = telemetry.histogram("t.disabled_hist")
+        c.inc()
+        g.set(5.0)
+        h.observe(0.1)
+        assert c.to_dict()["values"] == []
+        assert g.to_dict()["values"] == []
+        assert h.to_dict()["values"] == []
+
+    def test_counter_labels_and_values(self):
+        telemetry.set_enabled(True)
+        c = telemetry.counter("t.counter", "help")
+        c.inc()
+        c.inc(2, site="a")
+        c.inc(3, site="a")
+        assert c.value() == 1
+        assert c.value(site="a") == 5
+        doc = c.to_dict()
+        assert doc["type"] == "counter" and doc["help"] == "help"
+
+    def test_inc_always_bypasses_gate(self):
+        telemetry.set_enabled(False)
+        c = telemetry.counter("t.always_counter")
+        c.inc_always(outcome="hit")
+        assert c.value(outcome="hit") == 1
+
+    def test_gauge_set_and_add(self):
+        telemetry.set_enabled(True)
+        g = telemetry.gauge("t.gauge")
+        g.set(2.5)
+        g.add(1.0)
+        assert g.value() == 3.5
+
+    def test_histogram_buckets(self):
+        telemetry.set_enabled(True)
+        h = telemetry.histogram("t.hist", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        cell = h.to_dict()["values"][0]
+        assert cell["counts"] == [1, 1, 1]       # <=0.1, <=1.0, +Inf
+        assert cell["count"] == 3
+        assert cell["sum"] == pytest.approx(5.55)
+
+    def test_get_or_create_idempotent_and_typed(self):
+        assert telemetry.counter("t.same") is telemetry.counter("t.same")
+        with pytest.raises(ValueError):
+            telemetry.gauge("t.same")
+
+    def test_registry_reset_keeps_handles(self):
+        telemetry.set_enabled(True)
+        c = telemetry.counter("t.reset_me")
+        c.inc(7)
+        telemetry.registry().reset()
+        assert c.value() == 0
+        c.inc(1)
+        assert c.value() == 1
+
+    def test_snapshot_structure_and_collectors(self):
+        telemetry.register_collector("t.block", lambda: {"x": 1})
+        snap = telemetry.snapshot()
+        assert set(snap) == {"enabled", "metrics", "collectors"}
+        assert snap["collectors"]["t.block"] == {"x": 1}
+        # the wired adapters: engine + serve re-homed under one schema
+        assert "lifetime" in snap["collectors"]["engine"]
+        assert "queued" in snap["collectors"]["serve"]
+        json.dumps(snap)  # JSON-able end to end
+
+    def test_broken_collector_never_fails_snapshot(self):
+        def boom():
+            raise RuntimeError("collector died")
+
+        telemetry.register_collector("t.broken", boom)
+        try:
+            snap = telemetry.snapshot()
+            assert "error" in snap["collectors"]["t.broken"]
+        finally:
+            telemetry.registry().unregister_collector("t.broken")
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_disabled_span_yields_none(self):
+        telemetry.set_enabled(False)
+        with telemetry.span("nope") as sp:
+            assert sp is None
+        assert telemetry.finished_spans() == []
+
+    def test_force_opens_span_while_disabled(self):
+        telemetry.set_enabled(False)
+        with telemetry.span("forced", force=True) as sp:
+            assert sp is not None
+        assert sp.duration_s is not None
+
+    def test_parent_child_nesting_and_restore(self):
+        telemetry.set_enabled(True)
+        with telemetry.span("root") as root:
+            assert telemetry.current_span() is root
+            with telemetry.span("child") as child:
+                assert child.parent_id == root.span_id
+                assert child.trace_id == root.trace_id
+            assert telemetry.current_span() is root
+        assert telemetry.current_span() is None
+        names = [s.name for s in telemetry.finished_spans()]
+        assert names == ["child", "root"]      # children finish first
+
+    def test_error_status(self):
+        telemetry.set_enabled(True)
+        with pytest.raises(ValueError):
+            with telemetry.span("boom"):
+                raise ValueError("x")
+        sp = telemetry.finished_spans()[-1]
+        assert sp.status == "error" and "ValueError" in sp.error
+
+    def test_request_id_inheritance(self):
+        telemetry.set_enabled(True)
+        with telemetry.span("root", request_id="req-7"):
+            with telemetry.span("child") as child:
+                assert child.request_id == "req-7"
+
+    def test_cross_thread_handoff(self):
+        telemetry.set_enabled(True)
+        out = {}
+        with telemetry.span("origin", request_id="req-x") as origin:
+            ctx = telemetry.get_context()
+
+        def work():
+            # a fresh thread has NO ambient context...
+            with telemetry.span("orphan") as o:
+                out["orphan_parent"] = o.parent_id
+            # ...until the handoff context is attached explicitly
+            with telemetry.attach(ctx):
+                with telemetry.span("adopted") as a:
+                    out["parent"] = a.parent_id
+                    out["trace"] = a.trace_id
+                    out["rid"] = a.request_id
+
+        t = threading.Thread(target=work)
+        t.start()
+        t.join()
+        assert out["orphan_parent"] is None
+        assert out["parent"] == origin.span_id
+        assert out["trace"] == origin.trace_id
+        assert out["rid"] == "req-x"
+
+    def test_trace_annotation_mirror(self, monkeypatch):
+        import jax.profiler
+
+        entered = []
+
+        class FakeAnnotation:
+            def __init__(self, name):
+                self.name = name
+
+            def __enter__(self):
+                entered.append(self.name)
+                return self
+
+            def __exit__(self, *exc):
+                return False
+
+        monkeypatch.setattr(jax.profiler, "TraceAnnotation",
+                            FakeAnnotation)
+        telemetry.set_enabled(True)
+        with telemetry.span("mirror.me"):
+            pass
+        assert entered == ["mirror.me"]
+
+    def test_add_event_lands_on_current_span(self):
+        telemetry.set_enabled(True)
+        with telemetry.span("evented") as sp:
+            telemetry.add_event("retry", {"attempt": 1})
+        assert sp.events[0]["name"] == "retry"
+        assert sp.events[0]["attrs"]["attempt"] == 1
+        telemetry.add_event("dropped")  # outside any span: no-op
+
+
+# ---------------------------------------------------------------------------
+# timer shim: PhaseTimer phases ARE spans now
+# ---------------------------------------------------------------------------
+
+
+class TestTimerShim:
+    def test_phase_emits_span_with_own_gate(self):
+        from libskylark_tpu.utility import timer as timer_mod
+
+        prev = timer_mod._ENABLED
+        telemetry.set_enabled(False)   # global switch OFF...
+        try:
+            timer_mod.set_enabled(True)  # ...phase gate ON wins (force)
+            t = timer_mod.PhaseTimer("shim")
+            with t.phase("PHASE_A"):
+                pass
+            assert t.counts["PHASE_A"] == 1
+            sp = telemetry.finished_spans()[-1]
+            assert sp.name == "PHASE_A"
+            assert sp.attrs["phase_timer"] == "shim"
+            assert t.totals["PHASE_A"] == pytest.approx(sp.duration_s)
+        finally:
+            timer_mod._ENABLED = prev
+
+
+# ---------------------------------------------------------------------------
+# serve pipeline propagation (the acceptance-criteria trace)
+# ---------------------------------------------------------------------------
+
+
+def _ragged_reqs(n, seed=0):
+    rng = np.random.default_rng(seed)
+    ctx = Context(seed=seed)
+    return [(sk.JLT(48, 16, ctx),
+             rng.standard_normal((48, 3 + i % 4)).astype(np.float32))
+            for i in range(n)]
+
+
+class TestServePropagation:
+    def test_request_id_survives_into_flush_thread(self):
+        telemetry.set_enabled(True)
+        tmod.clear_finished()
+        (T, A), = _ragged_reqs(1)
+        with engine.MicrobatchExecutor(max_batch=4, linger_us=500) as ex:
+            fut = ex.submit_sketch(T, A, dimension=sk.COLUMNWISE,
+                                   request_id="req-hop")
+            fut.result(timeout=120)   # flusher pops after linger
+        spans = {s.span_id: s for s in telemetry.finished_spans()}
+        submits = [s for s in spans.values() if s.name == "serve.submit"]
+        flushes = [s for s in spans.values() if s.name == "serve.flush"
+                   and "req-hop" in s.attrs.get("request_ids", [])]
+        assert len(submits) == 1 and len(flushes) == 1
+        fl = flushes[0]
+        # the flush ran on the worker thread, not the submitting one,
+        # yet parents under the submit span and carries its request id
+        assert fl.thread != submits[0].thread
+        assert fl.thread.startswith("skylark-serve-worker")
+        assert fl.parent_id == submits[0].span_id
+        assert fl.request_id == "req-hop"
+
+    def test_request_id_on_flush_and_every_isolation_span(self):
+        """The issue's satellite: a request id set at submit() appears
+        on the flush span and on every bisection-isolation child span,
+        under an injected ``serve.flush`` fault plan."""
+        telemetry.set_enabled(True)
+        tmod.clear_finished()
+        reqs = _ragged_reqs(4)
+        rids = [f"req-iso-{i}" for i in range(3)] + ["req-iso-poison"]
+        plan = {"seed": 1, "faults": [
+            {"site": "serve.flush", "error": "SketchError",
+             "tag": "poison"}]}
+        with engine.MicrobatchExecutor(max_batch=4,
+                                       linger_us=50_000) as ex:
+            with faults.fault_plan(plan):
+                futs = [ex.submit_sketch(T, A, dimension=sk.COLUMNWISE,
+                                         request_id=rid)
+                        for (T, A), rid in zip(reqs[:3], rids[:3])]
+                with faults.tag("poison"):
+                    pT, pA = reqs[3]
+                    pf = ex.submit_sketch(pT, pA,
+                                          dimension=sk.COLUMNWISE,
+                                          request_id=rids[3])
+                ex.flush()
+                for f in futs:
+                    f.result(timeout=120)   # cohort-mates succeed
+                with pytest.raises(Exception) as ei:
+                    pf.result(timeout=120)
+                assert type(ei.value).__name__ == "SketchError"
+
+        spans = telemetry.finished_spans()
+        by_id = {s.span_id: s for s in spans}
+        flushes = [s for s in spans if s.name == "serve.flush"
+                   and set(rids) <= set(s.attrs.get("request_ids", []))]
+        assert len(flushes) == 1, "cohort flush span with all ids"
+        fl = flushes[0]
+        assert fl.status == "error"
+        assert by_id[fl.parent_id].name == "serve.submit"
+
+        isolations = [s for s in spans if s.name == "serve.isolation"]
+        # cohort of 4: two halves, then the poison half splits again
+        assert len(isolations) == 4
+        for iso in isolations:
+            iso_rids = iso.attrs.get("request_ids", [])
+            assert iso_rids, "every isolation span carries request ids"
+            assert set(iso_rids) <= set(rids)
+            # rooted under THE flush span
+            anc = iso
+            while anc is not None and anc.name != "serve.flush":
+                anc = by_id.get(anc.parent_id)
+            assert anc is fl
+        poison_leaves = [s for s in isolations
+                         if s.attrs.get("request_ids") == [rids[3]]
+                         and s.status == "error"]
+        assert len(poison_leaves) == 1, "poison pinned at capacity 1"
+
+    def test_no_spans_and_no_ids_when_disabled(self):
+        telemetry.set_enabled(False)
+        tmod.clear_finished()
+        (T, A), = _ragged_reqs(1)
+        with engine.MicrobatchExecutor(max_batch=2, linger_us=500) as ex:
+            fut = ex.submit_sketch(T, A, dimension=sk.COLUMNWISE)
+            fut.result(timeout=120)
+        assert [s for s in telemetry.finished_spans()
+                if s.name.startswith("serve.")] == []
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+class TestJsonlExport:
+    def test_span_and_metric_lines(self, tmp_path):
+        telemetry.set_enabled(True)
+        ex = export_mod.JsonlExporter(str(tmp_path))
+        try:
+            with telemetry.span("outer", request_id="req-j"):
+                with telemetry.span("inner"):
+                    pass
+            ex.flush_sync()
+            span_docs = [json.loads(line)
+                         for line in open(ex.span_path)]
+            names = {d["name"]: d for d in span_docs}
+            assert {"outer", "inner"} <= set(names)
+            assert (names["inner"]["parent_id"]
+                    == names["outer"]["span_id"])
+            assert names["inner"]["request_id"] == "req-j"
+            for d in span_docs:
+                for field in ("kind", "name", "trace_id", "span_id",
+                              "t_wall", "duration_s", "status",
+                              "thread"):
+                    assert field in d
+            metric_docs = [json.loads(line)
+                           for line in open(ex.metrics_path)]
+            assert metric_docs[-1]["kind"] == "metrics"
+            assert "collectors" in metric_docs[-1]["snapshot"]
+        finally:
+            ex.close()
+
+    def test_preemption_hook_runs_final_flush(self, tmp_path):
+        from libskylark_tpu.resilience import preemption
+
+        telemetry.set_enabled(True)
+        ex = export_mod.JsonlExporter(str(tmp_path))
+        try:
+            with preemption._LOCK:
+                hooks = list(preemption._HOOKS)
+            assert ex.flush_sync in hooks
+            with telemetry.span("pre-teardown"):
+                pass
+            ex.flush_sync()
+            assert any(json.loads(line)["name"] == "pre-teardown"
+                       for line in open(ex.span_path))
+        finally:
+            ex.close()
+        with preemption._LOCK:
+            assert ex.flush_sync not in preemption._HOOKS
+
+    def test_install_from_env_is_idempotent(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SKYLARK_TELEMETRY_DIR", str(tmp_path))
+        first = export_mod.install_exporter()
+        try:
+            assert first is not None
+            assert export_mod.install_exporter() is first
+        finally:
+            export_mod.shutdown_exporter()
+        assert export_mod.get_exporter() is None
+
+
+class TestPrometheus:
+    def test_counter_gauge_histogram_rendering(self):
+        telemetry.set_enabled(True)
+        telemetry.counter("t.prom_count").inc(2, site="s")
+        telemetry.gauge("t.prom_gauge").set(1.5)
+        telemetry.histogram("t.prom_hist", buckets=(1.0,)).observe(0.5)
+        text = telemetry.prometheus_text()
+        assert 'skylark_t_prom_count_total{site="s"} 2' in text
+        assert "skylark_t_prom_gauge 1.5" in text
+        assert 'skylark_t_prom_hist_bucket{le="1"} 1' in text
+        assert 'skylark_t_prom_hist_bucket{le="+Inf"} 1' in text
+        assert "skylark_t_prom_hist_count 1" in text
+
+    def test_unified_counters_exposed(self):
+        """The acceptance criterion: prometheus_text() carries the
+        re-homed engine/serve/resilience numbers."""
+        text = telemetry.prometheus_text()
+        assert "skylark_engine_lifetime_misses" in text
+        assert "skylark_serve_submitted" in text
+        assert "skylark_serve_queued" in text
+        assert "skylark_resilience_faults" in text
+
+    def test_label_escaping(self):
+        telemetry.set_enabled(True)
+        telemetry.counter("t.escape").inc(1, v='a"b\nc')
+        text = telemetry.prometheus_text()
+        assert 'v="a\\"b\\nc"' in text
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+
+
+class TestEngineIntegration:
+    def test_dump_stats_embeds_snapshot_atomically(self, tmp_path):
+        path = tmp_path / "stats.json"
+        engine.dump_stats(str(path))
+        doc = json.loads(path.read_text())
+        assert "telemetry" in doc
+        assert "engine" in doc["telemetry"]["collectors"]
+        assert "serve" in doc["telemetry"]["collectors"]
+        # atomicity: no orphan temp file left beside the artifact
+        assert list(tmp_path.iterdir()) == [path]
+
+    def test_cold_compile_emits_span(self):
+        telemetry.set_enabled(True)
+        tmod.clear_finished()
+        import jax.numpy as jnp
+
+        def f(x):
+            return x * 2.0
+
+        cf = engine.compiled(f, name="telemetry.test_compile",
+                             key_fn=lambda *a: ("telemetry-span-test",))
+        cf(jnp.ones((3,), jnp.float32))
+        compiles = [s for s in telemetry.finished_spans()
+                    if s.name == "engine.compile"
+                    and s.attrs.get("name") == "telemetry.test_compile"]
+        assert len(compiles) == 1
+        cf(jnp.ones((3,), jnp.float32))   # warm hit: no second span
+        compiles = [s for s in telemetry.finished_spans()
+                    if s.name == "engine.compile"
+                    and s.attrs.get("name") == "telemetry.test_compile"]
+        assert len(compiles) == 1
