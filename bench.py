@@ -25,7 +25,9 @@ retry deadline.
 
 Other modes: ``--solver`` (engine compile-vs-execute split),
 ``--serve`` (microbatch serving throughput A/B, batched vs sequential
-dispatch), ``--stamp`` (oracle certification line).
+dispatch), ``--fleet`` (N-replica router vs single-executor A/B with a
+one-replica drain-failover leg), ``--stamp`` (oracle certification
+line).
 
 Each timed iteration consumes the FULL sketch output (the loop carries
 sum(abs(SA)) back into the next input), so XLA cannot dead-code-eliminate
@@ -770,6 +772,262 @@ def _serve(n_requests: int = 64, max_batch: int = 16,
 
 
 # ---------------------------------------------------------------------------
+# fleet-level measurement: N-replica router vs single executor
+# ---------------------------------------------------------------------------
+
+
+def _fleet(n_requests: int = 64, n_replicas: int = 4,
+           max_batch: int = 16, rounds: int = 5) -> None:
+    """Replicated-fleet throughput A/B (``python bench.py --fleet``;
+    backend-agnostic — run with JAX_PLATFORMS=cpu for the
+    hardware-free record).
+
+    Workload: ``n_requests`` in-flight ragged sketch-apply requests
+    over four distinct pow2 bucket classes — a heterogeneous mix
+    spanning the ``--serve`` record's exact class ((48..60)x(112..128),
+    s=32) plus the lighter classes of the microbatching sweet spot
+    (``engine/bucket.py``'s design point: floods of small ragged
+    requests). *Fleet* routes them over ``n_replicas`` in-process
+    replicas through the warm-cache-aware ``fleet.Router`` — bounded-
+    load sticky affinity gives each replica one class, so the classes
+    flush concurrently on four executors while the fleet's total
+    compile count stays equal to a single executor's. The same storm
+    is also measured on ONE ``MicrobatchExecutor`` (at the r8
+    ``--serve`` config, workers=2, and at thread parity with the
+    fleet) — the in-run A/B — and the committed ``--serve`` record's
+    single-executor throughput is read for the cross-record
+    comparison. All sides are fully warmed; the record carries the
+    engine miss/recompile deltas over the measured window (zero
+    proves the warm replicas never compiled) and the router's
+    affinity hit-rate.
+
+    Host caveat the record states explicitly: on a host with fewer
+    cores than one executor's workers can saturate (the 2-core CI
+    box), in-process replication cannot raise aggregate throughput —
+    every replica shares one GIL and one core budget, so the fleet's
+    in-run numbers trail the single executor by the coordination tax
+    while buying per-replica drain/failover; the throughput upside
+    needs per-replica cores (or process-backed replicas).
+
+    The drain leg then preempts one replica MID-STORM (the per-replica
+    SIGTERM story: drain + router failover) and records the
+    client-visible failure count — the acceptance criterion is zero —
+    plus the surviving fleet's throughput. Prints one JSON line."""
+    import threading as _threading
+
+    import jax
+    import numpy as np
+
+    from libskylark_tpu import Context, engine, fleet
+    from libskylark_tpu import sketch as sk
+
+    rng = np.random.default_rng(0)
+    ctx = Context(seed=0)
+
+    # four distinct bucket classes (statics differ by padded shape
+    # and/or sketch dim): the --serve record's class plus three
+    # lighter sweet-spot classes; ragged rows inside one row class
+    # (48..60 -> 64)
+    classes = (
+        {"n_lo": 20, "s": 16},     # pad 32, s 16
+        {"n_lo": 52, "s": 16},     # pad 64, s 16
+        {"n_lo": 112, "s": 32},    # pad 128, s 32 — the --serve class
+        {"n_lo": 52, "s": 32},     # pad 64, s 32
+    )
+    reqs = []
+    for i in range(n_requests):
+        c = classes[i % len(classes)]
+        n = c["n_lo"] + (i % 3) * 4
+        m = 48 + (i % 4) * 4
+        T = sk.JLT(n, c["s"], ctx)
+        A = rng.standard_normal((m, n)).astype(np.float32)
+        reqs.append((T, A))
+
+    engine.reset()
+
+    def storm(submit):
+        futs = [submit(T, A) for (T, A) in reqs]
+        outs = [f.result(timeout=120) for f in futs]
+        jax.block_until_ready(outs)
+        return outs
+
+    def measure(submit):
+        best = float("inf")
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            storm(submit)
+            best = min(best, time.perf_counter() - t0)
+        return n_requests / best
+
+    def warm_ladder(submit):
+        """Compile every (class, pow2 capacity) executable up front so
+        the measured window is provably compile-free no matter how the
+        linger deadline fragments a round's cohorts (affinity keeps
+        each class's ladder on its owner when routed)."""
+        for c_idx in range(len(classes)):
+            idxs = [i for i in range(n_requests)
+                    if i % len(classes) == c_idx]
+            cap = 1
+            while cap <= max_batch:
+                futs = [submit(*reqs[i]) for i in idxs[:cap]]
+                jax.block_until_ready(
+                    [f.result(timeout=120) for f in futs])
+                cap *= 2
+
+    def single_rps(workers: int) -> float:
+        ex = engine.MicrobatchExecutor(max_batch=max_batch,
+                                       linger_us=5000,
+                                       max_queue=4 * n_requests,
+                                       workers=workers,
+                                       name=f"bench-single-w{workers}")
+        submit = lambda T, A: ex.submit_sketch(T, A,  # noqa: E731
+                                               dimension=sk.ROWWISE)
+        warm_ladder(submit)
+        storm(submit)
+        rps = measure(submit)
+        ex.shutdown()
+        return rps
+
+    rps_single_w2 = single_rps(2)      # the r8 --serve lineage config
+    rps_single_par = single_rps(n_replicas)   # thread parity
+
+    # -- fleet: N replicas, affinity-routed, host-sized flush pool -----
+    # shared_workers sizes flush concurrency to the host: N replicas
+    # each running private workers would run N concurrent flushes and
+    # thrash a small host's cores (docs/fleet, "Tuning N")
+    host_workers = max(2, min(n_replicas, os.cpu_count() or 2))
+    pool = fleet.ReplicaPool(n_replicas, max_batch=max_batch,
+                             linger_us=5000, max_queue=4 * n_requests,
+                             shared_workers=host_workers)
+    router = fleet.Router(pool)
+    submit = lambda T, A: router.submit_sketch(  # noqa: E731
+        T, A, dimension=sk.ROWWISE)
+    warm_ladder(submit)
+    storm(submit)
+    st = engine.stats()
+    warm = (st.misses, st.recompiles)
+    r0 = router.stats()
+    rps_fleet = measure(submit)
+    r1 = router.stats()
+    measured_misses = engine.stats().misses - warm[0]
+    measured_recompiles = engine.stats().recompiles - warm[1]
+    routed_delta = r1["routed"] - r0["routed"]
+    affinity_rate = (
+        round((r1["affinity_hit"] - r0["affinity_hit"]) / routed_delta, 4)
+        if routed_delta else None)
+
+    # correctness spot-check: routed results equal a capacity-1 serve
+    # dispatch bitwise (lane invariance holds THROUGH the router)
+    b_out = storm(submit)
+    ex1 = engine.MicrobatchExecutor(max_batch=1, linger_us=100)
+    lane_equal = all(
+        np.array_equal(
+            np.asarray(b),
+            np.asarray(ex1.submit_sketch(T, A, dimension=sk.ROWWISE)
+                       .result(timeout=120)))
+        for b, (T, A) in zip(b_out, reqs))
+    ex1.shutdown()
+
+    # -- drain leg: preempt one replica mid-storm ----------------------
+    victim = router.owner_of("sketch_apply", transform=reqs[0][0],
+                             A=reqs[0][1], dimension=sk.ROWWISE)
+    fired_hooks = []
+    pool.on_replica_drain(victim, lambda: fired_hooks.append(victim))
+    barrier = _threading.Event()
+    preempted = {}
+
+    def preempt():
+        barrier.wait(10.0)
+        preempted["drained"] = pool.preempt_replica(victim, timeout=60)
+
+    t = _threading.Thread(target=preempt)
+    t.start()
+    drain_failures = 0
+    futs = []
+    for i, (T, A) in enumerate(reqs):
+        futs.append(submit(T, A))
+        if i == n_requests // 4:
+            barrier.set()              # SIGTERM-equivalent lands here
+    t.join()
+    for f in futs:
+        try:
+            jax.block_until_ready(f.result(timeout=120))
+        except Exception:  # noqa: BLE001 — counted, not fatal
+            drain_failures += 1
+    rps_after_drain = measure(submit)
+    r2 = router.stats()
+
+    drain = {
+        "victim": victim,
+        "drained_to_quiescence": bool(preempted.get("drained")),
+        "final_drain_hook_fired": fired_hooks == [victim],
+        "client_visible_failures": drain_failures,
+        "routable_after": r2["routable"],
+        "failovers": r2["failover"],
+        "rps_fleet_after_drain": round(rps_after_drain, 1),
+    }
+
+    router.close()
+    pool.shutdown()
+
+    # cross-record comparison: the committed single-executor --serve
+    # record (rps_batched at 64 in-flight) — regenerated by the same
+    # CI pipeline the fleet gate runs in, so the two records share a
+    # machine and an era
+    serve_record = None
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "benchmarks",
+                               "results_serve_cpu.json")) as fh:
+            serve_rec = json.loads(fh.read().strip().splitlines()[-1])
+        serve_record = {
+            "rps_batched": serve_rec.get("rps_batched"),
+            "n_requests": serve_rec.get("n_requests"),
+            "file": "benchmarks/results_serve_cpu.json",
+        }
+    except Exception:  # noqa: BLE001 — record beats perfect record
+        pass
+
+    rps_single = max(rps_single_w2, rps_single_par)
+    rec = {
+        "metric": "fleet_router_throughput",
+        "platform": jax.default_backend(),
+        "n_requests": n_requests,
+        "n_replicas": n_replicas,
+        "max_batch": max_batch,
+        "workload_classes": [
+            {"rows": "48..60", "cols": f"{c['n_lo']}..{c['n_lo'] + 8}",
+             "s_dim": c["s"]} for c in classes
+        ],
+        "rps_fleet": round(rps_fleet, 1),
+        "rps_single_inrun_workers2": round(rps_single_w2, 1),
+        "rps_single_inrun_thread_parity": round(rps_single_par, 1),
+        "fleet_vs_single_inrun": round(rps_fleet / rps_single, 2),
+        "single_executor_serve_record": serve_record,
+        "fleet_exceeds_serve_record": (
+            bool(rps_fleet > serve_record["rps_batched"])
+            if serve_record and serve_record.get("rps_batched")
+            else None),
+        "host_note": (
+            "in-process replicas share one GIL and one core budget: "
+            "on a <=2-core host the fleet trails an equally-warmed "
+            "single executor by its coordination tax (the in-run A/B "
+            "above) while buying per-replica drain/failover; the "
+            "serve-record comparison spans workloads (this record's "
+            "heterogeneous 4-class mix vs the serve record's single "
+            "medium class)"),
+        "affinity_hit_rate_measured_window": affinity_rate,
+        "routed_by_replica": r1["by_replica"],
+        "misses_after_warmup": measured_misses,
+        "recompiles_after_warmup": measured_recompiles,
+        "bit_equal_to_capacity1_dispatch": lane_equal,
+        "drain": drain,
+        "telemetry": _telemetry_snapshot(),
+    }
+    print(json.dumps(rec), flush=True)
+
+
+# ---------------------------------------------------------------------------
 # parent: bounded orchestration
 # ---------------------------------------------------------------------------
 
@@ -1058,6 +1316,10 @@ if __name__ == "__main__":
         # microbatch serving throughput A/B (batched vs sequential
         # dispatch); backend-agnostic, in-process like --solver
         _serve()
+    elif "--fleet" in sys.argv:
+        # N-replica router vs single-executor A/B + one-replica drain
+        # failover; backend-agnostic, in-process like --serve
+        _fleet()
     elif "--stamp" in sys.argv:
         # the certification line for benchmarks/.tpu_oracle_recert_r*:
         # steps scripts append `$(python bench.py --stamp)` so the stamp

@@ -21,7 +21,16 @@ asserts the resilience subsystem's contract end to end:
   (``recompiles``) stays 0 and every miss is accounted
   (``hits + misses == executions``) — chaos must not thrash the
   executable cache;
-- **clean drain**: ``drain()`` after the storm reaches quiescence.
+- **clean drain**: ``drain()`` after the storm reaches quiescence;
+- **deterministic router failover** (the fleet leg): a fixed-seed
+  ``fleet.route`` fault storm through a 3-replica
+  :class:`~libskylark_tpu.fleet.Router` — every injected route fault
+  fails over to the next ring candidate, every request still resolves
+  bit-equal to the fault-free oracle, the failover counter equals the
+  fired-fault count, and two same-seed runs replay the identical
+  fired sequence. Route checks run on the submitting thread, so the
+  hit order — unlike flush-side hits under concurrent workers — is
+  deterministic by construction.
 
 Usage: ``python benchmarks/chaos_battery.py --gate`` (script/ci wires
 ``JAX_PLATFORMS=cpu`` and the canned ``SKYLARK_FAULT_PLAN``). Prints
@@ -123,6 +132,99 @@ def _storm(T, ops):
     return outcomes, faults.fired(), ex.stats(), drained
 
 
+# The fleet leg's canned plan: fleet.route-only, because route checks
+# happen on the (single) submitting thread — their hit order is
+# deterministic, which is what makes the replay comparison exact. A
+# serve.flush spec here would race across the replicas' worker threads.
+FLEET_PLAN = {
+    "seed": 13,
+    "faults": [
+        {"site": "fleet.route", "error": "IOError_", "every": 5},
+    ],
+}
+FLEET_REPLICAS = 3
+
+
+def _fleet_storm(T, ops):
+    """One deterministic routed storm over a 3-replica fleet: submit
+    in cohort groups (pool-flushed each), drain. Returns outcomes,
+    the fired log, and the router's counters."""
+    from libskylark_tpu import fleet
+    from libskylark_tpu.resilience import faults
+
+    pool = fleet.ReplicaPool(FLEET_REPLICAS, max_batch=MAX_BATCH,
+                             linger_us=10_000_000)
+    router = fleet.Router(pool)
+    futs = []
+    for i, A in enumerate(ops):
+        futs.append(router.submit_sketch(T, A))
+        if (i + 1) % MAX_BATCH == 0:
+            pool.flush()
+    pool.flush()
+    outcomes = []
+    for f in futs:
+        if not f.done():
+            outcomes.append(("ORPHANED", None))
+        elif f.exception() is not None:
+            outcomes.append(("ERROR", type(f.exception()).__name__))
+        else:
+            outcomes.append(("OK", np.asarray(f.result())))
+    stats = router.stats()
+    fired = faults.fired()
+    router.close()
+    pool.shutdown()
+    return outcomes, fired, stats
+
+
+def _fleet_leg(T, ops, refs, violations):
+    from libskylark_tpu.resilience import faults
+
+    with faults.fault_plan(dict(FLEET_PLAN)):
+        out1, fired1, stats1 = _fleet_storm(T, ops)
+    with faults.fault_plan(dict(FLEET_PLAN)):
+        out2, fired2, stats2 = _fleet_storm(T, ops)
+
+    orphans = sum(1 for s, _ in out1 + out2 if s == "ORPHANED")
+    if orphans:
+        violations.append(f"fleet leg: {orphans} orphaned future(s)")
+    for run, out in (("run1", out1), ("run2", out2)):
+        for i, (status, val) in enumerate(out):
+            if status != "OK":
+                violations.append(
+                    f"fleet leg {run}: request {i} got {status}/{val} "
+                    "— a route fault leaked to a client")
+                break
+            if not np.array_equal(val, refs[i]):
+                violations.append(
+                    f"fleet leg {run}: request {i} not bit-equal to "
+                    "the fault-free oracle")
+                break
+    if fired1 != fired2:
+        violations.append(
+            f"fleet leg: fired sequences differ across same-seed "
+            f"runs: {fired1} vs {fired2}")
+    if not fired1:
+        violations.append("fleet leg: plan injected nothing — inert")
+    if any(site != "fleet.route" for site, _, _ in fired1):
+        violations.append("fleet leg: unexpected site in fired log")
+    for run, st in (("run1", stats1), ("run2", stats2)):
+        if st["failover"] != len(fired1):
+            violations.append(
+                f"fleet leg {run}: failover count {st['failover']} != "
+                f"fired route faults {len(fired1)}")
+        if st["routed"] != len(ops):
+            violations.append(
+                f"fleet leg {run}: routed {st['routed']} != "
+                f"{len(ops)} submitted")
+    return {
+        "replicas": FLEET_REPLICAS,
+        "fired": [list(f) for f in fired1],
+        "failover": stats1["failover"],
+        "affinity_hit_rate": stats1["affinity_hit_rate"],
+        "deterministic": fired1 == fired2,
+    }
+
+
 def main() -> int:
     from libskylark_tpu import engine
     from libskylark_tpu.base import errors  # noqa: F401 — class names
@@ -192,6 +294,9 @@ def main() -> int:
                 f"{run}: isolation depth {st['isolation_depth_peak']} > "
                 f"log2(max_batch) = {depth_bound}")
 
+    # -- fleet leg: deterministic router failover -----------------------
+    fleet_rec = _fleet_leg(T, ops, refs, violations)
+
     # -- zero leaked executables (the jit-leak counter) -----------------
     est = engine.stats()
     if est.recompiles:
@@ -215,6 +320,7 @@ def main() -> int:
         "depth_bound": depth_bound,
         "engine_recompiles": est.recompiles,
         "deterministic": fired1 == fired2,
+        "fleet": fleet_rec,
         "violations": violations,
     }
     print(json.dumps(rec), flush=True)

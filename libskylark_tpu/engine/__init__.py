@@ -39,13 +39,14 @@ from libskylark_tpu.engine.compiled import (CompiledFn, cache, code_version,
                                             reset, stats)
 from libskylark_tpu.engine.serve import (DEGRADED, DRAINING, SERVING,
                                          STOPPED, MicrobatchExecutor,
-                                         ServeOverloadedError, serve_stats)
+                                         ServeOverloadedError,
+                                         request_statics, serve_stats)
 
 __all__ = [
     "CacheEntry", "CompiledFn", "DEGRADED", "DRAINING", "EngineStats",
     "ExecutableCache", "MicrobatchExecutor", "SERVING", "STOPPED",
     "ServeOverloadedError", "bucket", "cache",
     "code_version", "compiled", "digest", "donation_enabled", "dump_stats",
-    "enable_persistent_cache", "maybe_donate", "plan_fingerprint", "reset",
-    "serve_stats", "stats",
+    "enable_persistent_cache", "maybe_donate", "plan_fingerprint",
+    "request_statics", "reset", "serve_stats", "stats",
 ]

@@ -59,6 +59,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import dataclasses
+import itertools
 import threading
 import time
 import warnings
@@ -73,10 +74,17 @@ from libskylark_tpu.engine import bucket as bucketing
 from libskylark_tpu.engine.compiled import compiled as engine_compile
 from libskylark_tpu.engine.compiled import digest as engine_digest
 from libskylark_tpu.resilience import faults
+from libskylark_tpu.resilience import health as _health
 from libskylark_tpu.resilience.policy import Deadline
 from libskylark_tpu.telemetry import trace as _trace
 
 ENDPOINTS = ("sketch_apply", "solve_l2_sketched", "krr_predict")
+
+# auto-assigned replica identity labels ("ex-0", "ex-1", ...) for
+# executors constructed without an explicit ``name`` — every executor
+# has an identity so per-replica telemetry disaggregation never falls
+# back to "some anonymous executor"
+_EX_SEQ = itertools.count()
 
 # Executor health states (see the module docstring / docs/resilience).
 SERVING = "SERVING"
@@ -117,11 +125,167 @@ class _Bucket:
         return self.reqs[0].t_submit if self.reqs else float("inf")
 
 
+def dispatch_loop(workq) -> None:
+    """Flush-worker loop over a dispatch queue of ``(executor,
+    (bucket, cohort))`` items (``None`` poisons one worker). Run by
+    each executor's own worker threads, and by a
+    :class:`~libskylark_tpu.fleet.ReplicaPool`'s shared worker pool
+    when replicas are constructed with ``dispatch_queue=`` — cohorts
+    from many executors then drain through one host-sized pool."""
+    while True:
+        item = workq.get()
+        if item is None:
+            return
+        ex, work = item
+        ex._dispatch_cohort(*work)
+
+
 def _percentile(sorted_vals: list, q: float) -> Optional[float]:
     if not sorted_vals:
         return None
     i = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
     return sorted_vals[i]
+
+
+# ---------------------------------------------------------------------------
+# bucket statics derivation — shared by the executor's per-endpoint prep
+# and the fleet router's affinity key (libskylark_tpu/fleet/router.py):
+# both MUST hash the same tuple or sticky routing would send a request
+# to a replica whose executable cache is warm for a DIFFERENT class.
+# ---------------------------------------------------------------------------
+
+
+def _sketch_family(transform):
+    """(family tag, dist instance) for a serve-able transform."""
+    from libskylark_tpu.sketch.dense import DenseTransform
+    from libskylark_tpu.sketch.hash import CWT
+
+    if isinstance(transform, CWT):
+        return "CWT", None
+    if isinstance(transform, DenseTransform):
+        return transform.sketch_type, transform.dist
+    raise TypeError(
+        "serve endpoints batch dense (JLT/CT) and CWT transforms; "
+        f"got {type(transform).__name__}")
+
+
+def _sketch_statics(transform, A, dimension, pad_floor):
+    """(statics, info) for a sketch_apply request. ``info`` carries the
+    derivation intermediates the executor's prep reuses (reshaped
+    operand, family, dist, rowwise flag, padded class shape)."""
+    from libskylark_tpu.sketch import COLUMNWISE, Dimension
+
+    dimension = dimension or COLUMNWISE
+    rowwise = Dimension(dimension) == Dimension.ROWWISE
+    A = np.asarray(A)
+    if A.ndim == 1:
+        A = A[None, :] if rowwise else A[:, None]
+    n = A.shape[1] if rowwise else A.shape[0]
+    if n != transform.input_dim:
+        raise ValueError(
+            f"operand dim {n} != transform input dim "
+            f"{transform.input_dim}")
+    family, dist = _sketch_family(transform)
+    pad_axes = (0, 1)  # both extents paddable: N is stream-exact,
+    #                    the other axis is sliced off the output
+    padded = bucketing.pad_shape(A.shape, pad_axes, pad_floor)
+    statics = ("sketch_apply", family, repr(dist),
+               transform.sketch_dim, rowwise, str(A.dtype), padded)
+    return statics, {"A": A, "family": family, "dist": dist,
+                     "rowwise": rowwise, "padded": padded}
+
+
+def _solve_statics(transform, A, B, method, pad_floor):
+    """(statics, info) for a solve_l2_sketched request."""
+    A = np.asarray(A)
+    B = np.asarray(B)
+    squeeze = B.ndim == 1
+    if squeeze:
+        B = B[:, None]
+    if A.ndim != 2 or B.shape[0] != A.shape[0]:
+        raise ValueError(f"solve expects (n,d) A and (n,t) B, got "
+                         f"{A.shape} / {B.shape}")
+    if A.shape[0] != transform.input_dim:
+        raise ValueError(
+            f"operand rows {A.shape[0]} != transform input dim "
+            f"{transform.input_dim}")
+    family, dist = _sketch_family(transform)
+    if family not in ("JLT", "CWT"):
+        raise TypeError(f"solve serve path supports JLT/CWT, "
+                        f"got {family}")
+    n_pad = bucketing.pow2_pad(A.shape[0], pad_floor)
+    # d and t are exact bucket components: zero feature/target
+    # columns would make the compressed problem singular
+    statics = ("solve_l2_sketched", family, transform.sketch_dim,
+               method, A.shape[1], B.shape[1], str(A.dtype), n_pad)
+    return statics, {"A": A, "B": B, "squeeze": squeeze,
+                     "family": family, "n_pad": n_pad}
+
+
+def _krr_statics(kernel, X_new, X_train, coef, pad_floor):
+    """(statics, info) for a krr_predict request. Shape-only on the
+    model operands — the router must not pay a device conversion to
+    compute an affinity key, so this reads ``np.shape`` where the
+    executor's prep later converts."""
+    X_new = np.asarray(X_new)
+    squeeze_q = X_new.ndim == 1
+    if squeeze_q:
+        X_new = X_new[None, :]
+    train_shape = tuple(np.shape(X_train))
+    coef_shape = tuple(np.shape(coef))
+    if len(coef_shape) == 1:
+        coef_shape = coef_shape + (1,)
+    if X_new.shape[1] != train_shape[1]:
+        raise ValueError(
+            f"query dim {X_new.shape[1]} != train dim "
+            f"{train_shape[1]}")
+    q_pad = bucketing.pow2_pad(X_new.shape[0], pad_floor)
+    statics = ("krr_predict", engine_digest(kernel),
+               train_shape, coef_shape, str(X_new.dtype), q_pad)
+    return statics, {"X_new": X_new, "squeeze_q": squeeze_q,
+                     "q_pad": q_pad}
+
+
+def request_statics(endpoint: str, *,
+                    pad_floor: int = bucketing.PAD_FLOOR,
+                    **kwargs) -> tuple:
+    """The engine-level bucket statics a request of ``endpoint`` with
+    these operands lands in: (endpoint, family/digest, dtype, shape
+    class, ...) — exactly the tuple the executor keys its batched
+    executables on. This is the fleet router's affinity key (one
+    executable class == one consistent-hash bucket), exposed as a
+    module function so routing never has to build a request to know
+    where it belongs. Transport kwargs (``timeout`` / ``deadline`` /
+    ``request_id``) are ignored; ``pad_floor`` must match the target
+    executors' (a :class:`~libskylark_tpu.fleet.ReplicaPool` keeps it
+    uniform)."""
+    return derive_request(endpoint, pad_floor=pad_floor, **kwargs)[0]
+
+
+def derive_request(endpoint: str, *,
+                   pad_floor: int = bucketing.PAD_FLOOR,
+                   **kwargs) -> tuple:
+    """``(statics, info)`` — the full derivation behind
+    :func:`request_statics`. The fleet router uses this form and hands
+    the result back to the chosen replica's ``submit`` (internal
+    ``_derived=`` kwarg) so the derivation runs once per routed
+    request, not once in the router and again in the executor."""
+    for transport in ("timeout", "deadline", "request_id"):
+        kwargs.pop(transport, None)
+    if endpoint == "sketch_apply":
+        kwargs.setdefault("dimension", None)
+        return _sketch_statics(kwargs["transform"], kwargs["A"],
+                               kwargs["dimension"], pad_floor)
+    if endpoint == "solve_l2_sketched":
+        kwargs.setdefault("method", "qr")
+        return _solve_statics(kwargs["transform"], kwargs["A"],
+                              kwargs["B"], kwargs["method"], pad_floor)
+    if endpoint == "krr_predict":
+        return _krr_statics(kwargs["kernel"], kwargs["X_new"],
+                            kwargs["X_train"], kwargs["coef"],
+                            pad_floor)
+    raise ValueError(f"unknown serve endpoint {endpoint!r}; "
+                     f"expected one of {ENDPOINTS}")
 
 
 class MicrobatchExecutor:
@@ -145,6 +309,14 @@ class MicrobatchExecutor:
     single-flight, so concurrent cold flushes of one bucket compile
     once. Submission itself is cheap (a host-side pack + queue append)
     and safe from any thread.
+
+    ``dispatch_queue`` (advanced; a ``queue.Queue``) makes this
+    executor enqueue its cohorts there instead of spawning its own
+    workers — the seam a :class:`~libskylark_tpu.fleet.ReplicaPool`
+    uses to size flush concurrency to the HOST rather than to N
+    replicas (N replicas × own workers oversubscribes a small host;
+    see docs/fleet "Tuning N"). The queue's owner runs the worker
+    threads (:func:`dispatch_loop`) and must outlive the executor.
     """
 
     def __init__(self, max_batch: int = 8, linger_us: int = 2000,
@@ -152,13 +324,19 @@ class MicrobatchExecutor:
                  mesh=None, pad_floor: int = bucketing.PAD_FLOOR,
                  degraded_threshold: float = 0.5,
                  failure_window: int = 32,
-                 shed_fraction: float = 0.25):
+                 shed_fraction: float = 0.25,
+                 name: Optional[str] = None,
+                 dispatch_queue=None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if not 0.0 < degraded_threshold <= 1.0:
             raise ValueError("degraded_threshold must be in (0, 1]")
         if not 0.0 < shed_fraction <= 1.0:
             raise ValueError("shed_fraction must be in (0, 1]")
+        # replica identity: the label under which this executor's
+        # counters disaggregate in telemetry.snapshot() / Prometheus,
+        # and the name a ReplicaPool/Router address it by
+        self.name = str(name) if name else f"ex-{next(_EX_SEQ)}"
         self.max_batch = int(max_batch)
         self.linger = float(linger_us) * 1e-6
         self.max_queue = int(max_queue)
@@ -195,17 +373,28 @@ class MicrobatchExecutor:
         # sliding window of flush-attempt outcomes (1.0 = failed): the
         # DEGRADED detector's evidence
         self._health = collections.deque(maxlen=max(int(failure_window), 4))
+        # push-side of the health states: the last state published to
+        # the resilience hub (fleet routers subscribe); guarded by its
+        # own lock so a flush worker and a drain can race a transition
+        # without serializing on the executor lock
+        self._pub_lock = threading.Lock()
+        self._published_state = SERVING
 
         import queue as _queue
 
-        self._workq: "_queue.Queue" = _queue.Queue()
-        self._workers = [
-            threading.Thread(target=self._worker_loop,
-                             name=f"skylark-serve-worker-{i}", daemon=True)
-            for i in range(max(int(workers), 1))
-        ]
-        for t in self._workers:
-            t.start()
+        if dispatch_queue is not None:
+            self._workq = dispatch_queue
+            self._workers = []        # the queue's owner runs them
+        else:
+            self._workq = _queue.Queue()
+            self._workers = [
+                threading.Thread(
+                    target=dispatch_loop, args=(self._workq,),
+                    name=f"skylark-serve-worker-{i}", daemon=True)
+                for i in range(max(int(workers), 1))
+            ]
+            for t in self._workers:
+                t.start()
         self._flusher = threading.Thread(
             target=self._flusher_loop, name="skylark-serve-flusher",
             daemon=True)
@@ -231,6 +420,12 @@ class MicrobatchExecutor:
         timeout = kwargs.pop("timeout", 30.0)
         deadline = Deadline.coerce(kwargs.pop("deadline", None))
         rid = kwargs.pop("request_id", None)
+        # internal fast path: the fleet router already derived the
+        # bucket statics to pick this replica — reuse them instead of
+        # re-deriving (the derivation is the submit hot path's single
+        # biggest cost; doing it twice per routed request would tax
+        # every fleet submit)
+        derived = kwargs.pop("_derived", None)
         if rid is None and _telemetry.enabled():
             rid = _trace.new_request_id()
         # the submit span covers pack + enqueue; its context (trace id,
@@ -238,11 +433,14 @@ class MicrobatchExecutor:
         with _trace.span("serve.submit", attrs={"endpoint": endpoint},
                          request_id=rid) as sp:
             if endpoint == "sketch_apply":
-                key, statics, ctx, req = self._prep_sketch(**kwargs)
+                key, statics, ctx, req = self._prep_sketch(
+                    _derived=derived, **kwargs)
             elif endpoint == "solve_l2_sketched":
-                key, statics, ctx, req = self._prep_solve(**kwargs)
+                key, statics, ctx, req = self._prep_solve(
+                    _derived=derived, **kwargs)
             elif endpoint == "krr_predict":
-                key, statics, ctx, req = self._prep_krr(**kwargs)
+                key, statics, ctx, req = self._prep_krr(
+                    _derived=derived, **kwargs)
             else:
                 raise ValueError(f"unknown serve endpoint {endpoint!r}; "
                                  f"expected one of {ENDPOINTS}")
@@ -291,40 +489,12 @@ class MicrobatchExecutor:
                 pass
         return kd
 
-    def _sketch_family(self, transform):
-        """(family tag, dist instance) for a serve-able transform."""
-        from libskylark_tpu.sketch.dense import DenseTransform
-        from libskylark_tpu.sketch.hash import CWT
-
-        if isinstance(transform, CWT):
-            return "CWT", None
-        if isinstance(transform, DenseTransform):
-            return transform.sketch_type, transform.dist
-        raise TypeError(
-            "serve endpoints batch dense (JLT/CT) and CWT transforms; "
-            f"got {type(transform).__name__}")
-
-    def _prep_sketch(self, transform, A, dimension=None):
-        from libskylark_tpu.sketch import COLUMNWISE, Dimension
-
-        dimension = dimension or COLUMNWISE
-        rowwise = Dimension(dimension) == Dimension.ROWWISE
-        A = np.asarray(A)
-        if A.ndim == 1:
-            A = A[None, :] if rowwise else A[:, None]
-        n = A.shape[1] if rowwise else A.shape[0]
-        if n != transform.input_dim:
-            raise ValueError(
-                f"operand dim {n} != transform input dim "
-                f"{transform.input_dim}")
-        family, dist = self._sketch_family(transform)
-        pad_axes = (0, 1)  # both extents paddable: N is stream-exact,
-        #                    the other axis is sliced off the output
-        padded = bucketing.pad_shape(A.shape, pad_axes, self.pad_floor)
-        statics = ("sketch_apply", family, repr(dist),
-                   transform.sketch_dim, rowwise, str(A.dtype), padded)
-        ctx = {"dist": dist, "family": family,
-               "s_dim": transform.sketch_dim, "rowwise": rowwise}
+    def _prep_sketch(self, transform, A, dimension=None, _derived=None):
+        statics, info = _derived or _sketch_statics(
+            transform, A, dimension, self.pad_floor)
+        A = info["A"]
+        ctx = {"dist": info["dist"], "family": info["family"],
+               "s_dim": transform.sketch_dim, "rowwise": info["rowwise"]}
         req = _Request(
             endpoint="sketch_apply",
             arrays={"kd": self._key_data(transform),
@@ -332,34 +502,17 @@ class MicrobatchExecutor:
                                         dtype=A.dtype),
                     "A": A},
             true_shapes={"A": A.shape},
-            meta={"padded": padded, "rowwise": rowwise,
+            meta={"padded": info["padded"], "rowwise": info["rowwise"],
                   "s_dim": transform.sketch_dim},
         )
         return statics, statics, ctx, req
 
-    def _prep_solve(self, A, B, transform, method: str = "qr"):
-        A = np.asarray(A)
-        B = np.asarray(B)
-        squeeze = B.ndim == 1
-        if squeeze:
-            B = B[:, None]
-        if A.ndim != 2 or B.shape[0] != A.shape[0]:
-            raise ValueError(f"solve expects (n,d) A and (n,t) B, got "
-                             f"{A.shape} / {B.shape}")
-        if A.shape[0] != transform.input_dim:
-            raise ValueError(
-                f"operand rows {A.shape[0]} != transform input dim "
-                f"{transform.input_dim}")
-        family, dist = self._sketch_family(transform)
-        if family not in ("JLT", "CWT"):
-            raise TypeError(f"solve serve path supports JLT/CWT, "
-                            f"got {family}")
-        n_pad = bucketing.pow2_pad(A.shape[0], self.pad_floor)
-        # d and t are exact bucket components: zero feature/target
-        # columns would make the compressed problem singular
-        statics = ("solve_l2_sketched", family, transform.sketch_dim,
-                   method, A.shape[1], B.shape[1], str(A.dtype), n_pad)
-        ctx = {"family": family, "s_dim": transform.sketch_dim,
+    def _prep_solve(self, A, B, transform, method: str = "qr",
+                    _derived=None):
+        statics, info = _derived or _solve_statics(
+            transform, A, B, method, self.pad_floor)
+        A, B, n_pad = info["A"], info["B"], info["n_pad"]
+        ctx = {"family": info["family"], "s_dim": transform.sketch_dim,
                "method": method}
         req = _Request(
             endpoint="solve_l2_sketched",
@@ -369,17 +522,18 @@ class MicrobatchExecutor:
                     "A": A, "B": B.astype(A.dtype, copy=False)},
             true_shapes={"A": A.shape, "B": B.shape},
             meta={"padded_A": (n_pad, A.shape[1]),
-                  "padded_B": (n_pad, B.shape[1]), "squeeze": squeeze},
+                  "padded_B": (n_pad, B.shape[1]),
+                  "squeeze": info["squeeze"]},
         )
         return statics, statics, ctx, req
 
-    def _prep_krr(self, kernel, X_new, X_train, coef):
+    def _prep_krr(self, kernel, X_new, X_train, coef, _derived=None):
         import jax.numpy as jnp
 
-        X_new = np.asarray(X_new)
-        squeeze_q = X_new.ndim == 1
-        if squeeze_q:
-            X_new = X_new[None, :]
+        statics, info = _derived or _krr_statics(
+            kernel, X_new, X_train, coef, self.pad_floor)
+        X_new, squeeze_q, q_pad = (info["X_new"], info["squeeze_q"],
+                                   info["q_pad"])
         # model identity is taken from the objects the CALLER holds,
         # before any conversion: a server submitting the same numpy
         # model on every request must keep coalescing into one bucket
@@ -391,13 +545,6 @@ class MicrobatchExecutor:
         squeeze_t = coef.ndim == 1
         if squeeze_t:
             coef = coef[:, None]
-        if X_new.shape[1] != X_train.shape[1]:
-            raise ValueError(
-                f"query dim {X_new.shape[1]} != train dim "
-                f"{X_train.shape[1]}")
-        q_pad = bucketing.pow2_pad(X_new.shape[0], self.pad_floor)
-        statics = ("krr_predict", engine_digest(kernel),
-                   X_train.shape, coef.shape, str(X_new.dtype), q_pad)
         # model identity separates buckets (cohorts must not mix
         # models) but stays OUT of the engine key: two models with the
         # same shapes share one executable. The bucket ctx pins the
@@ -439,15 +586,19 @@ class MicrobatchExecutor:
         shed_bound = max(1, int(self.max_queue * self.shed_fraction))
         with self._lock:
             self._refuse_if_unavailable_locked()
-            if degraded and self._pending >= shed_bound:
+            exposure = self._pending + self._inflight
+            if degraded and exposure >= shed_bound:
                 # DEGRADED load shed: reject immediately at the reduced
-                # bound instead of letting callers linger in a queue the
-                # failing flush path may never clear
+                # bound instead of letting callers linger behind a
+                # failing flush path. The bound counts queued AND
+                # in-flight requests — the full-cohort fast path moves
+                # work straight to the workers, so a queued-only count
+                # would let a max_batch-sized burst bypass the shed
                 with self._stats_lock:
                     self._counts["shed"] += 1
                 raise ServeOverloadedError(
-                    f"load shed: executor DEGRADED and queue at "
-                    f"{self._pending} >= shed bound {shed_bound}")
+                    f"load shed: executor DEGRADED and exposure at "
+                    f"{exposure} >= shed bound {shed_bound}")
             while self._pending >= self.max_queue:
                 wait = deadline - time.monotonic() if timeout else None
                 if timeout and wait <= 0:
@@ -478,7 +629,24 @@ class MicrobatchExecutor:
                 self._counts["submitted"] += 1
                 self._counts["queued_peak"] = max(
                     self._counts["queued_peak"], self._pending)
-            self._work_cv.notify_all()
+            # full-cohort fast path: hand the cohort straight to the
+            # worker queue instead of waking the flusher thread to
+            # rediscover it — one less wakeup/context switch on the
+            # max_batch steady state (the flusher still owns linger
+            # expiry, drain, and partial flushes). The put must stay
+            # under the lock: popped outside it, a racing shutdown()
+            # could post the worker-poisoning sentinels between our
+            # pop and put (the cohort is no longer in _buckets, so
+            # the flusher sees nothing left), stranding every future
+            # in the cohort behind workers that already exited —
+            # under the lock, FIFO orders the work ahead of the
+            # sentinels. The queue is unbounded, so put cannot block.
+            work = (self._pop_cohort_locked(key)
+                    if len(b.reqs) >= self.max_batch else None)
+            if work is None:
+                self._work_cv.notify_all()
+            else:
+                self._workq.put((self, work))
 
     def _pop_cohort_locked(self, key) -> Optional[tuple]:
         b = self._buckets.get(key)
@@ -520,7 +688,7 @@ class MicrobatchExecutor:
                         continue
                     self._work_cv.wait(timeout=wait)
                     continue
-            self._workq.put(work)
+            self._workq.put((self, work))
         for _ in self._workers:
             self._workq.put(None)
 
@@ -544,16 +712,15 @@ class MicrobatchExecutor:
             with self._lock:
                 self._cohort_done_locked()
 
-    def _worker_loop(self) -> None:
-        while True:
-            work = self._workq.get()
-            if work is None:
-                return
-            self._dispatch_cohort(*work)
 
     def flush(self) -> None:
         """Synchronously flush every pending cohort from the calling
-        thread (tests/bench warmup; normal traffic never needs it)."""
+        thread (tests/bench warmup; normal traffic never needs it).
+        Returns only after every in-flight cohort has resolved too —
+        the full-cohort fast path hands work to the worker threads at
+        submit time, and "synchronous" must cover those (a chaos test
+        activates a fault plan around submit+flush and the flush
+        attempts must execute inside the plan's extent)."""
         while True:
             with self._lock:
                 work = None
@@ -562,8 +729,11 @@ class MicrobatchExecutor:
                     if work:
                         break
             if not work:
-                return
+                break
             self._dispatch_cohort(*work)
+        with self._lock:
+            while self._inflight:
+                self._idle_cv.wait(timeout=0.1)
 
     # ------------------------------------------------------------------
     # failure isolation: bisection converges on the poison request
@@ -642,6 +812,8 @@ class MicrobatchExecutor:
                         # shed healthy traffic — contradicting "fails
                         # alone"
                         self._health.append(1.0)
+                if depth == 0:
+                    self._maybe_publish_state()
                 if len(cohort) == 1:
                     r = cohort[0]
                     if not r.future.done():
@@ -661,6 +833,7 @@ class MicrobatchExecutor:
                 if depth == 0:
                     with self._stats_lock:
                         self._health.append(0.0)
+                    self._maybe_publish_state()
 
     def _is_degraded(self) -> bool:
         with self._stats_lock:
@@ -912,6 +1085,41 @@ class MicrobatchExecutor:
                 return DRAINING
         return DEGRADED if self._is_degraded() else SERVING
 
+    def queue_depth(self) -> int:
+        """Pending + in-flight request count — the live load signal the
+        fleet router's spill heuristic reads. Note this is a superset
+        of the telemetry ``queued`` gauge, which reports only the
+        pending (not-yet-dispatched) count: under high in-flight load
+        the router sees a larger number than a scraped dashboard, so
+        tune ``Router.spill_threshold`` against this method, not the
+        gauge."""
+        with self._lock:
+            return self._pending + self._inflight
+
+    def _maybe_publish_state(self) -> None:
+        """Publish a health-state transition to the resilience hub
+        (:mod:`libskylark_tpu.resilience.health`) if one happened —
+        the push-side a fleet router subscribes to. Called from every
+        root flush outcome (DEGRADED flips, both directions), from
+        :meth:`drain` (DRAINING) and :meth:`shutdown` (STOPPED).
+        Callbacks run outside the executor lock; the publish lock only
+        serializes the compare-and-set so two racing workers can't
+        both announce the same transition. The state read must happen
+        INSIDE the publish lock: read outside, a worker descheduled
+        between read and acquire would publish its stale snapshot
+        after a peer already announced a newer one."""
+        with self._pub_lock:
+            new = self.state
+            old = self._published_state
+            if new == old:
+                return
+            self._published_state = new
+            # publish under the (executor-independent) publish lock so
+            # racing transitions reach subscribers in order — a
+            # DEGRADED announcement landing after the recovery to
+            # SERVING would wedge a router's view of a healthy replica
+            _health.publish(self, old, new)
+
     def drain(self, timeout: Optional[float] = 30.0) -> bool:
         """Preemption-safe drain: stop intake (new submits raise
         :class:`ServeOverloadedError`), flush every queued cohort, and
@@ -928,6 +1136,11 @@ class MicrobatchExecutor:
             self._draining = True
             self._work_cv.notify_all()
             self._space_cv.notify_all()
+        # announce DRAINING before waiting for quiescence: a subscribed
+        # router must shed new traffic to peers WHILE the drain flushes
+        # the queue, not after
+        self._maybe_publish_state()
+        with self._lock:
             drained = True
             while self._pending or self._inflight or self._buckets:
                 rem = dl.remaining()
@@ -994,6 +1207,7 @@ class MicrobatchExecutor:
             self._stop = True
             self._work_cv.notify_all()
             self._space_cv.notify_all()
+        self._maybe_publish_state()
         if wait:
             self._flusher.join()
             for t in self._workers:
@@ -1012,12 +1226,33 @@ _EXECUTORS: "weakref.WeakSet[MicrobatchExecutor]" = weakref.WeakSet()
 def serve_stats() -> dict:
     """Aggregate counters across every live executor in the process
     (the serve analog of ``engine.stats()``; folded into
-    ``engine.dump_stats`` under ``"serve"``)."""
+    ``engine.dump_stats`` under ``"serve"``), disaggregated per
+    replica under ``by_replica``.
+
+    Aggregation semantics over N executors (the r11 fix — the
+    single-executor-era version summed what it knew and silently
+    dropped the rest): monotone counters SUM; the peak diagnostics
+    (``queued_peak``, ``isolation_depth_peak``) take the MAX — summing
+    a per-replica high-water mark across replicas would report a queue
+    depth no single executor ever saw; the capacity/cohort histograms
+    merge bin-wise; padding waste re-derives from the pooled raw
+    element counts (a mean of per-replica ratios would weight an idle
+    replica equally with a loaded one); latency percentiles come from
+    the pooled samples; ``states`` counts executors per health state.
+    ``by_replica`` keys each executor's own :meth:`stats()` block by
+    its ``name`` — the replica label telemetry and the Prometheus
+    renderer use (``docs/observability``)."""
     agg: dict = {"executors": 0}
     _SUM_KEYS = ("submitted", "completed", "failed", "rejected", "shed",
                  "expired", "poisoned", "flush_failures",
                  "isolation_retries", "queued", "coalesced", "flushes")
+    _MAX_KEYS = ("queued_peak", "isolation_depth_peak")
     sums = collections.Counter({k: 0 for k in _SUM_KEYS})
+    maxes = {k: 0 for k in _MAX_KEYS}
+    batch_hist: "collections.Counter" = collections.Counter()
+    cohort_hist: "collections.Counter" = collections.Counter()
+    states: "collections.Counter" = collections.Counter()
+    by_replica: dict = {}
     lat_all: list = []
     waste_real = waste_total = 0
     for ex in list(_EXECUTORS):
@@ -1025,19 +1260,33 @@ def serve_stats() -> dict:
         agg["executors"] += 1
         for k in _SUM_KEYS:
             sums[k] += s[k]
+        for k in _MAX_KEYS:
+            maxes[k] = max(maxes[k], s.get(k, 0))
+        batch_hist.update(s["batch_capacity_hist"])
+        cohort_hist.update(s["cohort_size_hist"])
+        states[s["state"]] += 1
         if s["padding_waste_ratio"] is not None:
             with ex._stats_lock:
                 waste_real += ex._pad_real
                 waste_total += ex._pad_total
         with ex._stats_lock:
             lat_all.extend(ex._latency)
+        name = ex.name
+        while name in by_replica:     # defensive: caller reused a name
+            name += "+"
+        by_replica[name] = s
     agg.update(sums)
+    agg.update(maxes)
+    agg["batch_capacity_hist"] = dict(sorted(batch_hist.items()))
+    agg["cohort_size_hist"] = dict(sorted(cohort_hist.items()))
+    agg["states"] = dict(sorted(states.items()))
     agg["padding_waste_ratio"] = (
         round(1.0 - waste_real / waste_total, 4) if waste_total else None)
     lat_all.sort()
     agg["latency_s"] = {"p50": _percentile(lat_all, 0.50),
                         "p99": _percentile(lat_all, 0.99),
                         "n": len(lat_all)}
+    agg["by_replica"] = dict(sorted(by_replica.items()))
     return agg
 
 

@@ -1,0 +1,41 @@
+"""Fleet subsystem: a replicated serving tier over N microbatch
+executors.
+
+One :class:`~libskylark_tpu.engine.serve.MicrobatchExecutor` per
+process was the r8–r10 ceiling; this package is the "millions of
+users" layer above it (ROADMAP item 1):
+
+- :mod:`~libskylark_tpu.fleet.replica` — the unit of capacity:
+  :class:`ThreadReplica` (in-process executor) and
+  :class:`ProcessReplica` (spawned child with its own executor,
+  preemption handler, and — via ``coordinator=`` — a seat in the
+  :mod:`libskylark_tpu.parallel.multihost` distributed pool).
+- :mod:`~libskylark_tpu.fleet.pool` — :class:`ReplicaPool`: N uniform
+  named replicas, per-replica drain hooks (final checkpoints), and
+  single-replica preemption composed with the process-wide r9 SIGTERM
+  handler.
+- :mod:`~libskylark_tpu.fleet.ring` — the consistent-hash
+  :class:`HashRing` that makes routing *sticky*: one bucket class, one
+  warm replica, one compile fleet-wide.
+- :mod:`~libskylark_tpu.fleet.router` — :class:`Router`: the front
+  door whose ``submit`` mirrors the executor API and routes on
+  affinity + live queue depth + subscribed health states, failing over
+  past refusing/draining replicas with zero client-visible failures.
+
+Measured by ``bench.py --fleet`` (N-replica vs single-executor A/B,
+affinity hit-rate, drain failover), chaos-replayed by
+``benchmarks/chaos_battery.py`` (the ``fleet.route`` fault site), and
+gated in CI by ``benchmarks/fleet_smoke.py``. See ``docs/fleet``.
+"""
+
+from libskylark_tpu.fleet.pool import ReplicaPool
+from libskylark_tpu.fleet.replica import (ProcessReplica, Replica,
+                                          ThreadReplica)
+from libskylark_tpu.fleet.ring import HashRing
+from libskylark_tpu.fleet.router import (NoHealthyReplicaError, Router,
+                                         fleet_stats)
+
+__all__ = [
+    "HashRing", "NoHealthyReplicaError", "ProcessReplica", "Replica",
+    "ReplicaPool", "Router", "ThreadReplica", "fleet_stats",
+]

@@ -1,0 +1,254 @@
+"""ReplicaPool: owns N named replicas and their shared lifecycle.
+
+The pool is the fleet's capacity layer: it constructs N replicas with
+*uniform* executor configuration (one ``pad_floor``, one ``max_batch``
+— the router derives affinity keys and spill bounds from the pool, so
+a heterogeneous fleet would break sticky routing), names them
+``r0..r{N-1}``, and gives the router one place to resolve health-hub
+event sources back to replica names.
+
+Preemption composition (the tentpole contract): the pool registers one
+:func:`~libskylark_tpu.resilience.on_preemption` hook, so a
+process-wide SIGTERM — which drains every in-process executor via the
+r9 handler — also runs every replica's registered drain hooks (final
+per-replica checkpoints) exactly once. A *single* replica can be
+preempted without touching the rest via :meth:`preempt_replica`:
+thread replicas drain in place (there is no thread-scoped SIGTERM);
+process replicas get a real SIGTERM. Either way the replica's drain
+hooks fire, its in-flight futures resolve, and the health hub
+announces DRAINING → STOPPED so a subscribed router sheds its traffic
+to peers mid-drain.
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+from typing import Callable, Dict, List, Optional
+
+from libskylark_tpu.engine import bucket as bucketing
+from libskylark_tpu.fleet.replica import (ProcessReplica, Replica,
+                                          ThreadReplica)
+from libskylark_tpu.resilience import preemption as _preemption
+
+
+class ReplicaPool:
+    """N uniform replicas behind names (``r0``..``r{N-1}``).
+
+    ::
+
+        pool = fleet.ReplicaPool(4, max_batch=16, linger_us=2000)
+        router = fleet.Router(pool)
+        ...
+        pool.shutdown()
+
+    ``backend`` is ``"thread"`` (default) or ``"process"``; remaining
+    keyword arguments are passed to every replica's
+    ``MicrobatchExecutor`` (process replicas additionally accept
+    ``coordinator=`` — multi-host kwargs forwarded to
+    ``parallel.multihost.initialize_distributed`` in the child).
+
+    ``shared_workers`` (thread backend only) sizes flush concurrency
+    to the HOST instead of to N: the pool owns one dispatch queue and
+    that many flush worker threads, and every replica enqueues its
+    cohorts there (``MicrobatchExecutor(dispatch_queue=...)``). N
+    replicas each running their own workers oversubscribe a small
+    host — N concurrent flushes thrash more cores than exist — while
+    a host-sized shared pool keeps the fleet's flush concurrency
+    equal to a well-tuned single executor's (docs/fleet, "Tuning N").
+    """
+
+    def __init__(self, n: int = 2, *, backend: str = "thread",
+                 names: Optional[List[str]] = None, coordinator=None,
+                 shared_workers: Optional[int] = None,
+                 **executor_kwargs):
+        if n < 1:
+            raise ValueError("a fleet needs at least one replica")
+        if backend not in ("thread", "process"):
+            raise ValueError(
+                f"backend must be 'thread' or 'process', got {backend!r}")
+        names = list(names) if names else [f"r{i}" for i in range(n)]
+        if len(names) != n or len(set(names)) != n:
+            raise ValueError(f"need {n} distinct replica names, "
+                             f"got {names!r}")
+        self.backend = backend
+        self.executor_kwargs = dict(executor_kwargs)
+        self.pad_floor = int(executor_kwargs.get(
+            "pad_floor", bucketing.PAD_FLOOR))
+        self.max_batch = int(executor_kwargs.get("max_batch", 8))
+        self._lock = threading.Lock()
+        self._drain_hooks: Dict[str, list] = {name: [] for name in names}
+        self._drained: set = set()
+        self._replicas: Dict[str, Replica] = {}
+        self._dispatchq = None
+        self._dispatchers: list = []
+        if shared_workers is not None:
+            if backend != "thread":
+                raise ValueError(
+                    "shared_workers applies to thread replicas only "
+                    "(process replicas have their own interpreters)")
+            import queue as _queue
+
+            from libskylark_tpu.engine.serve import dispatch_loop
+
+            self._dispatchq = _queue.Queue()
+            self._dispatchers = [
+                threading.Thread(target=dispatch_loop,
+                                 args=(self._dispatchq,),
+                                 name=f"skylark-fleet-dispatch-{i}",
+                                 daemon=True)
+                for i in range(max(int(shared_workers), 1))
+            ]
+            for t in self._dispatchers:
+                t.start()
+            executor_kwargs = dict(executor_kwargs,
+                                   dispatch_queue=self._dispatchq)
+        try:
+            for name in names:
+                if backend == "thread":
+                    self._replicas[name] = ThreadReplica(
+                        name, **executor_kwargs)
+                else:
+                    self._replicas[name] = ProcessReplica(
+                        name, coordinator=coordinator, **executor_kwargs)
+        except Exception:
+            for r in self._replicas.values():
+                r.shutdown()
+            self._stop_dispatchers()
+            raise
+        # process-wide preemption (SIGTERM to THIS process): the r9
+        # handler drains the executors; this hook runs after the drain
+        # (hook order: drain_serving first) so the per-replica final
+        # checkpoints see quiesced replicas
+        self._unhook = _preemption.on_preemption(self._run_all_drain_hooks)
+
+    # -- addressing ----------------------------------------------------
+
+    def names(self) -> List[str]:
+        return sorted(self._replicas)
+
+    def replicas(self) -> List[Replica]:
+        return [self._replicas[n] for n in self.names()]
+
+    def get(self, name: str) -> Replica:
+        return self._replicas[name]
+
+    def resolve_source(self, source: object) -> Optional[str]:
+        """Map a health-hub event source (an executor for thread
+        replicas, the replica object for process replicas) to its
+        replica name; ``None`` for sources outside this pool."""
+        for name, r in self._replicas.items():
+            if r.owns_source(source):
+                return name
+        return None
+
+    # -- traffic helpers -----------------------------------------------
+
+    def flush(self) -> None:
+        """Synchronously flush every replica, in name order (tests and
+        deterministic chaos storms; normal traffic never needs it)."""
+        for name in self.names():
+            self._replicas[name].flush()
+
+    def stats(self) -> dict:
+        return {name: self._replicas[name].stats()
+                for name in self.names()}
+
+    # -- per-replica preemption ----------------------------------------
+
+    def on_replica_drain(self, name: str,
+                         hook: Callable[[], None]) -> Callable[[], None]:
+        """Register a final-drain hook for one replica (its "final
+        checkpoint"); runs exactly once, whether the replica is
+        preempted alone (:meth:`preempt_replica`) or the whole process
+        is SIGTERM'd. Returns the unregister callable."""
+        with self._lock:
+            self._drain_hooks[name].append(hook)
+
+        def unregister() -> None:
+            with self._lock:
+                try:
+                    self._drain_hooks[name].remove(hook)
+                except (KeyError, ValueError):
+                    pass
+
+        return unregister
+
+    def _run_drain_hooks(self, name: str) -> None:
+        with self._lock:
+            if name in self._drained:
+                return
+            self._drained.add(name)
+            hooks = list(self._drain_hooks.get(name, ()))
+        for hook in hooks:
+            try:
+                hook()
+            except Exception as e:  # noqa: BLE001 — contain, like r9
+                warnings.warn(
+                    f"replica {name!r} drain hook {hook!r} failed: {e}",
+                    RuntimeWarning, stacklevel=2)
+
+    def _run_all_drain_hooks(self) -> None:
+        for name in self.names():
+            self._run_drain_hooks(name)
+
+    def preempt_replica(self, name: str,
+                        timeout: Optional[float] = 30.0) -> bool:
+        """Preempt ONE replica: drain it (intake refused — the health
+        hub announces DRAINING, a subscribed router sheds its traffic
+        to peers — queued cohorts flush, in-flight futures resolve),
+        then fire its drain hooks. Process replicas get a real SIGTERM
+        (the child's own preemption handler does the draining);
+        thread replicas drain in place. Returns whether quiescence was
+        reached inside ``timeout``."""
+        replica = self._replicas[name]
+        if isinstance(replica, ProcessReplica):
+            replica.preempt()
+            # the child's handler drains asynchronously; wait for its
+            # STOPPED announcement by polling the cached state
+            import time as _time
+
+            deadline = _time.monotonic() + (timeout or 30.0)
+            while (replica.state() != "STOPPED"
+                   and _time.monotonic() < deadline):
+                _time.sleep(0.05)
+            drained = replica.state() == "STOPPED"
+        else:
+            drained = replica.drain(timeout=timeout)
+        self._run_drain_hooks(name)
+        return drained
+
+    def drain_replica(self, name: str,
+                      timeout: Optional[float] = 30.0) -> bool:
+        """Drain one replica without the preemption framing (no drain
+        hooks) — administrative removal, e.g. before a resize."""
+        return self._replicas[name].drain(timeout=timeout)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _stop_dispatchers(self) -> None:
+        for _ in self._dispatchers:
+            self._dispatchq.put(None)     # FIFO: queued cohorts first
+        for t in self._dispatchers:
+            t.join(timeout=30.0)
+        self._dispatchers = []
+
+    def shutdown(self) -> None:
+        self._unhook()
+        for r in self.replicas():
+            try:
+                r.shutdown()
+            except Exception as e:  # noqa: BLE001 — stop the rest too
+                warnings.warn(f"replica {r.name!r} shutdown failed: {e}",
+                              RuntimeWarning, stacklevel=2)
+        if self._dispatchers:
+            self._stop_dispatchers()
+
+    def __enter__(self) -> "ReplicaPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+
+__all__ = ["ReplicaPool"]

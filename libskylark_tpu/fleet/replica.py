@@ -1,0 +1,382 @@
+"""Fleet replicas: one submit surface over thread- or process-backed
+microbatch executors.
+
+A *replica* is one unit of serving capacity the router can address:
+it has a name (its ring identity and telemetry label), a
+future-returning ``submit`` mirroring
+:meth:`~libskylark_tpu.engine.serve.MicrobatchExecutor.submit`, a live
+queue-depth signal, the r9 health states, and the drain lifecycle.
+
+Two backings:
+
+- :class:`ThreadReplica` — an in-process
+  :class:`~libskylark_tpu.engine.serve.MicrobatchExecutor` (the
+  default). Cheapest possible hop (the router calls straight into the
+  executor), shares the process executable cache, and health
+  transitions reach the resilience hub directly.
+- :class:`ProcessReplica` — a spawned child process hosting its own
+  executor behind a pickle pipe. The child is a real OS-level
+  preemption domain: it installs
+  :func:`~libskylark_tpu.resilience.install_preemption_handler`, so a
+  SIGTERM *to the child alone* drains its executor (in-flight futures
+  resolve and their results still flow back over the pipe) while the
+  parent-side router sheds new traffic to peers — the per-replica
+  preemption story a thread cannot give. Multi-host placement rides
+  the existing :mod:`libskylark_tpu.parallel.multihost` plumbing: pass
+  ``coordinator`` kwargs and the child joins the distributed pool via
+  ``initialize_distributed`` before serving. Spawn (not fork): a
+  forked child would inherit jax's initialized backend and the parent's
+  locked thread state.
+
+Process-replica protocol (one duplex pipe, length-tagged tuples):
+parent → child: ``("submit", rid, endpoint, kwargs)`` /
+``("stats"|"depth"|"flush", rid)`` / ``("drain", rid, timeout)`` /
+``("shutdown", rid)``; child → parent: ``("result", rid, value)`` /
+``("error", rid, exception)`` / ``("rpc", rid, value)`` /
+``("state", None, new_state)`` — the last forwarded from the child's
+health hub so the parent's hub (and any subscribed router) sees the
+child's transitions with the :class:`ProcessReplica` as the source.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import signal
+import threading
+import warnings
+from concurrent.futures import Future
+from typing import Optional
+
+from libskylark_tpu.engine.serve import ServeOverloadedError
+
+
+class Replica:
+    """The surface the router programs against (see module doc)."""
+
+    name: str
+
+    def submit(self, endpoint: str, /, **kwargs) -> Future:
+        raise NotImplementedError
+
+    def queue_depth(self) -> int:
+        raise NotImplementedError
+
+    def state(self) -> str:
+        raise NotImplementedError
+
+    def stats(self) -> dict:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        raise NotImplementedError
+
+    def drain(self, timeout: Optional[float] = 30.0) -> bool:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        raise NotImplementedError
+
+
+class ThreadReplica(Replica):
+    """In-process replica: a named ``MicrobatchExecutor`` plus the
+    thin identity layer the router needs."""
+
+    backend = "thread"
+
+    def __init__(self, name: str, **executor_kwargs):
+        from libskylark_tpu import engine
+
+        self.name = str(name)
+        self.executor = engine.MicrobatchExecutor(name=self.name,
+                                                  **executor_kwargs)
+
+    def submit(self, endpoint: str, /, **kwargs) -> Future:
+        return self.executor.submit(endpoint, **kwargs)
+
+    def queue_depth(self) -> int:
+        return self.executor.queue_depth()
+
+    def state(self) -> str:
+        return self.executor.state
+
+    def stats(self) -> dict:
+        return self.executor.stats()
+
+    def flush(self) -> None:
+        self.executor.flush()
+
+    def drain(self, timeout: Optional[float] = 30.0) -> bool:
+        return self.executor.drain(timeout=timeout)
+
+    def shutdown(self) -> None:
+        self.executor.shutdown()
+
+    def owns_source(self, source: object) -> bool:
+        """Whether a health-hub event source is this replica (the
+        executor publishes for thread replicas)."""
+        return source is self.executor or source is self
+
+
+# ---------------------------------------------------------------------------
+# process-backed replica
+# ---------------------------------------------------------------------------
+
+
+def _send_exception(send, rid, e: BaseException) -> None:
+    try:
+        send(("error", rid, e))
+    except Exception:  # unpicklable exception: degrade to its repr
+        send(("error", rid, RuntimeError(repr(e))))
+
+
+def _worker_main(conn, name: str, executor_kwargs: dict,
+                 coordinator: Optional[dict]) -> None:
+    """Child entry point (module-level: spawn pickles it by name)."""
+    # the child honors the parent's platform pin the same way the
+    # benchmarks do (env rides across spawn; sitecustomize may have
+    # pre-imported jax with another platform)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from libskylark_tpu import engine, resilience
+    from libskylark_tpu.resilience import health as _health
+
+    if coordinator:
+        # multi-host placement: the replica process joins the jax
+        # distributed pool through the same multihost plumbing every
+        # sharded code path uses (docs/distributed)
+        from libskylark_tpu.parallel import multihost
+
+        multihost.initialize_distributed(**coordinator)
+
+    # SIGTERM → drain this executor + final checkpoint hooks, exactly
+    # the in-process preemption contract, scoped to this replica
+    resilience.install_preemption_handler()
+    ex = engine.MicrobatchExecutor(name=name, **executor_kwargs)
+
+    send_lock = threading.Lock()
+
+    def send(msg) -> None:
+        with send_lock:
+            conn.send(msg)
+
+    def forward_state(source, old, new) -> None:
+        if source is ex:
+            try:
+                send(("state", None, new))
+            except Exception:  # parent gone mid-teardown
+                pass
+
+    _health.subscribe(forward_state)
+
+    def reply(rid, fut: Future) -> None:
+        try:
+            send(("result", rid, fut.result()))
+        except BaseException as e:  # noqa: BLE001 — future's exception
+            _send_exception(send, rid, e)
+
+    import functools
+
+    while True:
+        try:
+            if not conn.poll(0.1):
+                if (resilience.preemption_requested()
+                        and resilience.wait_for_preemption_teardown(0.0)):
+                    break            # drained by SIGTERM; parent's
+                #                      reader sees our STOPPED event
+                continue
+            msg = conn.recv()
+        except (EOFError, OSError):
+            break
+        kind, rid = msg[0], msg[1]
+        try:
+            if kind == "submit":
+                endpoint, kwargs = msg[2], msg[3]
+                fut = ex.submit(endpoint, **kwargs)
+                fut.add_done_callback(functools.partial(reply, rid))
+            elif kind == "stats":
+                send(("rpc", rid, ex.stats()))
+            elif kind == "depth":
+                send(("rpc", rid, ex.queue_depth()))
+            elif kind == "flush":
+                ex.flush()
+                send(("rpc", rid, True))
+            elif kind == "drain":
+                send(("rpc", rid, ex.drain(timeout=msg[2])))
+            elif kind == "shutdown":
+                ex.shutdown()
+                send(("rpc", rid, True))
+                break
+        except Exception as e:  # noqa: BLE001 — per-message containment
+            _send_exception(send, rid, e)
+    try:
+        ex.shutdown()
+    except Exception:
+        pass
+    conn.close()
+
+
+class ProcessReplica(Replica):
+    """A replica in its own spawned process (see module doc). Slow to
+    boot (a fresh jax import per child) but a true preemption domain:
+    :meth:`preempt` delivers a real SIGTERM."""
+
+    backend = "process"
+
+    def __init__(self, name: str, coordinator: Optional[dict] = None,
+                 start_timeout: float = 120.0, **executor_kwargs):
+        import multiprocessing as mp
+
+        self.name = str(name)
+        ctx = mp.get_context("spawn")
+        self._conn, child_conn = ctx.Pipe(duplex=True)
+        self._proc = ctx.Process(
+            target=_worker_main,
+            args=(child_conn, self.name, dict(executor_kwargs),
+                  coordinator),
+            name=f"skylark-replica-{self.name}", daemon=True)
+        self._proc.start()
+        child_conn.close()
+        self._lock = threading.Lock()          # send + bookkeeping
+        self._rids = itertools.count()
+        self._futures: "dict[int, Future]" = {}
+        self._state = "SERVING"
+        self._closed = False
+        self._reader = threading.Thread(
+            target=self._reader_loop,
+            name=f"skylark-replica-{self.name}-reader", daemon=True)
+        self._reader.start()
+        # prove liveness before the router ever trusts this replica: a
+        # stats roundtrip forces the child through import + executor
+        # construction (or surfaces its crash now, not mid-traffic)
+        if self._rpc("stats", timeout=start_timeout) is None:
+            self.shutdown()
+            raise ServeOverloadedError(
+                f"process replica {self.name!r} failed to come up "
+                f"within {start_timeout}s")
+
+    # -- child → parent ------------------------------------------------
+
+    def _reader_loop(self) -> None:
+        from libskylark_tpu.resilience import health as _health
+
+        while True:
+            try:
+                msg = self._conn.recv()
+            except (EOFError, OSError):
+                break
+            kind, rid, payload = msg[0], msg[1], msg[2]
+            if kind == "state":
+                old, self._state = self._state, payload
+                _health.publish(self, old, payload)
+                continue
+            with self._lock:
+                fut = self._futures.pop(rid, None)
+            if fut is None:
+                continue
+            if kind == "error":
+                fut.set_exception(payload)
+            else:                      # "result" / "rpc"
+                fut.set_result(payload)
+        # child gone: nothing pending can ever resolve
+        with self._lock:
+            dead = list(self._futures.values())
+            self._futures.clear()
+        for fut in dead:
+            if not fut.done():
+                fut.set_exception(ServeOverloadedError(
+                    f"replica process {self.name!r} exited with "
+                    f"requests in flight"))
+        if self._state not in ("STOPPED",):
+            old, self._state = self._state, "STOPPED"
+            _health.publish(self, old, "STOPPED")
+
+    # -- parent → child ------------------------------------------------
+
+    def _send(self, kind: str, *payload) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            if self._closed or not self._proc.is_alive():
+                raise ServeOverloadedError(
+                    f"replica process {self.name!r} is not serving")
+            rid = next(self._rids)
+            self._futures[rid] = fut
+            try:
+                self._conn.send((kind, rid) + payload)
+            except (OSError, ValueError) as e:
+                self._futures.pop(rid, None)
+                raise ServeOverloadedError(
+                    f"replica process {self.name!r} pipe closed") from e
+        return fut
+
+    def _rpc(self, kind: str, *payload, timeout: float = 30.0):
+        try:
+            return self._send(kind, *payload).result(timeout=timeout)
+        except Exception:  # noqa: BLE001 — callers treat None as down
+            return None
+
+    def submit(self, endpoint: str, /, **kwargs) -> Future:
+        # the router's predigested derivation is an in-process
+        # optimization; over the pipe it would pickle the operands
+        # twice — the child re-derives instead
+        kwargs.pop("_derived", None)
+        return self._send("submit", endpoint, kwargs)
+
+    def queue_depth(self) -> int:
+        # outstanding submits the parent knows about — no pipe
+        # roundtrip on the routing hot path
+        with self._lock:
+            return len(self._futures)
+
+    def state(self) -> str:
+        return self._state
+
+    def stats(self) -> dict:
+        return self._rpc("stats") or {}
+
+    def flush(self) -> None:
+        self._rpc("flush")
+
+    def drain(self, timeout: Optional[float] = 30.0) -> bool:
+        ok = self._rpc("drain", timeout,
+                       timeout=(timeout or 30.0) + 10.0)
+        return bool(ok)
+
+    def preempt(self) -> None:
+        """Deliver a real SIGTERM to the replica process — the child's
+        preemption handler drains its executor (in-flight results
+        still come back) and runs its checkpoint hooks."""
+        if self._proc.is_alive():
+            os.kill(self._proc.pid, signal.SIGTERM)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        try:
+            if self._proc.is_alive():
+                try:
+                    self._conn.send(("shutdown", next(self._rids)))
+                except (OSError, ValueError):
+                    pass
+            self._proc.join(timeout=30.0)
+            if self._proc.is_alive():  # wedged child: don't leak it
+                warnings.warn(
+                    f"replica process {self.name!r} did not exit; "
+                    "terminating", RuntimeWarning, stacklevel=2)
+                self._proc.terminate()
+                self._proc.join(timeout=5.0)
+        finally:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+
+    def owns_source(self, source: object) -> bool:
+        return source is self
+
+
+__all__ = ["ProcessReplica", "Replica", "ThreadReplica"]

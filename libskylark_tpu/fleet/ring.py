@@ -1,0 +1,128 @@
+"""Consistent-hash ring: the fleet router's sticky-affinity structure.
+
+Affinity routing exists because the microbatch executor's performance
+model is *per-replica* warm executable caches: after one warmup flush,
+a (bucket, capacity) class serves zero-compile forever — but only on
+the replica that compiled it. A round-robin router would spray one
+bucket class across every replica and pay N warmup compiles per class
+(plus N× the executable-cache pressure); a consistent-hash ring pins
+each class to one owner, so the fleet's aggregate compile count equals
+a single executor's.
+
+The ring is the classic construction: every member contributes
+``vnodes`` virtual points at ``blake2b(f"{member}#{i}")``; a key hashes
+to a point and is owned by the first member clockwise. Properties the
+router relies on:
+
+- **determinism across processes**: blake2b of the key's ``repr`` —
+  bucket statics are tuples of primitives with stable reprs — so two
+  router instances (or a router restarted after preemption) agree on
+  ownership without coordination, and the chaos battery can replay
+  routing decisions bit-identically;
+- **minimal disruption**: removing a member (a DRAINING replica) only
+  re-owns the keys it held — every other bucket class keeps its warm
+  replica;
+- **preference order**: :meth:`preference` yields *all* members in
+  ring order from the key's point — the router's failover sequence,
+  so retries after an injected route fault or a mid-submit drain land
+  on a deterministic next candidate.
+
+Members are plain strings (replica names); the ring never touches the
+replicas themselves.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+from typing import Iterable, Iterator, List, Tuple
+
+
+def ring_hash(data: str) -> int:
+    """64-bit stable hash (NOT Python's randomized ``hash``): ring
+    positions must agree across processes and runs."""
+    return int.from_bytes(
+        hashlib.blake2b(data.encode("utf-8"), digest_size=8).digest(),
+        "big")
+
+
+def key_point(key: object) -> int:
+    """Ring position of a routing key (bucket statics tuples have
+    stable reprs; see ``engine.request_statics``)."""
+    return ring_hash(repr(key))
+
+
+class HashRing:
+    """Thread-safe consistent-hash ring over named members."""
+
+    def __init__(self, members: Iterable[str] = (), vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        self._vnodes = int(vnodes)
+        self._lock = threading.Lock()
+        self._members: set = set()
+        self._points: List[Tuple[int, str]] = []   # sorted (point, member)
+        for m in members:
+            self.add(m)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._members)
+
+    def __contains__(self, member: str) -> bool:
+        with self._lock:
+            return member in self._members
+
+    def members(self) -> set:
+        with self._lock:
+            return set(self._members)
+
+    def add(self, member: str) -> None:
+        """Idempotent; a re-added member lands on its original points
+        (vnode hashes depend only on the name)."""
+        member = str(member)
+        with self._lock:
+            if member in self._members:
+                return
+            self._members.add(member)
+            for i in range(self._vnodes):
+                bisect.insort(self._points,
+                              (ring_hash(f"{member}#{i}"), member))
+
+    def remove(self, member: str) -> None:
+        """Idempotent removal (a DRAINING replica leaves the ring)."""
+        with self._lock:
+            if member not in self._members:
+                return
+            self._members.discard(member)
+            self._points = [p for p in self._points if p[1] != member]
+
+    def owner(self, key: object) -> str:
+        """The member owning ``key`` (first point clockwise).
+        Raises :class:`LookupError` on an empty ring."""
+        for m in self.preference(key):
+            return m
+        raise LookupError("hash ring is empty")
+
+    def preference(self, key: object) -> Iterator[str]:
+        """Every member once, in ring order starting at ``key``'s
+        point — the owner first, then the deterministic failover
+        sequence."""
+        with self._lock:
+            points = list(self._points)
+            n_members = len(self._members)
+        if not points:
+            return
+        start = bisect.bisect_left(points, (key_point(key), ""))
+        seen: set = set()
+        for i in range(len(points)):
+            member = points[(start + i) % len(points)][1]
+            if member not in seen:
+                seen.add(member)
+                yield member
+                if len(seen) == n_members:
+                    return
+
+
+__all__ = ["HashRing", "key_point", "ring_hash"]

@@ -1,0 +1,441 @@
+"""Warm-cache-aware router: the fleet's front door.
+
+``Router.submit`` mirrors the single-executor API (`submit_sketch` /
+`submit_solve` / `submit_krr_predict` return the same futures) but
+picks a replica per request from three live signals:
+
+1. **Sticky bucket affinity.** The request's engine-level bucket
+   statics (:func:`libskylark_tpu.engine.request_statics` — the exact
+   tuple the executor keys its batched executables on) consistent-hash
+   onto the replica ring (:mod:`libskylark_tpu.fleet.ring`) under a
+   *bounded-load* ownership rule (first preference-order replica
+   owning fewer than ``ceil(classes/replicas)`` classes — plain
+   consistent hashing strands replicas when the live class population
+   is small), so every request of a class lands on the one replica
+   whose executable cache is already warm for it. The fleet compiles
+   each (bucket, capacity) class once *total*, not once per replica —
+   and affinity also keeps cohorts dense: requests that can coalesce
+   meet in one queue instead of fragmenting into N half-empty flushes.
+2. **Live load.** The affinity owner is checked against its queue
+   depth (the per-replica ``queued`` signal telemetry exports); past
+   ``spill_threshold`` the router spills to the least-loaded healthy
+   peer — a deliberate affinity miss (counted) that trades one warmup
+   compile for not queueing behind a hot spot.
+3. **Health.** The router *subscribes* to the resilience health hub
+   (:mod:`libskylark_tpu.resilience.health`): a DEGRADED replica is
+   deprioritized (routed to only when every healthy peer is gone), a
+   DRAINING/STOPPED one leaves the ring immediately — its in-flight
+   futures still resolve (the drain flushes them) while new traffic
+   sheds to peers. No polling: the DRAINING announcement arrives from
+   the draining thread before the queue empties.
+
+Failover: each candidate dispatch is wrapped — a replica that refuses
+(load shed, drain race, pipe loss) or an injected ``fleet.route``
+fault (:mod:`libskylark_tpu.resilience.faults`) moves the request to
+the next replica in deterministic ring preference order. A SIGTERM'd
+replica therefore costs zero client-visible failures: queued work
+drains, new work fails over (``bench.py --fleet`` records it; the
+chaos battery replays it under a fixed seed).
+
+Telemetry: ``fleet.routed`` / ``fleet.affinity_hit`` /
+``fleet.failover`` / ``fleet.spilled`` counters (labeled per replica),
+a ``fleet.route`` span parented over the executor's ``serve.submit``
+span (same request id), and a ``fleet`` collector block in
+``telemetry.snapshot()`` aggregating every live router.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import weakref
+from concurrent.futures import Future
+from typing import Iterable, Optional
+
+from libskylark_tpu import telemetry as _telemetry
+from libskylark_tpu.engine import serve as _serve
+from libskylark_tpu.fleet.pool import ReplicaPool
+from libskylark_tpu.fleet.ring import HashRing
+from libskylark_tpu.resilience import faults
+from libskylark_tpu.resilience import health as _health
+from libskylark_tpu.telemetry import metrics as _metrics
+from libskylark_tpu.telemetry import trace as _trace
+
+# live (enablement-gated) instruments for scrape-time visibility; the
+# always-on rollup is the "fleet" collector below (docs/observability)
+_ROUTED = _metrics.counter(
+    "fleet.routed", "Requests routed, by replica and affinity outcome")
+_AFFINITY_HIT = _metrics.counter(
+    "fleet.affinity_hit", "Requests landing on their ring owner")
+_FAILOVER = _metrics.counter(
+    "fleet.failover", "Route failovers, by refusing replica")
+_SPILLED = _metrics.counter(
+    "fleet.spilled", "Load spills away from a saturated ring owner")
+
+
+class NoHealthyReplicaError(_serve.ServeOverloadedError):
+    """Every replica refused the request (all draining/stopped, or the
+    whole preference order failed over). A ``ServeOverloadedError``
+    subclass so single-executor retry handling keeps working against a
+    fleet."""
+
+
+class Router:
+    """Front-door router over a :class:`ReplicaPool` (see module doc).
+
+    ::
+
+        pool = fleet.ReplicaPool(4, max_batch=16)
+        router = fleet.Router(pool)
+        fut = router.submit_sketch(transform, A)
+        ...
+        router.close(); pool.shutdown()
+
+    ``spill_threshold`` (requests queued on the affinity owner before
+    the router spills to the least-loaded peer) defaults to
+    ``4 * max_batch`` — a full cohort plus headroom, so microbatches
+    still fill before load-balancing fragments them.
+    """
+
+    def __init__(self, pool: ReplicaPool, *, vnodes: int = 64,
+                 spill_threshold: Optional[int] = None):
+        self._pool = pool
+        self._ring = HashRing(pool.names(), vnodes=vnodes)
+        self.spill_threshold = int(
+            spill_threshold if spill_threshold is not None
+            else 4 * pool.max_batch)
+        self._lock = threading.Lock()
+        self._degraded: set = set()
+        self._removed: set = set()
+        self._counts = collections.Counter()
+        self._by_replica = collections.Counter()
+        # bounded-load ownership (consistent hashing with bounded
+        # loads): a key's owner is the FIRST replica in its ring
+        # preference order owning fewer than ceil(keys/replicas)
+        # distinct keys. Plain ownership strands whole replicas when
+        # the key population is small (four bucket classes over four
+        # replicas leave one idle with high probability); the bound
+        # spreads classes evenly while keeping assignment sticky and
+        # deterministic for a fixed arrival order. The map doubles as
+        # the routing fast path (one dict hit instead of a ring walk);
+        # it clears when membership changes (epoch bump) — keys then
+        # reassign, mostly back onto their surviving owners.
+        self._epoch = 0
+        self._assign: dict = {}        # statics -> (epoch, owner name)
+        self._owned = collections.Counter()
+        # seed the view from the replicas' CURRENT states: a router
+        # built after a replica started draining must not route to it
+        for name in pool.names():
+            state = pool.get(name).state()
+            if state in (_serve.DRAINING, _serve.STOPPED):
+                self._ring.remove(name)
+                self._removed.add(name)
+            elif state == _serve.DEGRADED:
+                self._degraded.add(name)
+        # subscribe via a weak method: a router dropped without
+        # close() must not be pinned alive by the hub (which would
+        # also keep its _ROUTERS entry — and so its counters in every
+        # fleet_stats() snapshot — forever); the shim unsubscribes
+        # itself on the first publish after collection
+        wm = weakref.WeakMethod(self._on_state)
+        unsub_cell: list = []
+
+        def _dispatch(source, old, new):
+            fn = wm()
+            if fn is None:
+                if unsub_cell:
+                    unsub_cell[0]()
+                return
+            fn(source, old, new)
+
+        self._unsub = _health.subscribe(_dispatch)
+        unsub_cell.append(self._unsub)
+        _ROUTERS.add(self)
+
+    # -- health subscription -------------------------------------------
+
+    def _on_state(self, source, old: str, new: str) -> None:
+        name = self._pool.resolve_source(source)
+        if name is None:
+            return                     # some other pool's executor
+        with self._lock:
+            if new in (_serve.DRAINING, _serve.STOPPED):
+                if name in self._ring:
+                    self._ring.remove(name)
+                    # membership changed: every sticky assignment is
+                    # re-derived against the surviving ring
+                    self._epoch += 1
+                    self._assign.clear()
+                    self._owned.clear()
+                self._removed.add(name)
+                self._degraded.discard(name)
+            elif new == _serve.DEGRADED:
+                self._degraded.add(name)
+            elif new == _serve.SERVING:
+                self._degraded.discard(name)
+
+    def _affinity_owner(self, statics: tuple,
+                        record: bool = True) -> Optional[str]:
+        """Sticky bounded-load owner of a bucket class (see
+        ``__init__``); ``None`` on an empty ring. Assignment is lazy
+        and cached per statics tuple — the routing fast path. With
+        ``record=False`` the derivation is read-only: no sticky
+        assignment is stored and no ownership is charged, so
+        introspection (``owner_of``) can never perturb where real
+        traffic lands."""
+        with self._lock:
+            hit = self._assign.get(statics)
+            if hit is not None and hit[0] == self._epoch:
+                return hit[1]
+            n_members = len(self._ring)
+            if n_members == 0:
+                return None
+            cap = -(-(len(self._assign) + 1) // n_members)  # ceil
+            owner = None
+            for name in self._ring.preference(statics):
+                if owner is None:
+                    owner = name           # unbounded fallback
+                if self._owned[name] < cap:
+                    owner = name
+                    break
+            if record:
+                self._assign[statics] = (self._epoch, owner)
+                self._owned[owner] += 1
+            return owner
+
+    # -- routing -------------------------------------------------------
+
+    def _candidates(self, statics: tuple) -> tuple:
+        """(ordered candidate names, affinity owner, spilled?). The
+        bounded-load owner leads; the rest follow in ring preference
+        order with DEGRADED members demoted to the tail (still
+        candidates — a degraded replica beats a refused request);
+        under owner saturation the least-loaded healthy peer is
+        promoted to the front (a counted spill)."""
+        owner = self._affinity_owner(statics)
+        if owner is None:
+            return (), None, False
+        pref = [owner] + [n for n in self._ring.preference(statics)
+                          if n != owner]
+        with self._lock:
+            degraded = set(self._degraded)
+        healthy = [n for n in pref if n not in degraded]
+        order = healthy + [n for n in pref if n in degraded]
+        spilled = False
+        if len(healthy) > 1 and order and order[0] == owner:
+            depth = self._pool.get(owner).queue_depth()
+            if depth >= self.spill_threshold:
+                peers = [(self._pool.get(n).queue_depth(), n)
+                         for n in healthy[1:]]
+                best_depth, best = min(peers)
+                if best_depth < depth:
+                    order.remove(best)
+                    order.insert(0, best)
+                    spilled = True
+        return tuple(order), owner, spilled
+
+    def submit(self, endpoint: str, /, **kwargs) -> Future:
+        """Route one request; returns the chosen replica's future.
+        Accepts exactly the executor ``submit`` kwargs (operands plus
+        ``timeout`` / ``deadline`` / ``request_id``)."""
+        derived = _serve.derive_request(
+            endpoint, pad_floor=self._pool.pad_floor,
+            **{k: v for k, v in kwargs.items()
+               if k not in ("timeout",)})
+        statics = derived[0]
+        # the chosen replica reuses this derivation (one prep per
+        # routed request); replicas with a different pad_floor would
+        # re-derive, but the pool keeps the fleet uniform
+        kwargs["_derived"] = derived
+        rid = kwargs.get("request_id")
+        if rid is None and _telemetry.enabled():
+            rid = kwargs["request_id"] = _trace.new_request_id()
+        # the route span is the request's ROOT: the executor's
+        # serve.submit span opens inside it (same thread) and parents
+        # under it with the same request id — docs/observability
+        with _trace.span("fleet.route", attrs={"endpoint": endpoint},
+                         request_id=rid) as sp:
+            tags = faults.current_tags()
+            # fast path: a healthy, unsaturated owner takes the
+            # request without materializing the failover order (the
+            # submit hot path — the full candidate walk only runs on
+            # refusal, saturation, or a degraded owner)
+            owner = self._affinity_owner(statics)
+            if owner is not None and owner not in self._degraded:
+                if (self._pool.get(owner).queue_depth()
+                        < self.spill_threshold):
+                    try:
+                        faults.check("fleet.route", tags=tags,
+                                     detail=f"{endpoint} -> {owner}")
+                        fut = self._pool.get(owner).submit(endpoint,
+                                                           **kwargs)
+                    except (KeyboardInterrupt, SystemExit):
+                        raise
+                    except BaseException as e:  # noqa: BLE001
+                        with self._lock:
+                            self._counts["failover"] += 1
+                        _FAILOVER.inc(replica=owner)
+                        if sp is not None:
+                            sp.add_event("failover",
+                                         {"replica": owner,
+                                          "error": repr(e)})
+                        return self._submit_slow(
+                            endpoint, kwargs, statics, owner, sp,
+                            tags, skip=owner, last_err=e)
+                    self._account(owner, owner, False, sp)
+                    return fut
+            return self._submit_slow(endpoint, kwargs, statics, owner,
+                                     sp, tags)
+
+    def _account(self, name: str, owner: Optional[str], spilled: bool,
+                 sp) -> None:
+        hit = name == owner
+        with self._lock:
+            self._counts["routed"] += 1
+            self._counts["affinity_hit"] += hit
+            self._counts["spilled"] += spilled
+            self._by_replica[name] += 1
+        _ROUTED.inc(replica=name, affinity=str(hit).lower())
+        if hit:
+            _AFFINITY_HIT.inc(replica=name)
+        if spilled:
+            _SPILLED.inc(replica=name)
+        if sp is not None:
+            sp.set_attr("replica", name)
+            sp.set_attr("affinity_hit", hit)
+
+    def _submit_slow(self, endpoint: str, kwargs: dict, statics: tuple,
+                     owner: Optional[str], sp, tags,
+                     skip: Optional[str] = None,
+                     last_err: Optional[BaseException] = None) -> Future:
+        """The full candidate walk: failover order, degraded demotion,
+        load spill (see :meth:`_candidates`). ``skip`` is a candidate
+        the fast path already tried (and counted as a failover)."""
+        order, owner, spilled = self._candidates(statics)
+        for name in order:
+            if name == skip:
+                continue
+            try:
+                # chaos seam: per route ATTEMPT, so a fault plan can
+                # fail the owner and replay the failover
+                faults.check("fleet.route", tags=tags,
+                             detail=f"{endpoint} -> {name}")
+                fut = self._pool.get(name).submit(endpoint, **kwargs)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — failover
+                last_err = e
+                with self._lock:
+                    self._counts["failover"] += 1
+                _FAILOVER.inc(replica=name)
+                if sp is not None:
+                    sp.add_event("failover", {"replica": name,
+                                              "error": repr(e)})
+                continue
+            self._account(name, owner, spilled, sp)
+            return fut
+        raise NoHealthyReplicaError(
+            f"no replica accepted {endpoint!r}: tried "
+            f"{list(order) or 'none (empty ring)'}"
+        ) from last_err
+
+    # executor-mirroring conveniences
+
+    def submit_sketch(self, transform, A, dimension=None, **kw) -> Future:
+        return self.submit("sketch_apply", transform=transform, A=A,
+                           dimension=dimension, **kw)
+
+    def submit_solve(self, A, B, transform, method: str = "qr",
+                     **kw) -> Future:
+        return self.submit("solve_l2_sketched", A=A, B=B,
+                           transform=transform, method=method, **kw)
+
+    def submit_krr_predict(self, kernel, X_new, X_train, coef,
+                           **kw) -> Future:
+        return self.submit("krr_predict", kernel=kernel, X_new=X_new,
+                           X_train=X_train, coef=coef, **kw)
+
+    # -- introspection -------------------------------------------------
+
+    def owner_of(self, endpoint: str, **kwargs) -> Optional[str]:
+        """The (bounded-load) owner a request WOULD have affinity for
+        (tests, capacity planning); ``None`` on an empty ring.
+        Read-only: probing never caches an assignment or charges
+        ownership, so hypothetical queries cannot shift where real
+        traffic lands."""
+        statics = _serve.request_statics(
+            endpoint, pad_floor=self._pool.pad_floor, **kwargs)
+        return self._affinity_owner(statics, record=False)
+
+    def routable(self) -> list:
+        """Names currently on the ring (DRAINING/STOPPED excluded)."""
+        return sorted(self._ring.members())
+
+    def stats(self) -> dict:
+        with self._lock:
+            c = dict(self._counts)
+            by = dict(sorted(self._by_replica.items()))
+        routed = c.get("routed", 0)
+        with self._lock:
+            degraded = sorted(self._degraded)
+            removed = sorted(self._removed)
+        return {
+            "routed": routed,
+            "affinity_hit": c.get("affinity_hit", 0),
+            "affinity_hit_rate": (
+                round(c.get("affinity_hit", 0) / routed, 4)
+                if routed else None),
+            "failover": c.get("failover", 0),
+            "spilled": c.get("spilled", 0),
+            "routable": self.routable(),
+            "degraded": degraded,
+            "removed": removed,
+            "by_replica": by,
+        }
+
+    def close(self) -> None:
+        """Unsubscribe from the health hub (the pool outlives the
+        router; idempotent)."""
+        self._unsub()
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+_ROUTERS: "weakref.WeakSet[Router]" = weakref.WeakSet()
+
+
+def fleet_stats() -> dict:
+    """Aggregate routing counters over every live router (the
+    ``fleet`` collector block in ``telemetry.snapshot()``)."""
+    agg = collections.Counter(routed=0, affinity_hit=0, failover=0,
+                              spilled=0)
+    by_replica = collections.Counter()
+    routers = 0
+    for router in list(_ROUTERS):
+        s = router.stats()
+        routers += 1
+        for k in ("routed", "affinity_hit", "failover", "spilled"):
+            agg[k] += s[k]
+        by_replica.update(s["by_replica"])
+    out = dict(agg)
+    out["routers"] = routers
+    out["affinity_hit_rate"] = (
+        round(out["affinity_hit"] / out["routed"], 4)
+        if out["routed"] else None)
+    out["by_replica"] = {name: {"routed": n}
+                         for name, n in sorted(by_replica.items())}
+    return out
+
+
+_telemetry.register_collector("fleet", fleet_stats)
+
+
+def _iter_routers() -> Iterable[Router]:   # tests/debug
+    return list(_ROUTERS)
+
+
+__all__ = ["NoHealthyReplicaError", "Router", "fleet_stats"]

@@ -28,7 +28,7 @@ the HDF5 batch reader, ``TrainCheckpointer.save_sync``, and
 ``BlockADMMSolver.train``'s preemption poll. See ``docs/resilience``.
 """
 
-from libskylark_tpu.resilience import faults
+from libskylark_tpu.resilience import faults, health
 from libskylark_tpu.resilience.faults import (FaultPlan, fault_plan,
                                               fired)
 from libskylark_tpu.resilience.policy import (TRANSIENT_ERRORS, Deadline,
@@ -42,6 +42,7 @@ from libskylark_tpu.resilience.preemption import (
 __all__ = [
     "Deadline", "DeadlineExceededError", "FaultPlan", "RetryPolicy",
     "TRANSIENT_ERRORS", "drain_serving", "fault_plan", "faults", "fired",
+    "health",
     "install_preemption_handler", "on_preemption", "preemption_requested",
     "register_checkpoint", "reset_preemption",
     "uninstall_preemption_handler", "wait_for_preemption_teardown",

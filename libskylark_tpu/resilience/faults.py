@@ -14,6 +14,12 @@ Sites planted today:
                       execution attempt (:mod:`libskylark_tpu.engine
                       .serve` — the poison-isolation bisection retries
                       re-enter the site)
+``fleet.route``       the fleet router's per-candidate dispatch
+                      (:mod:`libskylark_tpu.fleet.router` — a fired
+                      fault fails ONE route attempt; the router
+                      fails over to the next replica in preference
+                      order, which is what the chaos battery's
+                      failover leg replays deterministically)
 ``engine.compile``    the executable-cache cold-compile path
                       (:mod:`libskylark_tpu.engine.compiled`)
 ``io.webhdfs.open``   the WebHDFS OPEN request (per connection attempt)

@@ -1,0 +1,83 @@
+"""Health-state change hub: executors publish, routers subscribe.
+
+The r9 health states (``SERVING`` / ``DEGRADED`` / ``DRAINING`` /
+``STOPPED``) were, until the fleet round, pull-only: anyone who cared
+polled ``MicrobatchExecutor.state``. A router spreading traffic over N
+replicas cannot poll — by the time a poll sees DRAINING, requests have
+already been queued behind a drain. This hub makes the states *push*:
+the serve layer publishes every transition the moment it happens
+(:meth:`~libskylark_tpu.engine.serve.MicrobatchExecutor` calls
+:func:`publish` from the flush worker on DEGRADED flips, from
+``drain()`` on DRAINING, from ``shutdown()`` on STOPPED), and the
+fleet router (:mod:`libskylark_tpu.fleet.router`) subscribes to drop a
+draining replica from its ring before the next route decision.
+
+The hub is deliberately dumb: a process-global list of callbacks, no
+filtering, no history. ``source`` is whatever object transitioned — a
+:class:`~libskylark_tpu.engine.serve.MicrobatchExecutor` for in-process
+replicas, a :class:`~libskylark_tpu.fleet.replica.ProcessReplica` for
+process-backed ones — and subscribers resolve it to their own identity
+space (the router asks its pool). Callback failures are warned, never
+raised: a broken subscriber must not stop the drain that is publishing
+to it. Transitions are also counted on the always-on
+``resilience.health_transitions`` telemetry counter so chaos/bench
+records carry the state history for free.
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+from typing import Callable
+
+from libskylark_tpu.telemetry import metrics as _metrics
+
+_LOCK = threading.Lock()
+_SUBSCRIBERS: "list[Callable[[object, str, str], None]]" = []
+
+# always-on (the transition itself — a drain, a DEGRADED flip — dwarfs
+# the counter bump), so benchmarks records carry the state history
+_TRANSITIONS = _metrics.counter(
+    "resilience.health_transitions",
+    "Executor health-state transitions, by old and new state")
+
+
+def subscribe(fn: Callable[[object, str, str], None]
+              ) -> Callable[[], None]:
+    """Register ``fn(source, old_state, new_state)`` to run on every
+    published health transition in the process. Returns the
+    unregister callable. The callback runs on whatever thread
+    published (a flush worker, a drain caller, a SIGTERM teardown
+    thread) — it must be cheap and must not call back into the
+    publishing executor's submit/drain paths."""
+    with _LOCK:
+        _SUBSCRIBERS.append(fn)
+
+    def unsubscribe() -> None:
+        with _LOCK:
+            try:
+                _SUBSCRIBERS.remove(fn)
+            except ValueError:
+                pass
+
+    return unsubscribe
+
+
+def publish(source: object, old: str, new: str) -> None:
+    """Fan one transition out to every subscriber (the serve layer's
+    hook; see :meth:`MicrobatchExecutor._maybe_publish_state`).
+    Subscriber failures are contained — publishing happens on drain
+    and teardown paths that must complete regardless."""
+    _TRANSITIONS.inc_always(old=old, new=new)
+    with _LOCK:
+        subs = list(_SUBSCRIBERS)
+    for fn in subs:
+        try:
+            fn(source, old, new)
+        except Exception as e:  # noqa: BLE001 — never rob the drain
+            warnings.warn(
+                f"health-state subscriber {fn!r} failed on "
+                f"{old}->{new}: {e}", RuntimeWarning, stacklevel=2)
+
+
+__all__ = ["publish", "subscribe"]

@@ -23,7 +23,11 @@ gauges and histograms in text exposition format, plus every collector
 block flattened to gauges — one scrape surface carrying the unified
 engine/serve/resilience/tune/io numbers. Naming: ``skylark_`` prefix,
 dots to underscores, counters get ``_total``, histograms the classic
-``_bucket``/``_sum``/``_count`` triplet.
+``_bucket``/``_sum``/``_count`` triplet. Collector sub-blocks named
+``by_<label>`` (``serve_stats()``'s ``by_replica``, ``fleet_stats()``'s
+ditto) render as *label sets* — ``skylark_serve_submitted{replica=
+"r0"}`` — so N executors disaggregate per replica on the scrape
+surface instead of silently summing.
 """
 
 from __future__ import annotations
@@ -215,6 +219,8 @@ def _prom_number(v) -> str:
 
 def _flatten_numeric(doc: dict, prefix: str, out: list) -> None:
     for k, v in sorted(doc.items()):
+        if str(k).startswith("by_") and isinstance(v, dict):
+            continue       # labeled sub-blocks render separately
         key = f"{prefix}_{k}" if prefix else str(k)
         if isinstance(v, bool):
             out.append((key, 1.0 if v else 0.0))
@@ -223,6 +229,27 @@ def _flatten_numeric(doc: dict, prefix: str, out: list) -> None:
         elif isinstance(v, dict):
             _flatten_numeric(v, key, out)
         # strings / lists / None: not scrape-able scalars — skip
+
+
+def _labeled_blocks(doc: dict, prefix: str = ""):
+    """Yield ``(key_prefix, label, member, block)`` for every
+    ``by_<label>`` convention sub-dict in a collector block: a dict
+    named ``by_replica`` (say) maps member name -> numeric sub-block,
+    and renders as ``{replica="<member>"}``-labeled gauges instead of
+    flattening the member name into the metric name — the per-replica
+    disaggregation contract of ``serve_stats()`` / ``fleet_stats()``
+    (docs/observability)."""
+    for k, v in sorted(doc.items()):
+        if not isinstance(v, dict):
+            continue
+        key = f"{prefix}_{k}" if prefix else str(k)
+        if str(k).startswith("by_") and len(str(k)) > 3:
+            label = str(k)[3:]
+            for member, block in sorted(v.items()):
+                if isinstance(block, dict):
+                    yield prefix, label, str(member), block
+        else:
+            yield from _labeled_blocks(v, key)
 
 
 def prometheus_text() -> str:
@@ -264,17 +291,38 @@ def prometheus_text() -> str:
 
     # collector adapters: every numeric leaf becomes a gauge under the
     # collector's namespace — the re-homed engine/serve/resilience/...
-    # counters on one scrape surface
+    # counters on one scrape surface. ``by_<label>`` sub-blocks render
+    # as label sets (one series per replica), not name-mangled gauges.
     for cname, block in snap["collectors"].items():
         if not isinstance(block, dict):
             continue
+        # group every series by metric family FIRST: the exposition
+        # format requires all lines of a family contiguous under one
+        # TYPE line — an aggregate gauge and its labeled per-replica
+        # series are ONE family, and interleaving families fails
+        # strict parsers (promtool/OpenMetrics)
+        families: dict = {}     # base -> [(labels-or-None, value)]
         flat: list = []
         _flatten_numeric(block, "", flat)
         for key, value in flat:
             base = _prom_name(cname.replace(".", "_"),
                               key.replace(".", "_"))
+            families.setdefault(base, []).append((None, value))
+        for kprefix, label, member, sub in _labeled_blocks(block):
+            flat = []
+            _flatten_numeric(sub, "", flat)
+            for key, value in flat:
+                base = _prom_name(cname.replace(".", "_"),
+                                  (f"{kprefix}_{key}" if kprefix
+                                   else key).replace(".", "_"))
+                families.setdefault(base, []).append(
+                    ({label: member}, value))
+        for base in sorted(families):
             lines.append(f"# TYPE {base} gauge")
-            lines.append(f"{base} {_prom_number(value)}")
+            for lbls, value in families[base]:
+                lines.append(
+                    f"{base}{_prom_labels(lbls) if lbls else ''}"
+                    f" {_prom_number(value)}")
 
     return "\n".join(lines) + ("\n" if lines else "")
 

@@ -1,0 +1,633 @@
+"""Fleet subsystem: replicated serving behind a warm-cache-aware
+router (libskylark_tpu/fleet/).
+
+Oracles:
+
+- *correctness through the router*: every routed result is bit-equal
+  to the sequential ``transform.apply`` oracle (CWT's stream
+  exactness) — routing must never change a request's bits, whichever
+  replica serves it;
+- *affinity*: one bucket class pins to one ring owner, so a warmed
+  fleet serves with zero additional compiles and a hit-rate of 1.0;
+- *health routing*: DRAINING replicas leave the ring (push, via the
+  resilience health hub — no polling), DEGRADED ones are deprioritized;
+- *failover*: a draining/refusing replica or an injected
+  ``fleet.route`` fault moves requests to the next deterministic
+  candidate with zero client-visible failures and zero orphaned
+  futures;
+- *preemption composition*: SIGTERM (process-wide, and per-replica via
+  ``preempt_replica``) drains mid-traffic with every future resolved
+  and the drained replica's final drain hook fired exactly once.
+
+Satellites covered here: the multi-executor ``serve_stats()``
+aggregation fix, the per-replica telemetry labels end to end
+(snapshot + Prometheus), and ``request_statics`` ==
+executor-derived statics (the affinity key can never drift from the
+executable key).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from libskylark_tpu import Context, engine, fleet, resilience, telemetry
+from libskylark_tpu import sketch as sk
+from libskylark_tpu.fleet.ring import HashRing
+from libskylark_tpu.resilience import faults
+
+
+@pytest.fixture()
+def fresh_engine():
+    engine.reset()
+    yield
+    engine.reset()
+
+
+def _fleet(n=3, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("linger_us", 1000)
+    pool = fleet.ReplicaPool(n, **kw)
+    return pool, fleet.Router(pool)
+
+
+def _classed_reqs(n_reqs=12, classes=(40, 70, 130), s_dim=16, seed=0):
+    """Requests spread over len(classes) distinct pow2 bucket classes."""
+    rng = np.random.default_rng(seed)
+    ctx = Context(seed=seed)
+    transforms = {n: sk.CWT(n, s_dim, ctx) for n in classes}
+    reqs = []
+    for i in range(n_reqs):
+        n = classes[i % len(classes)]
+        A = rng.standard_normal((n, 3 + i % 3)).astype(np.float32)
+        reqs.append((transforms[n], A))
+    return reqs
+
+
+def _refs(reqs):
+    return [np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+            for (T, A) in reqs]
+
+
+class TestHashRing:
+    def test_owner_deterministic_and_stable(self):
+        r1 = HashRing(["a", "b", "c"])
+        r2 = HashRing(["c", "a", "b"])      # insertion order irrelevant
+        keys = [("sketch_apply", "CWT", i) for i in range(50)]
+        assert [r1.owner(k) for k in keys] == [r2.owner(k) for k in keys]
+
+    def test_removal_only_moves_removed_members_keys(self):
+        ring = HashRing(["a", "b", "c"], vnodes=64)
+        keys = [("bucket", i) for i in range(200)]
+        before = {k: ring.owner(k) for k in keys}
+        ring.remove("b")
+        for k, owner in before.items():
+            if owner != "b":
+                assert ring.owner(k) == owner   # minimal disruption
+            else:
+                assert ring.owner(k) in ("a", "c")
+
+    def test_preference_covers_all_members_once(self):
+        ring = HashRing(["a", "b", "c", "d"])
+        pref = list(ring.preference(("k",)))
+        assert sorted(pref) == ["a", "b", "c", "d"]
+
+    def test_spread(self):
+        ring = HashRing([f"r{i}" for i in range(4)], vnodes=64)
+        owners = [ring.owner(("bucket", i)) for i in range(400)]
+        counts = {m: owners.count(m) for m in set(owners)}
+        assert len(counts) == 4
+        assert min(counts.values()) > 400 // 16   # no starved member
+
+    def test_empty_ring(self):
+        ring = HashRing()
+        with pytest.raises(LookupError):
+            ring.owner("k")
+
+
+class TestAffinityKey:
+    def test_request_statics_matches_executor_statics(self, fresh_engine):
+        """The router's affinity key and the executor's executable key
+        must be the SAME tuple — drift would send requests to cold
+        replicas forever."""
+        ctx = Context(seed=0)
+        rng = np.random.default_rng(0)
+        ex = engine.MicrobatchExecutor(max_batch=2, linger_us=500)
+        try:
+            T = sk.JLT(40, 16, ctx)
+            A = rng.standard_normal((40, 3)).astype(np.float32)
+            assert engine.request_statics(
+                "sketch_apply", transform=T, A=A, dimension=None
+            ) == ex._prep_sketch(T, A)[1]
+
+            Tc = sk.CWT(40, 12, ctx)
+            B = rng.standard_normal((40, 2)).astype(np.float32)
+            assert engine.request_statics(
+                "solve_l2_sketched", A=A, B=B, transform=Tc, method="qr"
+            ) == ex._prep_solve(A, B, Tc)[1]
+
+            from libskylark_tpu import ml
+
+            X = rng.standard_normal((20, 3)).astype(np.float32)
+            coef = rng.standard_normal((20,)).astype(np.float32)
+            q = rng.standard_normal((4, 3)).astype(np.float32)
+            k = ml.Gaussian(3, sigma=1.0)
+            assert engine.request_statics(
+                "krr_predict", kernel=k, X_new=q, X_train=X, coef=coef
+            ) == ex._prep_krr(k, q, X, coef)[1]
+        finally:
+            ex.shutdown()
+
+    def test_transport_kwargs_ignored(self, fresh_engine):
+        ctx = Context(seed=1)
+        T = sk.CWT(40, 16, ctx)
+        A = np.ones((40, 3), np.float32)
+        base = engine.request_statics("sketch_apply", transform=T, A=A)
+        assert base == engine.request_statics(
+            "sketch_apply", transform=T, A=A, timeout=5.0, deadline=1.0,
+            request_id="req-x")
+
+
+class TestRouterAffinity:
+    def test_results_bit_equal_and_sticky(self, fresh_engine):
+        reqs = _classed_reqs(24)
+        refs = _refs(reqs)
+        pool, router = _fleet(3)
+        try:
+            futs = [router.submit_sketch(T, A) for (T, A) in reqs]
+            for f, ref in zip(futs, refs):
+                assert np.array_equal(np.asarray(f.result(timeout=60)),
+                                      ref)
+            st = router.stats()
+            assert st["routed"] == 24
+            assert st["affinity_hit_rate"] == 1.0
+            assert st["failover"] == 0
+            # stickiness: each bucket class routed to exactly one
+            # replica — the fleet compiled each class once total
+            owners = {router.owner_of("sketch_apply", transform=T, A=A,
+                                      dimension=None)
+                      for (T, A) in reqs}
+            assert set(st["by_replica"]) == owners
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_zero_extra_compiles_after_warmup(self, fresh_engine):
+        """A warmed fleet serves a repeat storm with zero engine misses
+        — the warm-cache-aware routing claim, measured."""
+        reqs = _classed_reqs(24)
+        pool, router = _fleet(3, linger_us=10_000_000)
+        try:
+            futs = [router.submit_sketch(T, A) for (T, A) in reqs]
+            pool.flush()
+            [f.result(timeout=60) for f in futs]
+            m0 = engine.stats().misses
+            futs = [router.submit_sketch(T, A) for (T, A) in reqs]
+            pool.flush()
+            [f.result(timeout=60) for f in futs]
+            assert engine.stats().misses == m0
+            assert engine.stats().recompiles == 0
+            assert router.stats()["affinity_hit_rate"] == 1.0
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_owner_of_is_read_only(self, fresh_engine):
+        """Probing owner_of must never perturb routing: a
+        capacity-planning query for classes that never arrive cannot
+        charge phantom ownership and shift real placement."""
+        pool, router = _fleet(2)
+        try:
+            ctx = Context(seed=0)
+            # probe several hypothetical classes before any traffic
+            probed = [router.owner_of(
+                "sketch_apply", transform=sk.CWT(n, 16, ctx),
+                A=np.ones((n, 2), np.float32), dimension=None)
+                for n in (40, 70, 130, 200)]
+            assert all(p is not None for p in probed)
+            assert router._assign == {}      # nothing cached
+            assert not router._owned         # nothing charged
+            # a probe agrees with where the first real request lands
+            T = sk.CWT(40, 16, ctx)
+            A = np.ones((40, 2), np.float32)
+            peek = router.owner_of("sketch_apply", transform=T, A=A,
+                                   dimension=None)
+            router.submit_sketch(T, A).result(timeout=60)
+            assert router.stats()["by_replica"] == {peek: 1}
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_dropped_router_is_collectible(self, fresh_engine):
+        """A router dropped without close() must not be pinned by its
+        health-hub subscription (it would aggregate into fleet_stats
+        forever); the weak subscription shim lets it collect."""
+        import gc
+        import weakref
+
+        pool = fleet.ReplicaPool(2, max_batch=4, linger_us=500)
+        try:
+            router = fleet.Router(pool)
+            wr = weakref.ref(router)
+            del router
+            gc.collect()
+            assert wr() is None
+            # the next publish sweeps the dead shim without warning
+            pool.get(pool.names()[0]).drain(timeout=10)
+        finally:
+            pool.shutdown()
+
+    def test_load_spill_past_threshold(self, fresh_engine):
+        """A saturated owner spills to the least-loaded peer: affinity
+        trades off against live queue depth."""
+        reqs = _classed_reqs(8, classes=(40,))   # ONE bucket class
+        pool, router = _fleet(2, max_batch=4, linger_us=10_000_000)
+        router.spill_threshold = 4
+        try:
+            futs = [router.submit_sketch(T, A) for (T, A) in reqs]
+            st = router.stats()
+            assert st["spilled"] > 0
+            assert len(st["by_replica"]) == 2   # both replicas loaded
+            pool.flush()
+            refs = _refs(reqs)
+            for f, ref in zip(futs, refs):
+                assert np.array_equal(np.asarray(f.result(timeout=60)),
+                                      ref)
+        finally:
+            router.close()
+            pool.shutdown()
+
+
+class TestHealthRouting:
+    def test_draining_replica_leaves_ring_push_not_poll(
+            self, fresh_engine):
+        pool, router = _fleet(3)
+        try:
+            victim = pool.names()[0]
+            assert victim in router.routable()
+            pool.get(victim).drain(timeout=30)
+            # the DRAINING announcement is push: no request needed to
+            # notice
+            assert victim not in router.routable()
+            assert victim in router.stats()["removed"]
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_degraded_replica_deprioritized(self, fresh_engine):
+        reqs = _classed_reqs(6, classes=(40,))
+        pool, router = _fleet(2, linger_us=500)
+        try:
+            owner = router.owner_of("sketch_apply",
+                                    transform=reqs[0][0], A=reqs[0][1],
+                                    dimension=None)
+            ex = pool.get(owner).executor
+            # force the DEGRADED detector: a window of failed flushes,
+            # then publish (what the flush worker does per root flush)
+            for _ in range(8):
+                ex._health.append(1.0)
+            ex._maybe_publish_state()
+            assert owner in router.stats()["degraded"]
+            futs = [router.submit_sketch(T, A) for (T, A) in reqs]
+            [f.result(timeout=60) for f in futs]
+            st = router.stats()
+            # traffic avoided the degraded owner entirely
+            assert st["by_replica"].get(owner, 0) == 0
+            assert st["affinity_hit_rate"] == 0.0
+            # recovery: successful flushes heal the window, the router
+            # re-prioritizes the owner
+            for _ in range(32):
+                ex._health.append(0.0)
+            ex._maybe_publish_state()
+            assert owner not in router.stats()["degraded"]
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_router_seeded_from_current_states(self, fresh_engine):
+        """A router built AFTER a replica started draining must not
+        route to it (the subscription starts late; the constructor
+        seeds from live states)."""
+        pool = fleet.ReplicaPool(2, max_batch=4, linger_us=500)
+        try:
+            pool.get("r0").drain(timeout=30)
+            router = fleet.Router(pool)
+            assert router.routable() == ["r1"]
+            router.close()
+        finally:
+            pool.shutdown()
+
+
+class TestFailover:
+    def test_drain_one_replica_mid_traffic(self, fresh_engine):
+        """The tentpole drain story: preempt one replica while traffic
+        flows — peers absorb the load, zero futures orphaned, zero
+        client-visible failures, and the drained replica's final
+        drain hook (its checkpoint) fires exactly once."""
+        reqs = _classed_reqs(48, classes=(40, 70, 130), seed=3)
+        refs = _refs(reqs)
+        pool, router = _fleet(3, linger_us=2000)
+        fired = []
+        try:
+            victim = router.owner_of("sketch_apply",
+                                     transform=reqs[0][0], A=reqs[0][1],
+                                     dimension=None)
+            pool.on_replica_drain(victim, lambda: fired.append(victim))
+            futs = []
+            stop = threading.Event()
+
+            def preempt_mid_traffic():
+                stop.wait(0.05)
+                pool.preempt_replica(victim, timeout=60)
+
+            t = threading.Thread(target=preempt_mid_traffic)
+            t.start()
+            for i, (T, A) in enumerate(reqs):
+                futs.append(router.submit_sketch(T, A))
+                if i == 8:
+                    stop.set()
+                    time.sleep(0.01)
+            t.join()
+            outs = [np.asarray(f.result(timeout=120)) for f in futs]
+            for o, ref in zip(outs, refs):
+                assert np.array_equal(o, ref)
+            assert fired == [victim]               # checkpoint fired once
+            assert victim not in router.routable()
+            st = router.stats()
+            # peers absorbed everything submitted after the drain
+            assert sum(st["by_replica"].get(n, 0)
+                       for n in pool.names() if n != victim) > 0
+            # double-preempt must not re-fire the hook
+            pool.preempt_replica(victim, timeout=5)
+            assert fired == [victim]
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_injected_route_fault_fails_over(self, fresh_engine):
+        """The fleet.route chaos site: an injected fault on the first
+        candidate moves the request to the next replica — the client
+        sees a result, not the fault."""
+        reqs = _classed_reqs(6, classes=(40,), seed=4)
+        refs = _refs(reqs)
+        plan = {"seed": 3, "faults": [
+            {"site": "fleet.route", "error": "IOError_", "every": 2}]}
+        pool, router = _fleet(2, linger_us=500)
+        try:
+            with faults.fault_plan(plan):
+                futs = [router.submit_sketch(T, A) for (T, A) in reqs]
+                outs = [np.asarray(f.result(timeout=60)) for f in futs]
+                fired = faults.fired()
+            for o, ref in zip(outs, refs):
+                assert np.array_equal(o, ref)
+            st = router.stats()
+            # every 2nd route ATTEMPT fires; each fire costs one extra
+            # attempt, so hits 2,4,6,8,10 fail over and 1,3,5,7,9,11
+            # land — 5 deterministic failovers for 6 submits
+            assert st["failover"] == 5
+            assert st["routed"] == 6        # all requests still landed
+            assert [f[0] for f in fired] == ["fleet.route"] * 5
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_all_replicas_down_raises_no_healthy(self, fresh_engine):
+        pool, router = _fleet(2)
+        try:
+            for name in pool.names():
+                pool.get(name).drain(timeout=30)
+            T = sk.CWT(40, 16, Context(seed=0))
+            with pytest.raises(fleet.NoHealthyReplicaError):
+                router.submit_sketch(T, np.ones((40, 2), np.float32))
+            # a fleet refusal is still a ServeOverloadedError: existing
+            # single-executor retry handling keeps working
+            with pytest.raises(engine.ServeOverloadedError):
+                router.submit_sketch(T, np.ones((40, 2), np.float32))
+        finally:
+            router.close()
+            pool.shutdown()
+
+
+class TestSharedDispatchPool:
+    def test_shared_workers_serve_and_drain(self, fresh_engine):
+        """A host-sized shared flush pool: replicas spawn no private
+        workers, cohorts from every replica drain through the pool's
+        dispatchers, results stay bit-equal, and a one-replica drain
+        still reaches quiescence (its in-flight cohorts run on pool
+        threads that outlive the replica)."""
+        reqs = _classed_reqs(18, seed=13)
+        refs = _refs(reqs)
+        pool = fleet.ReplicaPool(3, max_batch=4, linger_us=1000,
+                                 shared_workers=2)
+        router = fleet.Router(pool)
+        try:
+            for r in pool.replicas():
+                assert r.executor._workers == []   # no private workers
+            futs = [router.submit_sketch(T, A) for (T, A) in reqs]
+            for f, ref in zip(futs, refs):
+                assert np.array_equal(np.asarray(f.result(timeout=60)),
+                                      ref)
+            victim = pool.names()[0]
+            assert pool.preempt_replica(victim, timeout=30)
+            futs = [router.submit_sketch(T, A) for (T, A) in reqs]
+            for f, ref in zip(futs, refs):
+                assert np.array_equal(np.asarray(f.result(timeout=60)),
+                                      ref)
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_shared_workers_rejects_process_backend(self):
+        with pytest.raises(ValueError, match="thread replicas only"):
+            fleet.ReplicaPool(2, backend="process", shared_workers=2)
+
+
+class TestPreemptionComposition:
+    @pytest.fixture(autouse=True)
+    def _clean_handler(self):
+        yield
+        resilience.uninstall_preemption_handler()
+        resilience.reset_preemption()
+
+    def test_sigterm_drains_fleet_and_fires_replica_hooks(
+            self, fresh_engine):
+        """Process-wide SIGTERM composes: the r9 handler drains every
+        replica executor (futures resolve), the pool's hook then runs
+        every replica's final drain hook, and the router ends with an
+        empty ring."""
+        reqs = _classed_reqs(9, seed=5)
+        refs = _refs(reqs)
+        pool, router = _fleet(3, linger_us=10_000_000)
+        fired = []
+        try:
+            for name in pool.names():
+                pool.on_replica_drain(
+                    name, lambda n=name: fired.append(n))
+            futs = [router.submit_sketch(T, A) for (T, A) in reqs]
+            resilience.install_preemption_handler(drain_timeout=60.0)
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert resilience.wait_for_preemption_teardown(timeout=60.0)
+            for f, ref in zip(futs, refs):
+                assert np.array_equal(np.asarray(f.result(timeout=5)),
+                                      ref)
+            assert sorted(fired) == pool.names()   # each exactly once
+            assert router.routable() == []
+            with pytest.raises(fleet.NoHealthyReplicaError):
+                router.submit_sketch(reqs[0][0], reqs[0][1])
+        finally:
+            router.close()
+            pool.shutdown()
+
+
+@pytest.mark.slow
+class TestProcessReplica:
+    def test_process_fleet_serves_and_sigterm_drains_one(
+            self, fresh_engine):
+        """A 2-process fleet: results bit-equal through the pipe, then
+        a REAL SIGTERM to one child — the child's preemption handler
+        drains (its queued work resolves), the parent's router sheds
+        to the peer, zero client-visible failures."""
+        ctx = Context(seed=0)
+        rng = np.random.default_rng(0)
+        T = sk.CWT(40, 16, ctx)
+        ops = [rng.standard_normal((40, 3)).astype(np.float32)
+               for _ in range(8)]
+        refs = [np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+                for A in ops]
+        pool = fleet.ReplicaPool(2, backend="process", max_batch=8,
+                                 linger_us=1000)
+        router = fleet.Router(pool)
+        try:
+            futs = [router.submit_sketch(T, A) for A in ops]
+            for f, ref in zip(futs, refs):
+                assert np.array_equal(np.asarray(f.result(timeout=120)),
+                                      ref)
+            victim = router.owner_of("sketch_apply", transform=T,
+                                     A=ops[0], dimension=None)
+            fired = []
+            pool.on_replica_drain(victim, lambda: fired.append(victim))
+            assert pool.preempt_replica(victim, timeout=90)
+            assert fired == [victim]
+            assert router.routable() == [n for n in pool.names()
+                                         if n != victim]
+            # the surviving replica takes the traffic
+            futs = [router.submit_sketch(T, A) for A in ops]
+            for f, ref in zip(futs, refs):
+                assert np.array_equal(np.asarray(f.result(timeout=120)),
+                                      ref)
+            assert router.stats()["failover"] == 0   # ring had updated
+        finally:
+            router.close()
+            pool.shutdown()
+
+
+class TestServeStatsMultiExecutor:
+    def test_aggregation_over_two_executors(self, fresh_engine):
+        """Satellite regression: serve_stats() over two live executors
+        — counters sum, peaks take max (not sum), histograms merge,
+        and by_replica disaggregates under each executor's name."""
+        reqs = _classed_reqs(8, classes=(40,), seed=6)
+        ex1 = engine.MicrobatchExecutor(max_batch=4, linger_us=500,
+                                        name="agg-a")
+        ex2 = engine.MicrobatchExecutor(max_batch=4, linger_us=500,
+                                        name="agg-b")
+        try:
+            futs = ([ex1.submit_sketch(T, A) for (T, A) in reqs[:5]]
+                    + [ex2.submit_sketch(T, A) for (T, A) in reqs[5:]])
+            [f.result(timeout=60) for f in futs]
+            agg = engine.serve_stats()
+            s1, s2 = ex1.stats(), ex2.stats()
+            assert agg["executors"] >= 2
+            assert agg["submitted"] >= 8
+            assert agg["by_replica"]["agg-a"]["submitted"] == 5
+            assert agg["by_replica"]["agg-b"]["submitted"] == 3
+            # peaks: max across executors, never the sum
+            assert agg["queued_peak"] == max(
+                b["queued_peak"] for b in agg["by_replica"].values())
+            assert agg["isolation_depth_peak"] == max(
+                b["isolation_depth_peak"]
+                for b in agg["by_replica"].values())
+            # histogram merge: bin-wise sum of the per-replica hists
+            merged = {}
+            for b in agg["by_replica"].values():
+                for cap, n in b["batch_capacity_hist"].items():
+                    merged[cap] = merged.get(cap, 0) + n
+            for cap, n in merged.items():
+                assert agg["batch_capacity_hist"][cap] >= n
+            assert agg["states"].get("SERVING", 0) >= 2
+            assert s1["submitted"] + s2["submitted"] == 8
+        finally:
+            ex1.shutdown()
+            ex2.shutdown()
+
+    def test_prometheus_disaggregates_per_replica(self, fresh_engine):
+        """Satellite: the replica label reaches the Prometheus surface
+        as a label set, not a summed scalar."""
+        reqs = _classed_reqs(4, classes=(40,), seed=7)
+        ex1 = engine.MicrobatchExecutor(max_batch=4, linger_us=500,
+                                        name="prom-a")
+        ex2 = engine.MicrobatchExecutor(max_batch=4, linger_us=500,
+                                        name="prom-b")
+        try:
+            futs = ([ex1.submit_sketch(T, A) for (T, A) in reqs[:3]]
+                    + [ex2.submit_sketch(T, A) for (T, A) in reqs[3:]])
+            [f.result(timeout=60) for f in futs]
+            snap = telemetry.snapshot()
+            by = snap["collectors"]["serve"]["by_replica"]
+            assert by["prom-a"]["submitted"] == 3
+            assert by["prom-b"]["submitted"] == 1
+            text = telemetry.prometheus_text()
+            assert 'skylark_serve_submitted{replica="prom-a"} 3' in text
+            assert 'skylark_serve_submitted{replica="prom-b"} 1' in text
+            # exactly one TYPE declaration per metric family
+            type_lines = [ln for ln in text.splitlines()
+                          if ln == "# TYPE skylark_serve_submitted gauge"]
+            assert len(type_lines) == 1
+        finally:
+            ex1.shutdown()
+            ex2.shutdown()
+
+
+class TestFleetTelemetry:
+    def test_fleet_collector_and_route_spans(self, fresh_engine):
+        """fleet.routed/affinity counters in the snapshot, and the
+        fleet.route span parenting the serve.submit span with one
+        request id end to end."""
+        reqs = _classed_reqs(6, seed=8)
+        telemetry.set_enabled(True)
+        try:
+            import libskylark_tpu.telemetry.trace as trace_mod
+
+            trace_mod.clear_finished()
+            pool, router = _fleet(2, linger_us=500)
+            try:
+                futs = [router.submit_sketch(T, A) for (T, A) in reqs]
+                [f.result(timeout=60) for f in futs]
+            finally:
+                router.close()
+                pool.shutdown()
+            snap = telemetry.snapshot()
+            fl = snap["collectors"]["fleet"]
+            assert fl["routed"] >= 6
+            assert fl["affinity_hit_rate"] is not None
+            assert fl["by_replica"]
+            routed = snap["metrics"]["fleet.routed"]["values"]
+            assert sum(v["value"] for v in routed) >= 6
+            spans = trace_mod.finished_spans()
+            routes = {s.span_id: s for s in spans
+                      if s.name == "fleet.route"}
+            submits = [s for s in spans if s.name == "serve.submit"]
+            assert routes and submits
+            parented = [s for s in submits if s.parent_id in routes]
+            assert parented, "serve.submit must nest under fleet.route"
+            for s in parented:
+                assert s.request_id == routes[s.parent_id].request_id
+        finally:
+            telemetry.set_enabled(False)
