@@ -25,7 +25,9 @@ retry deadline.
 
 Other modes: ``--solver`` (engine compile-vs-execute split),
 ``--serve`` (microbatch serving throughput A/B, batched vs sequential
-dispatch), ``--fleet`` (N-replica router vs single-executor A/B with a
+dispatch, plus the r12 kernel-selection A/B: autotuned per-bucket
+pallas-vs-XLA flush selection against forced XLA, with per-bucket
+outcomes), ``--fleet`` (N-replica router vs single-executor A/B with a
 one-replica drain-failover leg), ``--stamp`` (oracle certification
 line).
 
@@ -741,6 +743,135 @@ def _serve(n_requests: int = 64, max_batch: int = 16,
     }
 
     ex.shutdown()
+
+    # -- kernel-selection A/B: autotuned per-bucket selection vs forced
+    # XLA (r12). A 2-bucket serve mix is tuned OFFLINE (record_ranked
+    # into an in-memory cache — the committed benchmarks/plan_cache.json
+    # is never touched by a bench run), then the same storm runs once
+    # with selection enabled (arg > env > plan cache > default) and once
+    # forced onto the vmapped-XLA flush. On a CPU host the cost model's
+    # interpret-mode penalty makes the tuner certify XLA for EVERY serve
+    # bucket — interpret-mode pallas is a correctness surface, not a
+    # speed surface — so the honest CPU record shows ~1x with
+    # per-bucket "xla" outcomes; the kernel side of the A/B only opens
+    # up on real silicon, where numbers ride the committed-record
+    # protocol (the bench tunnel is dead — ROADMAP).
+    from libskylark_tpu import tune as _tune
+
+    kab_nreq, kab_batch = 16, 8
+    cwt_reqs = []
+    for i in range(kab_nreq):
+        Tk = sk.CWT(40, 16, ctx)
+        Ak = rng.standard_normal((40, 3 + i % 4)).astype(np.float32)
+        cwt_reqs.append((Tk, Ak))
+    jlt_reqs = [(reqs[i][0], reqs[i][1]) for i in range(kab_nreq)]
+
+    prev_cache = _tune.set_cache(_tune.PlanCache(path=None))
+    try:
+        # tune every pow2 capacity class, not just kab_batch: the
+        # measured storm's linger-fragmented cohorts flush at any of
+        # them, and an untuned capacity would silently run the xla
+        # DEFAULT while the record claimed a tuner decision ran
+        buckets = {}
+        cap = 1
+        while cap <= kab_batch:
+            buckets[f"cwt_cw_64x8_s16/b{cap}"] = _tune.serve_workload(
+                "sketch_apply", "CWT", "float32", (64, 8), 16,
+                cap, rowwise=False)
+            buckets[f"jlt_rw_64x128_s32/b{cap}"] = _tune.serve_workload(
+                "sketch_apply", "JLT", "float32", (64, 128), 32,
+                cap, rowwise=True)
+            cap *= 2
+        outcomes = {}
+        for bname, w in buckets.items():
+            plan, _cost = _tune.record_ranked(w)
+            modeled = {}
+            for p, c in _tune.rank_candidates(w):
+                modeled.setdefault(
+                    p.backend,
+                    {"modeled_s": float(f"{c['modeled_s']:.3g}"),
+                     "interpret_penalized": bool(c.get("interpret"))})
+            ent = _tune.get_cache().entry(w)
+            outcomes[bname] = {
+                "selected": plan.backend,
+                "source": ent["source"] if ent else None,
+                "candidates": modeled,
+            }
+
+        def kab_run(exk):
+            futs = ([exk.submit_sketch(T, A, dimension=sk.COLUMNWISE)
+                     for (T, A) in cwt_reqs]
+                    + [exk.submit_sketch(T, A, dimension=sk.ROWWISE)
+                       for (T, A) in jlt_reqs])
+            outs = [f.result(timeout=60) for f in futs]
+            jax.block_until_ready(outs)
+            return outs
+
+        def kab_measure(kernel):
+            exk = engine.MicrobatchExecutor(
+                max_batch=kab_batch, linger_us=5000,
+                max_queue=8 * kab_nreq, kernel=kernel)
+            # warm every pow2 capacity class of both buckets up front —
+            # same provably-compile-free discipline as warm_capacities
+            # above: a linger-fragmented straggler cohort in the
+            # measured window must never hit a cold capacity class
+            cap = 1
+            while cap <= kab_batch:
+                futs = ([exk.submit_sketch(T, A, dimension=sk.COLUMNWISE)
+                         for (T, A) in cwt_reqs[:cap]]
+                        + [exk.submit_sketch(T, A, dimension=sk.ROWWISE)
+                           for (T, A) in jlt_reqs[:cap]])
+                exk.flush()
+                jax.block_until_ready(
+                    [f.result(timeout=120) for f in futs])
+                cap *= 2
+            kab_run(exk)                   # warm both buckets
+            m0 = engine.stats().misses
+            r0 = engine.stats().recompiles
+            best = float("inf")
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                outs = kab_run(exk)
+                best = min(best, time.perf_counter() - t0)
+            st_k = exk.stats()["kernel"]["by_backend"]
+            exk.shutdown()
+            return (2 * kab_nreq / best, outs,
+                    engine.stats().misses - m0,
+                    engine.stats().recompiles - r0, st_k)
+
+        rps_sel, out_sel, m_sel, r_sel, flushes_sel = kab_measure(None)
+        rps_xla, out_xla, _mx, _rx, _fx = kab_measure("xla")
+        kab_equal = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(out_sel, out_xla))
+        kab_close = all(
+            np.allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                        atol=1e-5)
+            for a, b in zip(out_sel, out_xla))
+    finally:
+        _tune.set_cache(prev_cache)
+
+    on_tpu = jax.default_backend() == "tpu"
+    kernel_ab = {
+        "buckets": outcomes,
+        "rps_selected": round(rps_sel, 1),
+        "rps_forced_xla": round(rps_xla, 1),
+        "speedup_selected_vs_xla": round(rps_sel / rps_xla, 2),
+        "selected_flushes_by_backend": {
+            k: v["flushes"] for k, v in flushes_sel.items()},
+        "misses_after_warmup": m_sel,
+        "recompiles_after_warmup": r_sel,
+        "bit_equal_to_forced_xla": kab_equal,
+        "allclose_to_forced_xla": kab_close,
+        "note": None if on_tpu else (
+            "CPU host: the tuner correctly certifies XLA for every "
+            "serve bucket (interpret-mode pallas is a correctness "
+            "surface, not a speed surface — cost.INTERPRET_PENALTY); "
+            "the pallas side of this A/B only opens up on real "
+            "silicon, where numbers ride the committed-record protocol "
+            "(bench tunnel dead since r02 — ROADMAP)"),
+    }
+
     rec = {
         "metric": "serve_microbatch_throughput",
         "platform": jax.default_backend(),
@@ -765,6 +896,7 @@ def _serve(n_requests: int = 64, max_batch: int = 16,
         },
         "endpoints": {"solve_l2_sketched": solve_ab,
                       "krr_predict": krr_ab},
+        "kernel_ab": kernel_ab,
         "degraded_mode": degraded_mode,
         "telemetry": _telemetry_snapshot(),
     }

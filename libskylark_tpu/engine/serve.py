@@ -4,9 +4,22 @@ one vmapped executable per flush.
 The r7 engine made a *single* request run as one compiled program; this
 layer makes *N concurrent small requests* run as ``N / batch`` compiled
 programs. Requests enter through a future-returning :meth:`submit` on
-the hot endpoints — dense/CWT sketch-apply, sketched least squares, KRR
-predict — and are grouped by **bucket**: (endpoint statics, dtype, pow2
-shape class, sharding) as defined in :mod:`libskylark_tpu.engine.bucket`.
+the hot endpoints — dense/CWT sketch-apply, Fastfood/RFT feature maps,
+sketched least squares, KRR predict — and are grouped by **bucket**:
+(endpoint statics, dtype, pow2 shape class, sharding) as defined in
+:mod:`libskylark_tpu.engine.bucket`.
+
+Flush kernels: the sketch-apply and fastfood buckets can flush through
+the endpoint's **batched Pallas kernel** (one ``pallas_call`` over the
+stacked cohort — ``sketch/pallas_hash.py`` scatter-free CountSketch,
+``sketch/pallas_dense.py`` fused generate+matmul, ``sketch/
+pallas_fastfood.py`` fused SHGΠHB chain) instead of the vmapped XLA
+path. Which program serves a (bucket, capacity) flush is resolved by
+:meth:`MicrobatchExecutor._resolve_flush_kernel` with the precedence
+``kernel=`` argument > ``SKYLARK_SERVE_KERNEL`` env > tune plan cache >
+default (xla); the resolved choice is a **static of the executable
+cache key**, so selection can never retrace a warm bucket
+(docs/performance, "Serve-bucket kernel selection").
 A cohort flushes as ONE ``jax.vmap``-batched executable when it reaches
 ``max_batch`` or its oldest request has lingered ``linger_us``; past
 ``max_queue`` pending requests, ``submit`` blocks (backpressure) and
@@ -78,7 +91,15 @@ from libskylark_tpu.resilience import health as _health
 from libskylark_tpu.resilience.policy import Deadline
 from libskylark_tpu.telemetry import trace as _trace
 
-ENDPOINTS = ("sketch_apply", "solve_l2_sketched", "krr_predict")
+ENDPOINTS = ("sketch_apply", "fastfood_features", "solve_l2_sketched",
+             "krr_predict")
+
+# endpoints with a batched Pallas flush kernel behind the selection
+# seam (arg > env > plan cache > default); the others always flush
+# through the vmapped XLA path
+_KERNEL_ENDPOINTS = ("sketch_apply", "fastfood_features")
+
+_KERNEL_BACKENDS = ("pallas", "xla")
 
 # auto-assigned replica identity labels ("ex-0", "ex-1", ...) for
 # executors constructed without an explicit ``name`` — every executor
@@ -155,6 +176,40 @@ def _percentile(sorted_vals: list, q: float) -> Optional[float]:
 # ---------------------------------------------------------------------------
 
 
+def _serve_kernel_env():
+    """``SKYLARK_SERVE_KERNEL`` — the one-shot override between the
+    executor argument and the tune plan cache in the flush-kernel
+    precedence (``pallas`` | ``xla``; anything else is ignored so a
+    typo degrades to cache consultation, the repo's env-parse
+    convention)."""
+    import os
+
+    v = os.environ.get("SKYLARK_SERVE_KERNEL")
+    if v is None:
+        return None
+    v = v.strip().lower()
+    return v if v in _KERNEL_BACKENDS else None
+
+
+def _pallas_native() -> bool:
+    """Whether this backend compiles Mosaic kernels natively; off-TPU a
+    pallas flush runs the interpreter (a correctness surface the tests
+    and the CI bit-equality leg use — the tuner never *selects* it for
+    throughput off-TPU, the cost model's interpret penalty sees to
+    that)."""
+    from libskylark_tpu.sketch.pallas_dense import available
+
+    return available()
+
+
+def _decline_slug(msg: str) -> str:
+    """Compact label-value form of a kernel decline reason (the
+    ``by_reason`` Prometheus label set must not carry free prose)."""
+    import re
+
+    return re.sub(r"[^a-z0-9]+", "-", str(msg).lower()).strip("-")[:48]
+
+
 def _sketch_family(transform):
     """(family tag, dist instance) for a serve-able transform."""
     from libskylark_tpu.sketch.dense import DenseTransform
@@ -165,7 +220,8 @@ def _sketch_family(transform):
     if isinstance(transform, DenseTransform):
         return transform.sketch_type, transform.dist
     raise TypeError(
-        "serve endpoints batch dense (JLT/CT) and CWT transforms; "
+        "serve endpoints batch dense (JLT/CT) and CWT transforms "
+        "(Fastfood/RFT feature maps go through submit_fastfood); "
         f"got {type(transform).__name__}")
 
 
@@ -193,6 +249,41 @@ def _sketch_statics(transform, A, dimension, pad_floor):
                transform.sketch_dim, rowwise, str(A.dtype), padded)
     return statics, {"A": A, "family": family, "dist": dist,
                      "rowwise": rowwise, "padded": padded}
+
+
+def _fastfood_statics(transform, A, pad_floor):
+    """(statics, info) for a fastfood_features request: the Fastfood /
+    RFT feature-map serve endpoint. The row extent is the one paddable
+    class dimension (rows are independent lanes of the chain); the
+    column extent must equal the transform's input dim exactly — the
+    chain's own NB-padding is part of the feature definition. The Sm
+    spec (kind, param) is a bucket static: transforms differing only by
+    seed share one executable (streams rebuild from the stacked raw
+    keys), transforms differing by sigma/nu do not."""
+    from libskylark_tpu.sketch.frft import FastRFT
+
+    if not isinstance(transform, FastRFT):
+        raise TypeError(
+            "fastfood_features serves FastRFT-family transforms "
+            f"(FastGaussianRFT/FastMaternRFT); got "
+            f"{type(transform).__name__}")
+    A = np.asarray(A)
+    squeeze = A.ndim == 1
+    if squeeze:
+        A = A[None, :]
+    if A.shape[1] != transform.input_dim:
+        raise ValueError(
+            f"operand dim {A.shape[1]} != transform input dim "
+            f"{transform.input_dim}")
+    sm_kind, sm_param = transform._sm_spec()
+    m_pad = bucketing.pow2_pad(A.shape[0], pad_floor)
+    statics = ("fastfood_features", transform._fut_name, sm_kind,
+               repr(sm_param), transform.sketch_dim, A.shape[1],
+               str(A.dtype), m_pad)
+    return statics, {"A": A, "squeeze": squeeze, "m_pad": m_pad,
+                     "fut": transform._fut_name, "sm_kind": sm_kind,
+                     "sm_param": sm_param,
+                     "family": type(transform).sketch_type}
 
 
 def _solve_statics(transform, A, B, method, pad_floor):
@@ -276,6 +367,9 @@ def derive_request(endpoint: str, *,
         kwargs.setdefault("dimension", None)
         return _sketch_statics(kwargs["transform"], kwargs["A"],
                                kwargs["dimension"], pad_floor)
+    if endpoint == "fastfood_features":
+        return _fastfood_statics(kwargs["transform"], kwargs["A"],
+                                 pad_floor)
     if endpoint == "solve_l2_sketched":
         kwargs.setdefault("method", "qr")
         return _solve_statics(kwargs["transform"], kwargs["A"],
@@ -326,9 +420,14 @@ class MicrobatchExecutor:
                  failure_window: int = 32,
                  shed_fraction: float = 0.25,
                  name: Optional[str] = None,
-                 dispatch_queue=None):
+                 dispatch_queue=None,
+                 kernel: Optional[str] = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        if kernel is not None and kernel not in _KERNEL_BACKENDS:
+            raise ValueError(
+                f"kernel must be one of {_KERNEL_BACKENDS} or None "
+                f"(autotuned selection), got {kernel!r}")
         if not 0.0 < degraded_threshold <= 1.0:
             raise ValueError("degraded_threshold must be in (0, 1]")
         if not 0.0 < shed_fraction <= 1.0:
@@ -362,9 +461,21 @@ class MicrobatchExecutor:
 
         self._compiled: dict = {}          # bucket key -> CompiledFn
         self._compiled_lock = threading.Lock()
+        # flush-kernel selection (docs/performance "Serve-bucket kernel
+        # selection"): the explicit argument tops the precedence; the
+        # memo makes key_fn's per-call re-resolution a dict hit, keyed
+        # on (bucket statics, capacity, plan fingerprint) so a plan
+        # edit re-resolves while steady-state traffic never recomputes
+        self.kernel = kernel
+        self._kernel_memo: dict = {}
+        self._kernel_memo_fp: Optional[str] = None
 
         self._stats_lock = threading.Lock()
         self._counts = collections.Counter()
+        # flush-kernel selection counters (per flush): backend ->
+        # flushes served, decline-reason -> flushes that fell back
+        self._kernel_sel: "collections.Counter" = collections.Counter()
+        self._kernel_dec: "collections.Counter" = collections.Counter()
         self._batch_hist: "collections.Counter" = collections.Counter()
         self._cohort_hist: "collections.Counter" = collections.Counter()
         self._pad_real = 0
@@ -435,6 +546,9 @@ class MicrobatchExecutor:
             if endpoint == "sketch_apply":
                 key, statics, ctx, req = self._prep_sketch(
                     _derived=derived, **kwargs)
+            elif endpoint == "fastfood_features":
+                key, statics, ctx, req = self._prep_fastfood(
+                    _derived=derived, **kwargs)
             elif endpoint == "solve_l2_sketched":
                 key, statics, ctx, req = self._prep_solve(
                     _derived=derived, **kwargs)
@@ -458,6 +572,13 @@ class MicrobatchExecutor:
     def submit_sketch(self, transform, A, dimension=None, **kw) -> Future:
         return self.submit("sketch_apply", transform=transform, A=A,
                            dimension=dimension, **kw)
+
+    def submit_fastfood(self, transform, A, **kw) -> Future:
+        """Fastfood/RFT feature-map endpoint: resolves to exactly what
+        ``transform.apply(A, ROWWISE)`` returns (the (m, S) feature
+        map; 1-D input returns (S,))."""
+        return self.submit("fastfood_features", transform=transform,
+                           A=A, **kw)
 
     def submit_solve(self, A, B, transform, method: str = "qr",
                      **kw) -> Future:
@@ -494,7 +615,8 @@ class MicrobatchExecutor:
             transform, A, dimension, self.pad_floor)
         A = info["A"]
         ctx = {"dist": info["dist"], "family": info["family"],
-               "s_dim": transform.sketch_dim, "rowwise": info["rowwise"]}
+               "s_dim": transform.sketch_dim, "rowwise": info["rowwise"],
+               "padded": info["padded"], "dtype": str(A.dtype)}
         req = _Request(
             endpoint="sketch_apply",
             arrays={"kd": self._key_data(transform),
@@ -504,6 +626,24 @@ class MicrobatchExecutor:
             true_shapes={"A": A.shape},
             meta={"padded": info["padded"], "rowwise": info["rowwise"],
                   "s_dim": transform.sketch_dim},
+        )
+        return statics, statics, ctx, req
+
+    def _prep_fastfood(self, transform, A, _derived=None):
+        statics, info = _derived or _fastfood_statics(
+            transform, A, self.pad_floor)
+        A = info["A"]
+        ctx = {"family": info["family"], "fut": info["fut"],
+               "sm_kind": info["sm_kind"], "sm_param": info["sm_param"],
+               "n_dim": A.shape[1], "s_dim": transform.sketch_dim,
+               "padded": (info["m_pad"], A.shape[1]),
+               "dtype": str(A.dtype)}
+        req = _Request(
+            endpoint="fastfood_features",
+            arrays={"kd": self._key_data(transform), "A": A},
+            true_shapes={"A": A.shape},
+            meta={"padded": (info["m_pad"], A.shape[1]),
+                  "m": A.shape[0], "squeeze": info["squeeze"]},
         )
         return statics, statics, ctx, req
 
@@ -859,12 +999,154 @@ class MicrobatchExecutor:
                 self._compiled[b.statics] = cf
             return cf
 
+    # ------------------------------------------------------------------
+    # flush-kernel selection (docs/performance, "Serve-bucket kernel
+    # selection"): which program serves a (bucket, capacity) flush —
+    # the endpoint's batched Pallas kernel or the vmapped XLA path.
+    # Precedence: executor ``kernel=`` argument > SKYLARK_SERVE_KERNEL
+    # env > tune plan cache > default (xla). A pallas intent that fails
+    # host-side qualification declines (reason counted) back to xla.
+    # ------------------------------------------------------------------
+
+    def _kernel_workload(self, b: _Bucket, capacity: int):
+        """The tune serve-bucket workload of a flush — (endpoint /
+        orientation, family, dtype, padded lane class, capacity class)
+        — or None when the endpoint has no kernel decision."""
+        from libskylark_tpu import tune
+
+        endpoint = b.statics[0]
+        ctx = b.ctx
+        if endpoint == "sketch_apply":
+            return tune.serve_workload(
+                "sketch_apply", ctx["family"], ctx["dtype"],
+                ctx["padded"], ctx["s_dim"], capacity,
+                rowwise=ctx["rowwise"])
+        if endpoint == "fastfood_features":
+            return tune.serve_workload(
+                "fastfood_features", ctx["family"], ctx["dtype"],
+                ctx["padded"], ctx["s_dim"], capacity)
+        return None
+
+    def _qualify_serve_kernel(self, b: _Bucket,
+                              m_tile: Optional[int] = None):
+        """Host-side (ok, why) qualification of the bucket's batched
+        kernel at the padded lane class — run BEFORE a pallas choice is
+        committed to the executable key, so an unqualified bucket keys
+        (and compiles) the XLA program it will actually run."""
+        ctx = b.ctx
+        endpoint = b.statics[0]
+        interpret = not _pallas_native()
+        if endpoint == "fastfood_features":
+            from libskylark_tpu.sketch import pallas_fastfood
+
+            return pallas_fastfood.serve_qualify(
+                ctx["n_dim"], ctx["s_dim"], ctx["padded"][0],
+                ctx["dtype"], ctx["fut"], interpret=interpret)
+        padded, rowwise = ctx["padded"], ctx["rowwise"]
+        n = padded[1] if rowwise else padded[0]
+        m = padded[0] if rowwise else padded[1]
+        if ctx["family"] == "CWT":
+            from libskylark_tpu.sketch import pallas_hash
+
+            return pallas_hash.qualify(ctx["s_dim"], n, m,
+                                       ctx["dtype"],
+                                       interpret=interpret)
+        from libskylark_tpu.sketch import pallas_dense
+
+        return pallas_dense.serve_qualify(
+            ctx["dist"], ctx["s_dim"], n, m, ctx["dtype"],
+            interpret=interpret, m_tile=m_tile)
+
+    def _resolve_flush_kernel(self, b: _Bucket, capacity: int) -> tuple:
+        """``(backend, plan, source, declined)`` for one (bucket,
+        capacity) flush. Memoized per plan-cache fingerprint: the
+        engine key_fn re-resolves on every call (the kernel choice is
+        a STATIC of the executable key — the r7 jit-leak gate's
+        zero-recompile contract holds because this is a dict hit with
+        a stable answer), and a plan edit changes the fingerprint,
+        which both re-resolves the choice and re-keys the executable.
+        ``declined`` is the reason slug when a pallas intent fell back
+        to xla (the ``by_reason`` counter), else None."""
+        if b.statics[0] not in _KERNEL_ENDPOINTS:
+            return ("xla", None, "endpoint", None)
+        from libskylark_tpu.engine.compiled import plan_fingerprint
+
+        fp = plan_fingerprint()
+        if fp != self._kernel_memo_fp:
+            # new fingerprint era: every memoized choice (including
+            # mosaic-reject poisonings — they hold "for the fingerprint
+            # era") is stale; drop them so the memo stays bounded by
+            # the live (bucket, capacity) population
+            self._kernel_memo.clear()
+            self._kernel_memo_fp = fp
+        memo_key = (b.statics, int(capacity), fp)
+        got = self._kernel_memo.get(memo_key)
+        if got is not None:
+            return got
+        plan = None
+        if self.kernel is not None:
+            choice, source = self.kernel, "arg"
+        elif _serve_kernel_env() is not None:
+            choice, source = _serve_kernel_env(), "env"
+        else:
+            from libskylark_tpu.sketch import params as sketch_params
+
+            if sketch_params.get_use_plan_cache():
+                try:
+                    from libskylark_tpu import tune
+
+                    w = self._kernel_workload(b, capacity)
+                    plan = tune.plan_for(w) if w is not None else None
+                except Exception:
+                    plan = None
+            if plan is not None and plan.backend in _KERNEL_BACKENDS:
+                choice, source = plan.backend, "plan"
+            else:
+                plan = None
+                choice, source = "xla", "default"
+        out = (choice, plan, source, None)
+        if choice == "pallas":
+            ok, why = self._qualify_serve_kernel(
+                b, m_tile=plan.m_tile if plan else None)
+            if not ok:
+                out = ("xla", None, source, _decline_slug(why))
+        self._kernel_memo[memo_key] = out
+        return out
+
+    def _kernel_key_token(self, b: _Bucket, capacity: int) -> str:
+        """The kernel-choice static the flush executable is keyed on
+        (plan_id carries the m-tile for the dense family — two plans
+        trace different programs and must key differently)."""
+        backend, plan, _src, _why = self._resolve_flush_kernel(
+            b, capacity)
+        return plan.plan_id() if (backend == "pallas"
+                                  and plan is not None) else backend
+
+    def _poison_kernel(self, b: _Bucket, capacity: int,
+                       reason: str) -> None:
+        """Force (bucket, capacity) onto the XLA path for the rest of
+        this fingerprint era — the compile-time Mosaic-rejection
+        fallback (a rejection is a decline, not an outage)."""
+        from libskylark_tpu.engine.compiled import plan_fingerprint
+
+        memo_key = (b.statics, int(capacity), plan_fingerprint())
+        self._kernel_memo[memo_key] = ("xla", None, "fallback", reason)
+
     def _build_batched(self, b: _Bucket):
         import jax
 
         statics = b.statics
         ctx = b.ctx
         endpoint = statics[0]
+        # kernel-selecting endpoints key their executables on the
+        # resolved kernel-choice token too: the choice is derived from
+        # the SAME (bucket, capacity, plan-fingerprint) triple at key
+        # time and at trace time, so the key can never disagree with
+        # the program it names
+        def serve_key(*a):
+            return statics + (
+                "kernel", self._kernel_key_token(b, int(a[0].shape[0])))
+
         if endpoint == "sketch_apply":
             s_dim, rowwise = ctx["s_dim"], ctx["rowwise"]
             if ctx["family"] == "CWT":
@@ -885,12 +1167,63 @@ class MicrobatchExecutor:
             inner = jax.vmap(one)
 
             def batched_sketch(kd, scale, A):
+                backend, plan, _src, _why = self._resolve_flush_kernel(
+                    b, int(A.shape[0]))
+                if backend == "pallas":
+                    interpret = not _pallas_native()
+                    if ctx["family"] == "CWT":
+                        from libskylark_tpu.sketch import pallas_hash
+
+                        # exact accumulation under the interpreter:
+                        # bit-equal to the scatter (the CI bit-equality
+                        # leg); the MXU mode serves on real silicon
+                        return pallas_hash.cwt_apply_batched(
+                            kd, A, s_dim=s_dim, rowwise=rowwise,
+                            accum="exact" if interpret else "mxu",
+                            interpret=interpret)
+                    from libskylark_tpu.sketch import pallas_dense
+
+                    return pallas_dense.serve_batched_apply(
+                        kd, scale, A, dist=ctx["dist"], s_dim=s_dim,
+                        rowwise=rowwise,
+                        m_tile=plan.m_tile if plan else None,
+                        interpret=interpret)
                 return inner(kd, scale, A)
 
             return engine_compile(
                 batched_sketch, name="serve.sketch_apply",
                 donate_argnums=(0, 1, 2),
-                key_fn=lambda *a: statics)
+                key_fn=serve_key)
+        if endpoint == "fastfood_features":
+            from libskylark_tpu.sketch.frft import fastfood_serve_apply
+
+            n_dim, s_dim = ctx["n_dim"], ctx["s_dim"]
+            fut, sm_kind, sm_param = (ctx["fut"], ctx["sm_kind"],
+                                      ctx["sm_param"])
+
+            def one_ff(kd, A):
+                return fastfood_serve_apply(
+                    kd, A, n_dim=n_dim, s_dim=s_dim, fut=fut,
+                    sm_kind=sm_kind, sm_param=sm_param)
+
+            inner_ff = jax.vmap(one_ff)
+
+            def batched_fastfood(kd, A):
+                backend, _plan, _src, _why = self._resolve_flush_kernel(
+                    b, int(A.shape[0]))
+                if backend == "pallas":
+                    from libskylark_tpu.sketch import pallas_fastfood
+
+                    return pallas_fastfood.serve_features_batched(
+                        kd, A, n_dim=n_dim, s_dim=s_dim, fut=fut,
+                        sm_kind=sm_kind, sm_param=sm_param,
+                        interpret=not _pallas_native())
+                return inner_ff(kd, A)
+
+            return engine_compile(
+                batched_fastfood, name="serve.fastfood_features",
+                donate_argnums=(0, 1),
+                key_fn=serve_key)
         if endpoint == "solve_l2_sketched":
             from libskylark_tpu.algorithms.regression import (
                 sketched_solve_serve,
@@ -963,10 +1296,27 @@ class MicrobatchExecutor:
         faults.check("serve.flush",
                      tags=frozenset().union(*(r.tags for r in cohort)),
                      detail=f"{endpoint} k={k} cap={capacity}")
+        # kernel selection: resolved once per flush (memo hit after the
+        # first), counted per flush so operators see live which buckets
+        # are on the fast path and WHY the others are not
+        kernel_backend, kdeclined = "xla", None
+        if endpoint in _KERNEL_ENDPOINTS:
+            kernel_backend, _kp, _ks, kdeclined = \
+                self._resolve_flush_kernel(b, capacity)
         if endpoint == "sketch_apply":
             padded = cohort[0].meta["padded"]
             args = self._stack_common(cohort, padded, capacity,
                                       with_b=False)
+            primary = "A"
+        elif endpoint == "fastfood_features":
+            padded = cohort[0].meta["padded"]
+            dtype = cohort[0].arrays["A"].dtype
+            kd = bucketing.stack_pad([r.arrays["kd"] for r in cohort],
+                                     (2,), capacity, np.uint32)
+            Astk = bucketing.stack_pad([r.arrays["A"] for r in cohort],
+                                       padded, capacity, dtype)
+            args = (self._device_put_batch(kd),
+                    self._device_put_batch(Astk))
             primary = "A"
         elif endpoint == "solve_l2_sketched":
             padded = cohort[0].meta["padded_A"]
@@ -993,16 +1343,48 @@ class MicrobatchExecutor:
         # silently diverge from its sequential twin on MXU backends.
         # Sketch-apply stays at the fast ambient default, also matching
         # its sequential path (base/precision.py policy).
-        prec = (contextlib.nullcontext() if endpoint == "sketch_apply"
-                else solver_precision())
-        with prec, warnings.catch_warnings():
-            # the donated stacked buffers rarely alias the batch output
-            # — jax's unusable-donation warning is this layer's expected
-            # steady state, silenced ONLY around the serve dispatch so
-            # user donation sites keep their diagnostic
-            warnings.filterwarnings(
-                "ignore", message="Some donated buffers were not usable")
-            out = cf(*args)
+        def dispatch():
+            prec = (contextlib.nullcontext()
+                    if endpoint in _KERNEL_ENDPOINTS
+                    else solver_precision())
+            with prec, warnings.catch_warnings():
+                # the donated stacked buffers rarely alias the batch
+                # output — jax's unusable-donation warning is this
+                # layer's expected steady state, silenced ONLY around
+                # the serve dispatch so user donation sites keep their
+                # diagnostic
+                warnings.filterwarnings(
+                    "ignore",
+                    message="Some donated buffers were not usable")
+                return cf(*args)
+
+        if kernel_backend == "pallas" and _pallas_native():
+            # compile-time Mosaic rejection is a DECLINE, not an
+            # outage: poison this (bucket, capacity) onto the XLA path
+            # and re-dispatch — the key_fn re-resolves to the xla
+            # token, so the retry compiles (and caches) the fallback
+            # program. Rejections surface as JaxRuntimeError from
+            # Mosaic proper but as trace-time NotImplementedError /
+            # LoweringError from the Pallas lowering rules, so the net
+            # is Exception-wide; the serve.flush fault seam fires
+            # BEFORE this block, so an injected chaos fault can never
+            # be misread as a rejection. A rejected attempt never
+            # EXECUTED, so the donated buffers are intact and the
+            # re-dispatch is safe; a post-compile runtime failure may
+            # have consumed them — detected below — in which case the
+            # original error propagates into bisection isolation
+            # (future flushes of this bucket still take the XLA path).
+            try:
+                out = dispatch()
+            except Exception:  # noqa: BLE001 — decline seam, see above
+                self._poison_kernel(b, capacity, "mosaic-reject")
+                kernel_backend, kdeclined = "xla", "mosaic-reject"
+                if any(getattr(a, "is_deleted", lambda: False)()
+                       for a in args):
+                    raise
+                out = dispatch()
+        else:
+            out = dispatch()
         # resolve futures from ONE host view of the batch output: a
         # per-request eager device slice would cost a dispatched XLA op
         # per lane — at microbatch request sizes that's comparable to
@@ -1022,6 +1404,10 @@ class MicrobatchExecutor:
             self._counts["completed"] += k
             if k > 1:
                 self._counts["coalesced"] += k
+            if endpoint in _KERNEL_ENDPOINTS:
+                self._kernel_sel[kernel_backend] += 1
+                if kdeclined:
+                    self._kernel_dec[kdeclined] += 1
             self._batch_hist[capacity] += 1
             self._cohort_hist[k] += 1
             self._pad_total += bucketing.padded_elements(padded, capacity)
@@ -1054,6 +1440,9 @@ class MicrobatchExecutor:
             if r.meta["rowwise"]:
                 return out[lane, : r.true_shapes["A"][0], :]
             return out[lane, :, : r.true_shapes["A"][1]]
+        if endpoint == "fastfood_features":
+            p = out[lane, : r.meta["m"], :]
+            return p[0] if r.meta["squeeze"] else p
         if endpoint == "solve_l2_sketched":
             x = out[lane]
             return x[:, 0] if r.meta["squeeze"] else x
@@ -1168,6 +1557,8 @@ class MicrobatchExecutor:
             batch_hist = dict(sorted(self._batch_hist.items()))
             cohort_hist = dict(sorted(self._cohort_hist.items()))
             pad_real, pad_total = self._pad_real, self._pad_total
+            ksel = dict(sorted(self._kernel_sel.items()))
+            kdec = dict(sorted(self._kernel_dec.items()))
         with self._lock:
             queued = self._pending
         return {
@@ -1186,6 +1577,15 @@ class MicrobatchExecutor:
             "queued_peak": c.get("queued_peak", 0),
             "coalesced": c.get("coalesced", 0),
             "flushes": c.get("flushes", 0),
+            # by_<label> convention (docs/observability): renders on
+            # the Prometheus surface as skylark_serve_kernel_flushes
+            # {backend="pallas"} / ..._declined_flushes{reason="..."}
+            "kernel": {
+                "by_backend": {k: {"flushes": int(v)}
+                               for k, v in ksel.items()},
+                "by_reason": {k: {"declined_flushes": int(v)}
+                              for k, v in kdec.items()},
+            },
             "batch_capacity_hist": batch_hist,
             "cohort_size_hist": cohort_hist,
             "padding_waste_ratio": (
@@ -1252,6 +1652,8 @@ def serve_stats() -> dict:
     batch_hist: "collections.Counter" = collections.Counter()
     cohort_hist: "collections.Counter" = collections.Counter()
     states: "collections.Counter" = collections.Counter()
+    ksel: "collections.Counter" = collections.Counter()
+    kdec: "collections.Counter" = collections.Counter()
     by_replica: dict = {}
     lat_all: list = []
     waste_real = waste_total = 0
@@ -1264,6 +1666,10 @@ def serve_stats() -> dict:
             maxes[k] = max(maxes[k], s.get(k, 0))
         batch_hist.update(s["batch_capacity_hist"])
         cohort_hist.update(s["cohort_size_hist"])
+        for kk, vv in s["kernel"]["by_backend"].items():
+            ksel[kk] += vv["flushes"]
+        for kk, vv in s["kernel"]["by_reason"].items():
+            kdec[kk] += vv["declined_flushes"]
         states[s["state"]] += 1
         if s["padding_waste_ratio"] is not None:
             with ex._stats_lock:
@@ -1279,6 +1685,12 @@ def serve_stats() -> dict:
     agg.update(maxes)
     agg["batch_capacity_hist"] = dict(sorted(batch_hist.items()))
     agg["cohort_size_hist"] = dict(sorted(cohort_hist.items()))
+    agg["kernel"] = {
+        "by_backend": {k: {"flushes": int(v)}
+                       for k, v in sorted(ksel.items())},
+        "by_reason": {k: {"declined_flushes": int(v)}
+                      for k, v in sorted(kdec.items())},
+    }
     agg["states"] = dict(sorted(states.items()))
     agg["padding_waste_ratio"] = (
         round(1.0 - waste_real / waste_total, 4) if waste_total else None)

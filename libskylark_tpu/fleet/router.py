@@ -1,7 +1,8 @@
 """Warm-cache-aware router: the fleet's front door.
 
 ``Router.submit`` mirrors the single-executor API (`submit_sketch` /
-`submit_solve` / `submit_krr_predict` return the same futures) but
+`submit_fastfood` / `submit_solve` / `submit_krr_predict` return the
+same futures) but
 picks a replica per request from three live signals:
 
 1. **Sticky bucket affinity.** The request's engine-level bucket
@@ -344,6 +345,10 @@ class Router:
     def submit_sketch(self, transform, A, dimension=None, **kw) -> Future:
         return self.submit("sketch_apply", transform=transform, A=A,
                            dimension=dimension, **kw)
+
+    def submit_fastfood(self, transform, A, **kw) -> Future:
+        return self.submit("fastfood_features", transform=transform,
+                           A=A, **kw)
 
     def submit_solve(self, A, B, transform, method: str = "qr",
                      **kw) -> Future:

@@ -71,14 +71,30 @@ class HashTransform(SketchTransform):
         return self._value_stream(dtype)
 
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        out = self._try_kernel(A, rowwise=False)
+        if out is not None:
+            return out
         h = self.bucket_indices()
         v = self.values(A.dtype)
         return jax.ops.segment_sum(v[:, None] * A, h, num_segments=self._S)
 
     def _apply_rowwise(self, A: jnp.ndarray) -> jnp.ndarray:
+        out = self._try_kernel(A, rowwise=True)
+        if out is not None:
+            return out
         h = self.bucket_indices()
         v = self.values(A.dtype)
         return jax.ops.segment_sum(v[:, None] * A.T, h, num_segments=self._S).T
+
+    def _try_kernel(self, A, *, rowwise: bool):
+        """Scatter-free Pallas dispatch (sketch/pallas_hash.py) — CWT on
+        a qualifying TPU operand, routed only by an explicit override
+        (``SKYLARK_HASH_KERNEL``) or a certified plan-cache entry;
+        None declines and the ``segment_sum`` scatter below serves
+        (see the kernel module's dispatch doc)."""
+        from libskylark_tpu.sketch import pallas_hash
+
+        return pallas_hash.try_apply(self, A, rowwise=rowwise)
 
     # -- sparse input: O(nnz) scatter-add over COO triplets (the dataflow
     # form of ref: sketch/hash_transform_local_sparse.hpp:12-152) --

@@ -884,3 +884,158 @@ def jr_key_data(k):
     import jax.random as jr
 
     return jr.key_data(k)
+
+
+# ---------------------------------------------------------------------------
+# batched (microbatch-flush) launchers: one kernel over a stacked cohort
+# ---------------------------------------------------------------------------
+#
+# The serve layer (engine/serve.py) flushes a cohort as ONE executable.
+# These launchers give that executable a single pallas_call whose grid
+# carries the batch as its leading (parallel) axis — batch lanes tile
+# innermost against the same VMEM budget as the unbatched kernel (one
+# lane's working set per grid step; _qualify's shrink-don't-fail plan
+# applies unchanged), and every lane contracts against its OWN virtual
+# operator (per-lane key table, per-lane scale) so transforms differing
+# only by seed coexist in one flush. Per-lane bits are capacity-
+# invariant: lanes run the same fixed-tile program independently.
+
+
+def _kernel_batched_rw(dist_kind, s_dim, n_blocks, precision, keys_ref,
+                       scale_ref, a_ref, out_ref):
+    """Batched rowwise: out[b] += A[b]_tile @ (scale[b]·S_blk[b])ᵀ.
+    Grid (batch, m_tiles, n_blocks); key table flattened (B·nb, 2)."""
+    b = pl.program_id(0)
+    k = pl.program_id(2)
+    S_blk = _gen_block(dist_kind, s_dim, keys_ref, b * n_blocks + k)
+    S_blk = S_blk * scale_ref[b]
+    acc = _dot(a_ref[0], S_blk, (((1,), (1,)), ((), ())), precision,
+               gen_side=1)
+    _accumulate(out_ref, acc[None], k)
+
+
+def _kernel_batched_cw(dist_kind, s_dim, n_blocks, precision, keys_ref,
+                       scale_ref, a_ref, out_ref):
+    """Batched columnwise: out[b] += (scale[b]·S_blk[b]) @ A[b]_blk."""
+    b = pl.program_id(0)
+    k = pl.program_id(2)
+    S_blk = _gen_block(dist_kind, s_dim, keys_ref, b * n_blocks + k)
+    S_blk = S_blk * scale_ref[b]
+    acc = _dot(S_blk, a_ref[0], (((1,), (0,)), ((), ())), precision,
+               gen_side=0)
+    _accumulate(out_ref, acc[None], k)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("s_dim", "dist_kind", "m_tile", "precision",
+                     "rowwise", "interpret"),
+)
+def _batched_call(A, keys, scale, *, s_dim, dist_kind, m_tile,
+                  precision, rowwise, interpret):
+    B = A.shape[0]
+    n = A.shape[2] if rowwise else A.shape[1]
+    m = A.shape[1] if rowwise else A.shape[2]
+    n_blocks = n // BLOCK_COLS
+    grid = (B, m // m_tile, n_blocks)
+    params = _CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    if rowwise:
+        kern = functools.partial(_kernel_batched_rw, dist_kind, s_dim,
+                                 n_blocks, precision)
+        a_spec = pl.BlockSpec((1, m_tile, BLOCK_COLS),
+                              lambda b, i, k: (b, i, k),
+                              memory_space=pltpu.VMEM)
+        out_spec = pl.BlockSpec((1, m_tile, s_dim),
+                                lambda b, i, k: (b, i, 0),
+                                memory_space=pltpu.VMEM)
+        out_shape = jax.ShapeDtypeStruct((B, m, s_dim), jnp.float32)
+    else:
+        kern = functools.partial(_kernel_batched_cw, dist_kind, s_dim,
+                                 n_blocks, precision)
+        a_spec = pl.BlockSpec((1, BLOCK_COLS, m_tile),
+                              lambda b, i, k: (b, k, i),
+                              memory_space=pltpu.VMEM)
+        out_spec = pl.BlockSpec((1, s_dim, m_tile),
+                                lambda b, i, k: (b, 0, i),
+                                memory_space=pltpu.VMEM)
+        out_shape = jax.ShapeDtypeStruct((B, s_dim, m), jnp.float32)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # keys (B·nb, 2)
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # scale (B,)
+            a_spec,
+        ],
+        out_specs=out_spec,
+        out_shape=out_shape,
+        compiler_params=params,
+        interpret=interpret,
+    )(keys, scale, A)
+
+
+def serve_qualify(dist, s_dim: int, n: int, m: int, dtype,
+                  interpret: bool = False,
+                  m_tile: Optional[int] = None) -> tuple[bool, str]:
+    """Host-side qualification for the batched serve launcher:
+    (ok, reason) — the serve layer's decline counter wants the why."""
+    if not _HAVE_PALLAS:
+        return False, "pallas unavailable"
+    if not interpret and not available():
+        return False, "backend is not a TPU (interpret-mode only here)"
+    if not supported(dist, dtype):
+        return False, f"distribution/dtype unsupported ({dtype})"
+    lane = jax.ShapeDtypeStruct((m, n), jnp.dtype(dtype))
+    mt = _qualify(dist, lane, seq_axis=1,
+                  m_tile=m_tile or _DEFAULT_M_TILE(),
+                  interpret=interpret, s_dim=s_dim)
+    if mt is None:
+        return False, "no m-tile fits the VMEM budget"
+    return True, "ok"
+
+
+def serve_batched_apply(key_data, scale, A, *, dist, s_dim: int,
+                        rowwise: bool, m_tile: Optional[int] = None,
+                        precision: Optional[str] = None,
+                        interpret: bool = False) -> jnp.ndarray:
+    """Batched fused generate+matmul for a microbatch flush: the
+    stacked-cohort analog of :func:`rowwise_apply`/:func:`columnwise_
+    apply`, fully traceable (the serve builder compiles it into the
+    bucket's batched executable). ``key_data`` (B, 2) uint32,
+    ``scale`` (B,), ``A`` (B, m, n) rowwise / (B, n, m) columnwise.
+    The scale multiplies the generated operator entries — the same
+    elementwise order as ``serve_apply``'s scaled virtual panel.
+    Raises on unqualified input: callers gate on
+    :func:`serve_qualify` first."""
+    import jax.random as jr
+
+    A = jnp.asarray(A)
+    n_axis = 2 if rowwise else 1
+    n, m = A.shape[n_axis], A.shape[3 - n_axis]
+    lane = jax.ShapeDtypeStruct(
+        (m, n) if rowwise else (n, m), A.dtype)
+    mt = _qualify(dist, lane, seq_axis=1 if rowwise else 0,
+                  m_tile=m_tile or _DEFAULT_M_TILE(),
+                  interpret=interpret, s_dim=s_dim)
+    if mt is None:
+        raise ValueError(
+            f"batched dense kernel unqualified for s_dim={s_dim} "
+            f"shape {A.shape}")
+    if precision is None:
+        precision = _default_precision()
+    n_p, m_p = _padded_extents(n, m, mt)
+    pads = [(0, 0), (0, 0), (0, 0)]
+    pads[n_axis] = (0, n_p - n)
+    pads[3 - n_axis] = (0, m_p - m)
+    Ap = jnp.pad(A, pads) if (n_p != n or m_p != m) else A
+    B = A.shape[0]
+    keys = jax.vmap(
+        lambda k: _block_keys(jr.wrap_key_data(k), n))(
+            jnp.asarray(key_data, jnp.uint32))
+    out = _batched_call(
+        Ap, keys.reshape(B * keys.shape[1], 2),
+        jnp.asarray(scale, jnp.float32).reshape(B),
+        s_dim=s_dim, dist_kind=_DIST_KINDS[type(dist)], m_tile=mt,
+        precision=precision, rowwise=rowwise, interpret=interpret)
+    return out[:, :m, :] if rowwise else out[:, :, :m]

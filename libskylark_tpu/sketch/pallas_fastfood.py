@@ -219,6 +219,139 @@ def _launch_split(X, bdiag, perms, gdiag, smdiag, shifts, mt, NB, nb,
     )(Wg, gdiag, smdiag, shifts, Ha, Hb)
 
 
+def _kernel_batched(mt, NB, precision, scale,
+                    x_ref, bdiag_ref, perm_ref, gdiag_ref, smdiag_ref,
+                    shift_ref, ha_ref, hb_ref, out_ref):
+    """Batched-cohort grid step: the SAME fused chain (shared stage
+    helpers) with the microbatch lane as the leading grid axis — refs
+    carry one lane's block, indexed off their unit batch dim."""
+    Ha, Hb = ha_ref[:], hb_ref[:]
+    W = _stage_pre(x_ref[0], bdiag_ref[0], Ha, Hb, mt, NB, precision)
+    W = jnp.take_along_axis(W, perm_ref[0], axis=1)
+    out_ref[:] = _stage_post(
+        W, gdiag_ref[0], smdiag_ref[0], shift_ref[0], Ha, Hb,
+        mt, NB, precision, scale,
+    ).astype(out_ref.dtype)[None, None]
+
+
+@functools.partial(jax.jit, static_argnames=("mt", "NB", "nb",
+                                             "precision", "scale",
+                                             "interpret"))
+def _launch_batched(X, bdiag, perms, gdiag, smdiag, shifts, mt, NB, nb,
+                    precision, scale, interpret):
+    """One pallas_call over a stacked cohort: X (B, m_p, NB), per-lane
+    diagonal/permutation/shift streams (B, nb, NB). Grid (B, nb,
+    m-tiles) — batch lanes tile innermost against the same VMEM plan
+    as the single-request launcher (one lane's chain working set per
+    step; ``plan_m_tile`` unchanged)."""
+    from libskylark_tpu.sketch.fut import _hadamard_np
+
+    B = X.shape[0]
+    n_tiles = X.shape[1] // mt
+    a, b = _wht_split(NB)
+    Ha = jnp.asarray(_hadamard_np(a), jnp.float32)
+    Hb = jnp.asarray(_hadamard_np(b), jnp.float32)
+    diag_spec = pl.BlockSpec((1, 1, NB), lambda i, blk, t: (i, blk, 0))
+    return pl.pallas_call(
+        functools.partial(_kernel_batched, mt, NB, precision, scale),
+        grid=(B, nb, n_tiles),
+        in_specs=[
+            pl.BlockSpec((1, mt, NB), lambda i, blk, t: (i, t, 0)),
+            diag_spec, diag_spec, diag_spec, diag_spec, diag_spec,
+            pl.BlockSpec((a, a), lambda i, blk, t: (0, 0)),
+            pl.BlockSpec((b, b), lambda i, blk, t: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, mt, NB),
+                               lambda i, blk, t: (i, blk, t, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, nb, X.shape[1], NB), X.dtype),
+        interpret=interpret,
+    )(X, bdiag, perms, gdiag, smdiag, shifts, Ha, Hb)
+
+
+def serve_qualify(n_dim: int, s_dim: int, m: int, dtype, fut: str,
+                  interpret: bool = False) -> tuple[bool, str]:
+    """Host-side qualification for the batched serve launcher:
+    (ok, reason) — mirrors :func:`supported` for the stacked-cohort
+    case (the serve layer's decline counter wants the why)."""
+    from libskylark_tpu.sketch.frft import block_geometry
+
+    if not _PALLAS:
+        return False, "pallas unavailable"
+    if not interpret and not available():
+        return False, "backend is not a TPU (interpret-mode only here)"
+    if fut != "wht":
+        return False, f"fut {fut!r} has no kernel (WHT core only)"
+    NB, _nb = block_geometry(n_dim, s_dim, fut)
+    if NB < 512 or NB & (NB - 1):
+        return False, f"NB={NB} outside the MXU-matmul regime (>=512 pow2)"
+    if jnp.dtype(dtype) != jnp.float32:
+        return False, f"dtype {jnp.dtype(dtype).name} != float32"
+    if plan_m_tile(NB, max(int(m), 8)) is None:
+        return False, "no m-tile fits the VMEM budget"
+    return True, "ok"
+
+
+def serve_features_batched(key_data, A, *, n_dim: int, s_dim: int,
+                           fut: str = "wht", sm_kind: str = "ones",
+                           sm_param=None,
+                           precision: str | None = None,
+                           interpret: bool = False) -> jnp.ndarray:
+    """Batched fused Fastfood chain for a microbatch flush: the
+    stacked-cohort analog of :func:`features_rows`, fully traceable
+    (compiled into the bucket's batched executable by engine/serve).
+    ``key_data`` (B, 2) uint32, ``A`` (B, m, n_dim). Per-lane streams
+    are rebuilt inline from the raw keys (``frft.serve_streams`` — the
+    bit-pinned pure form), so one kernel serves transforms differing
+    only by seed. Raises on unqualified input: callers gate on
+    :func:`serve_qualify` first."""
+    import math
+
+    import jax.random as jr
+
+    from libskylark_tpu.sketch.frft import block_geometry, serve_streams
+    from libskylark_tpu.sketch.fut import make_fut
+
+    A = jnp.asarray(A)
+    B, m, d = A.shape
+    if d != n_dim:
+        raise ValueError(f"operand cols {d} != n_dim {n_dim}")
+    NB, nb = block_geometry(n_dim, s_dim, fut)
+    mt = plan_m_tile(NB, max(m, 8))
+    if mt is None:
+        raise ValueError(f"no VMEM plan for NB={NB}")
+    if precision is None:
+        precision = "bf16x3"
+    dt = A.dtype
+    fut_obj = make_fut(fut, NB)
+    scal = math.sqrt(NB) * fut_obj.scale()
+
+    def lane_streams(kd):
+        bd, gd, sm, pm, sh = serve_streams(
+            jr.wrap_key_data(kd), dt, NB=NB, nb=nb, s_dim=s_dim,
+            sm_kind=sm_kind, sm_param=sm_param)
+        # shifts indexed by final feature position; features past S are
+        # computed then sliced — pad their shifts with zeros (same
+        # epilogue as features_rows)
+        sh = jnp.pad(sh, (0, nb * NB - s_dim)).reshape(nb, NB)
+        return (bd, pm.astype(jnp.int32), scal * gd,
+                scal * sm.reshape(nb, NB), sh)
+
+    bdiag, perms, gdiag, smdiag, shifts = jax.vmap(lane_streams)(
+        jnp.asarray(key_data, jnp.uint32))
+
+    pad_rows = (-m) % mt
+    pad_cols = NB - d
+    Ap = (jnp.pad(A, ((0, 0), (0, pad_rows), (0, pad_cols)))
+          if pad_rows or pad_cols else A)
+    F = _launch_batched(Ap, bdiag, perms, gdiag, smdiag, shifts,
+                        mt=mt, NB=NB, nb=nb, precision=precision,
+                        scale=float(math.sqrt(2.0 / s_dim)),
+                        interpret=interpret)
+    # (B, nb, m_p, NB) → block-major feature order, un-pad, truncate
+    return jnp.moveaxis(F, 1, 2).reshape(B, Ap.shape[1], nb * NB)[
+        :, :m, :s_dim]
+
+
 def supported(transform, A) -> bool:
     """Whether the fused kernel may serve this FastRFT apply: WHT core
     in its MXU-matmul regime, f32 single-device eager input (sharded

@@ -1,0 +1,550 @@
+"""Pallas TPU kernel: scatter-free CWT/CountSketch apply.
+
+The hash sketch is the framework's cheapest transform — O(nnz) work, one
+±1 multiply and one add per input coordinate — yet it was the LEAST
+kernel-optimized: ``HashTransform.apply`` / ``hash.cwt_serve_apply`` are
+``jax.ops.segment_sum`` scatters, which XLA lowers to a serialized
+scatter-add on every backend (the TPU scatter unit retires one update
+row at a time, so the MXU idles through the whole apply). Per the
+FlashSketch sketch-kernel co-design line (PAPERS.md), this kernel
+replaces the scatter with MXU work it can pipeline:
+
+1. **On-the-fly stream generation.** The (h, v) bucket/value streams are
+   regenerated in-kernel from the transform's raw Threefry key — the
+   same discipline as ``pallas_dense._gen_block``, but replicating
+   ``randgen.stream_slice``'s *chunk* format (jax.random's own
+   fold_in/split/randint/rademacher pipeline, spelled out in the shared
+   integer-op cipher of ``base/threefry.py``) so the kernel's streams
+   are **bit-identical** to the XLA path's. The per-chunk derived keys
+   (a handful of tiny fold_in/split ciphers) are precomputed by the
+   traced wrapper into an SMEM table (:func:`chunk_key_table`); the
+   per-entry work (one or two 2048-wide Threefry sweeps + the
+   ``randint`` modular math + a sign map) runs in VMEM per grid step.
+
+2. **Bucket-tiled one-hot contraction** (``accum="mxu"``, the TPU fast
+   path): each 128-entry row of the generated chunk becomes a signed
+   one-hot matrix ``Hv`` (s_dim × 128) contracted against the matching
+   input rows on the MXU — the sketch *is* a matmul against a matrix the
+   kernel never stores globally. f32 operands at ``Precision.HIGHEST``;
+   the one-hot entries and ±1 values are exact, so only the contraction
+   ORDER differs from the scatter — last-ulp differences on float data,
+   bit-equal on any data whose bucket sums are exact (the lattice-valued
+   battery in tests/test_pallas_hash.py pins the whole dataflow bitwise
+   this way).
+
+3. **Exact sequential accumulation** (``accum="exact"``): a fori_loop
+   masked-broadcast add that reproduces the scatter's
+   increasing-coordinate accumulation order term by term — **bit-equal
+   to ``HashTransform.apply`` and ``cwt_serve_apply``** including
+   zero-padded serve lanes (padded coordinates contribute exact ±0.0,
+   which can never flip an accumulator bit). This is the interpret-mode
+   correctness surface CPU tier-1 pins and the CI serve gate's
+   bit-equality leg; it is VPU-serial over coordinates, so the
+   autotuner never selects it for throughput (on TPU the mxu mode
+   serves; on CPU the tuner correctly keeps XLA).
+
+The batched entry point (:func:`cwt_apply_batched`) adds a leading
+cohort dimension as a grid axis — one ``pallas_call`` flushes a whole
+microbatch cohort (``engine/serve.py``) instead of vmap-of-XLA — with
+the same shrink-don't-fail VMEM planning as ``pallas_dense._qualify``.
+Lanes are computed independently at fixed tile sizes, so per-lane bits
+are invariant to the capacity class, which is the serve layer's lane-
+invariance contract.
+
+Non-finite caveat: the scatter touches only bucket ``h[j]`` with row
+``j``, while both kernel modes multiply every bucket by a 0/±1 mask —
+``0 · inf = nan``, so a non-finite input coordinate poisons all buckets
+of its output column, not just its own. Finite inputs are unaffected.
+
+Like every kernel in this tree, dispatch DECLINES (returns None /
+``qualify`` explains why) rather than failing: callers keep the XLA
+scatter. Mosaic has no certified on-chip precedent for this kernel yet
+(the bench tunnel is down — ROADMAP); until a live window certifies it,
+only an explicit override or a measured plan-cache entry routes serve
+traffic here, and a Mosaic rejection at compile time falls back.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from libskylark_tpu.base import randgen
+from libskylark_tpu.base import threefry as tf
+
+try:  # same import seam as pallas_dense: non-TPU builds may lack pallas
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams")
+
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+from libskylark_tpu.sketch.pallas_dense import (_VMEM_BUDGET_BYTES,
+                                                available)
+
+# Stream chunk width — randgen's CHUNK is part of the stream format; the
+# kernel's n-axis tile is one chunk (or a pow2 prefix of one).
+CHUNK = randgen.CHUNK
+
+# jax.random materializes a chunk's 32-bit draws as threefry2x32 over
+# counter pairs (j, j + CHUNK//2): position j < half rides the cipher's
+# first output lane, position j + half the second. Fixed by the format.
+_HALF = CHUNK // 2
+
+# Lane width of the in-kernel generation grid: chunk positions are laid
+# out row-major over (rows, _GEN_COLS) so every Threefry/randint op is a
+# native 2-D vector op (Mosaic has no 1-D iota).
+_GEN_COLS = 128
+
+# Default rows-per-grid-step of the non-contracted axis; shrunk (never
+# failed) against the VMEM budget like pallas_dense's m-tile.
+_DEFAULT_M_TILE = 256
+
+_MODES = ("mxu", "exact")
+
+
+# ---------------------------------------------------------------------------
+# stream replication: host/XLA side (tiny per-chunk key table)
+# ---------------------------------------------------------------------------
+
+
+def chunk_key_table(key, n_chunks: int) -> jnp.ndarray:
+    """(n_chunks, 6) uint32 table of the derived keys the kernel needs
+    per stream chunk: the ``randint`` split pair for the bucket stream
+    (sub-stream 0) and the chunk key for the value stream (sub-stream
+    1). Exactly the keys ``randgen.stream_slice`` derives via
+    ``fold_in(fold_in(subkey, hi), lo)`` (hi == 0 below 2³¹ chunks) and
+    ``jax.random`` derives inside ``randint`` — a few 2-wide ciphers
+    per chunk, traced and vmappable (the serve executable computes the
+    whole cohort's tables inline)."""
+    import jax.random as jr
+
+    hkey = jr.fold_in(key, 0)
+    vkey = jr.fold_in(key, 1)
+
+    def one(c):
+        hck = jr.fold_in(jr.fold_in(hkey, 0), c)
+        k1, k2 = jr.split(hck)
+        vck = jr.fold_in(jr.fold_in(vkey, 0), c)
+        return jnp.concatenate([
+            jr.key_data(k1), jr.key_data(k2), jr.key_data(vck),
+        ]).astype(jnp.uint32)
+
+    return jax.vmap(one)(jnp.arange(n_chunks, dtype=jnp.int32))
+
+
+def _randint_multiplier(s_dim: int) -> int:
+    """jax.random.randint's double-draw modular multiplier for span
+    ``s_dim`` — static Python math. Zero exactly when 2¹⁶ % span == 0
+    (every pow2 span ≤ 2¹⁶), where the high draw cancels and the
+    kernel can skip its cipher."""
+    m = (1 << 16) % s_dim
+    return (m * m) % s_dim
+
+
+# ---------------------------------------------------------------------------
+# in-kernel generation
+# ---------------------------------------------------------------------------
+
+
+def _chunk_bits(k0, k1, rows: int, cols: int, both: bool):
+    """uint32 draws for the leading ``rows*cols`` (× 2 when ``both``)
+    positions of one chunk, row-major (rows, cols) — the
+    ``random_bits(key, 32, (CHUNK,))`` layout: counter pairs
+    (j, j + _HALF) with position j on the first cipher lane and
+    position j + _HALF on the second."""
+    c = (
+        jax.lax.broadcasted_iota(jnp.uint32, (rows, cols), 0) * cols
+        + jax.lax.broadcasted_iota(jnp.uint32, (rows, cols), 1)
+    )
+    x0, x1 = tf.threefry2x32(k0, k1, c, c + _HALF)
+    if both:
+        return jnp.concatenate([x0, x1], axis=0)
+    return x0
+
+
+def _gen_hv(keys_ref, kidx, s_dim: int, length: int, cols: int):
+    """(h, v) for the leading ``length`` positions of chunk ``kidx`` of
+    the key table, as row-major (length // cols, cols) grids — h the
+    int32 bucket stream (``UniformInt(0, s_dim-1)``), v the ±1 f32
+    value stream (``Rademacher``), both bit-identical to
+    ``randgen.stream_slice`` (tests pin this through an identity-input
+    apply)."""
+    cipher_rows = min(length, _HALF) // cols
+    both = length > _HALF
+    mult = _randint_multiplier(s_dim)
+    lo = _chunk_bits(keys_ref[kidx, 2], keys_ref[kidx, 3],
+                     cipher_rows, cols, both)
+    if mult == 0:
+        mixed = _mod_span(lo, s_dim)
+    else:
+        hi = _chunk_bits(keys_ref[kidx, 0], keys_ref[kidx, 1],
+                         cipher_rows, cols, both)
+        mixed = _mod_span(
+            _mod_span(hi, s_dim) * mult + _mod_span(lo, s_dim), s_dim)
+    h = mixed.astype(jnp.int32)
+    vbits = _chunk_bits(keys_ref[kidx, 4], keys_ref[kidx, 5],
+                        cipher_rows, cols, both)
+    v = tf.bits_to_rademacher(vbits)
+    return h, v
+
+
+def _mod_span(x, s_dim: int):
+    """x % s_dim on uint32 — a lane mask for pow2 spans (the common
+    serve case; Mosaic-native), the general remainder otherwise."""
+    if s_dim & (s_dim - 1) == 0:
+        return x & (s_dim - 1)
+    return x % s_dim
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+
+def _mxu_rows(h, v, s_dim: int, cols: int, rows: int, contract):
+    """Σ over generation rows of the signed-one-hot contraction:
+    ``contract(Hv, r)`` supplies each row's dot against the matching
+    input slice. The one-hot build is pure VPU compare/select; the
+    contraction is the MXU's."""
+    acc = None
+    for r in range(rows):
+        hr = h[r:r + 1, :]
+        vr = v[r:r + 1, :]
+        onehot = (jax.lax.broadcasted_iota(jnp.int32, (s_dim, cols), 0)
+                  == hr).astype(jnp.float32)
+        part = contract(onehot * vr, r)
+        acc = part if acc is None else acc + part
+    return acc
+
+
+def _hot_dot(lhs, rhs, dims):
+    return jax.lax.dot_general(
+        lhs, rhs, dims, precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+
+
+def _kernel_cw(s_dim, n_tile, n_chunks, cols, accum, keys_ref, a_ref,
+               out_ref):
+    """Columnwise: out[b] (s_dim, m_tile) += CWT over one chunk of
+    a[b] (n_tile, m_tile). Grid (batch, m_tiles, n_chunks); the chunk
+    axis is sequential (accumulation), batch/m parallel."""
+    b = pl.program_id(0)
+    c = pl.program_id(2)
+
+    @pl.when(c == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    h, v = _gen_hv(keys_ref, b * n_chunks + c, s_dim, n_tile, cols)
+    if accum == "mxu":
+        A = a_ref[0]
+
+        def contract(hv, r):
+            return _hot_dot(hv, A[r * cols:(r + 1) * cols, :],
+                            (((1,), (0,)), ((), ())))
+
+        out_ref[:] += _mxu_rows(h, v, s_dim, cols, n_tile // cols,
+                                contract)[None]
+    else:
+        # exact scatter order: one coordinate at a time, increasing j —
+        # the mask lanes contribute ±0.0, which never perturbs a sum
+        iota_s = jax.lax.broadcasted_iota(jnp.int32, (s_dim, 1), 0)
+
+        def body(j, _):
+            r = j // cols
+            col = j % cols
+            hj = jax.lax.dynamic_slice(h, (r, col), (1, 1))
+            vj = jax.lax.dynamic_slice(v, (r, col), (1, 1))
+            arow = a_ref[0, pl.ds(j, 1), :]
+            mask = (iota_s == hj).astype(jnp.float32)
+            out_ref[:] += (mask * (vj * arow))[None]
+            return 0
+
+        jax.lax.fori_loop(0, n_tile, body, 0)
+
+
+def _kernel_rw(s_dim, n_tile, n_chunks, cols, accum, keys_ref, a_ref,
+               out_ref):
+    """Rowwise orientation: out[b] (m_tile, s_dim) += a[b] (m_tile,
+    n_tile) · signed-one-hot."""
+    b = pl.program_id(0)
+    c = pl.program_id(2)
+
+    @pl.when(c == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    h, v = _gen_hv(keys_ref, b * n_chunks + c, s_dim, n_tile, cols)
+    if accum == "mxu":
+        A = a_ref[0]
+
+        def contract(hv, r):
+            return _hot_dot(A[:, r * cols:(r + 1) * cols], hv,
+                            (((1,), (1,)), ((), ())))
+
+        out_ref[:] += _mxu_rows(h, v, s_dim, cols, n_tile // cols,
+                                contract)[None]
+    else:
+        iota_s = jax.lax.broadcasted_iota(jnp.int32, (1, s_dim), 1)
+
+        def body(j, _):
+            r = j // cols
+            col = j % cols
+            hj = jax.lax.dynamic_slice(h, (r, col), (1, 1))
+            vj = jax.lax.dynamic_slice(v, (r, col), (1, 1))
+            acol = a_ref[0, :, pl.ds(j, 1)]
+            mask = (iota_s == hj).astype(jnp.float32)
+            out_ref[:] += (acol * (vj * mask))[None]
+            return 0
+
+        jax.lax.fori_loop(0, n_tile, body, 0)
+
+
+# ---------------------------------------------------------------------------
+# planning + launch
+# ---------------------------------------------------------------------------
+
+
+def _pad_to(x: int, mult: int) -> int:
+    return -(-x // mult) * mult
+
+
+def _padded_n(n: int) -> int:
+    """Stream-axis extent the kernel runs at: next pow2 (min 8) below
+    one chunk, else the next whole-chunk multiple. Zero-padding is
+    exact — padded coordinates carry real stream values but multiply
+    zero data."""
+    if n <= 8:
+        return 8
+    if n < CHUNK:
+        return 1 << (n - 1).bit_length()
+    return _pad_to(n, CHUNK)
+
+
+def _vmem_estimate(m_tile: int, s_dim: int, n_tile: int) -> int:
+    """Per-grid-step VMEM plan: double-buffered input tile and output
+    accumulator, the generated h/v grids and cipher temporaries
+    (~6 chunk-sized u32/f32 arrays), and the (s_dim × _GEN_COLS)
+    one-hot."""
+    return 4 * (
+        2 * n_tile * m_tile
+        + 2 * s_dim * m_tile
+        + 6 * n_tile
+        + 2 * s_dim * _GEN_COLS
+    )
+
+
+def plan_tiles(n: int, m: int, s_dim: int,
+               m_tile: Optional[int] = None) -> Optional[tuple]:
+    """(n_pad, n_tile, m_pad, m_tile) under the VMEM budget, or None
+    when even the minimum tile doesn't fit — shrink-don't-fail, the
+    same discipline as ``pallas_dense._qualify``."""
+    n_pad = _padded_n(n)
+    n_tile = min(n_pad, CHUNK)
+    mt = m_tile or _DEFAULT_M_TILE
+    mt = max(8, 1 << (max(int(mt), 8).bit_length() - 1))
+    while mt > 8 and _vmem_estimate(mt, s_dim, n_tile) > _VMEM_BUDGET_BYTES:
+        mt //= 2
+    if _vmem_estimate(mt, s_dim, n_tile) > _VMEM_BUDGET_BYTES:
+        return None
+    m_pad = _pad_to(max(m, 8), mt)
+    mt = min(mt, m_pad)
+    while m_pad % mt:
+        mt //= 2
+    return n_pad, n_tile, m_pad, mt
+
+
+def qualify(s_dim: int, n: int, m: int, dtype,
+            interpret: bool = False,
+            accum: str = "mxu") -> tuple[bool, str]:
+    """Host-side qualification: (ok, reason). The serve layer counts
+    declined reasons (``serve.kernel_declined``) so operators can see
+    WHY a replica is not on the fast path."""
+    if accum not in _MODES:
+        return False, f"unknown accum mode {accum!r}"
+    if not _HAVE_PALLAS:
+        return False, "pallas unavailable"
+    if not interpret and not available():
+        return False, "backend is not a TPU (interpret-mode only here)"
+    if jnp.dtype(dtype) != jnp.float32:
+        return False, f"dtype {jnp.dtype(dtype).name} != float32"
+    if s_dim < 1 or n < 1 or m < 1:
+        return False, "degenerate shape"
+    if plan_tiles(n, m, s_dim) is None:
+        return False, "no tile fits the VMEM budget"
+    return True, "ok"
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("s_dim", "rowwise", "accum", "m_tile", "interpret"),
+)
+def _hash_call(A, keys, *, s_dim, rowwise, accum, m_tile, interpret):
+    """One pallas_call over the stacked (B, ...) operand (already
+    padded). ``keys`` is the flattened (B * n_chunks, 6) chunk-key
+    table."""
+    B = A.shape[0]
+    n = A.shape[2] if rowwise else A.shape[1]
+    m = A.shape[1] if rowwise else A.shape[2]
+    n_tile = min(n, CHUNK)
+    n_chunks = n // n_tile
+    cols = min(n_tile, _GEN_COLS)
+    grid = (B, m // m_tile, n_chunks)
+    params = _CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    if rowwise:
+        kern = functools.partial(_kernel_rw, s_dim, n_tile, n_chunks,
+                                 cols, accum)
+        a_spec = pl.BlockSpec((1, m_tile, n_tile),
+                              lambda b, i, c: (b, i, c),
+                              memory_space=pltpu.VMEM)
+        out_spec = pl.BlockSpec((1, m_tile, s_dim),
+                                lambda b, i, c: (b, i, 0),
+                                memory_space=pltpu.VMEM)
+        out_shape = jax.ShapeDtypeStruct((B, m, s_dim), jnp.float32)
+    else:
+        kern = functools.partial(_kernel_cw, s_dim, n_tile, n_chunks,
+                                 cols, accum)
+        a_spec = pl.BlockSpec((1, n_tile, m_tile),
+                              lambda b, i, c: (b, c, i),
+                              memory_space=pltpu.VMEM)
+        out_spec = pl.BlockSpec((1, s_dim, m_tile),
+                                lambda b, i, c: (b, 0, i),
+                                memory_space=pltpu.VMEM)
+        out_shape = jax.ShapeDtypeStruct((B, s_dim, m), jnp.float32)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # whole key table
+            a_spec,
+        ],
+        out_specs=out_spec,
+        out_shape=out_shape,
+        compiler_params=params,
+        interpret=interpret,
+    )(keys, A)
+
+
+def cwt_apply_batched(key_data, A, *, s_dim: int, rowwise: bool,
+                      accum: str = "mxu",
+                      m_tile: Optional[int] = None,
+                      interpret: bool = False) -> jnp.ndarray:
+    """Batched scatter-free CountSketch: one kernel over a stacked
+    cohort. ``key_data`` (B, 2) uint32 raw keys (one transform per
+    lane), ``A`` (B, n, m) columnwise / (B, m, n) rowwise. Fully
+    traceable — the serve layer calls this inside its engine-compiled
+    batched executable. Raises on unqualified input (callers gate on
+    :func:`qualify` first); per-lane bits are capacity-invariant
+    because every lane runs the same fixed-tile program."""
+    import jax.random as jr
+
+    if accum not in _MODES:
+        raise ValueError(f"accum must be one of {_MODES}, got {accum!r}")
+    A = jnp.asarray(A)
+    kd = jnp.asarray(key_data, jnp.uint32)
+    B = A.shape[0]
+    n_axis = 2 if rowwise else 1
+    n, m = A.shape[n_axis], A.shape[3 - n_axis]
+    plan = plan_tiles(n, m, s_dim, m_tile)
+    if plan is None:
+        raise ValueError(
+            f"no VMEM plan for s_dim={s_dim} n={n} m={m}")
+    n_pad, n_tile, m_pad, mt = plan
+    pads = [(0, 0), (0, 0), (0, 0)]
+    pads[n_axis] = (0, n_pad - n)
+    pads[3 - n_axis] = (0, m_pad - m)
+    Ap = jnp.pad(A, pads) if (n_pad != n or m_pad != m) else A
+    n_chunks = n_pad // n_tile
+    keys = jax.vmap(
+        lambda k: chunk_key_table(jr.wrap_key_data(k), n_chunks))(kd)
+    out = _hash_call(Ap, keys.reshape(B * n_chunks, 6), s_dim=s_dim,
+                     rowwise=rowwise, accum=accum, m_tile=mt,
+                     interpret=interpret)
+    return out[:, :m, :] if rowwise else out[:, :, :m]
+
+
+def cwt_apply(key_data, A, *, s_dim: int, rowwise: bool,
+              accum: str = "mxu", m_tile: Optional[int] = None,
+              interpret: bool = False) -> jnp.ndarray:
+    """Single-request form: the batched kernel at B == 1 (bit-identical
+    lanes either way). Same contract as ``hash.cwt_serve_apply`` —
+    zero-padding the operand past the transform's true N leaves the
+    result bit-equal (``accum="exact"``) / ulp-close (``"mxu"``)."""
+    A = jnp.asarray(A)
+    kd = jnp.asarray(key_data, jnp.uint32).reshape(1, 2)
+    out = cwt_apply_batched(kd, A[None], s_dim=s_dim, rowwise=rowwise,
+                            accum=accum, m_tile=m_tile,
+                            interpret=interpret)
+    return out[0]
+
+
+def try_apply(transform, A, *, rowwise: bool) -> Optional[jnp.ndarray]:
+    """Direct-apply dispatch hook for ``HashTransform``: run the kernel
+    when (a) it's a CWT on a qualifying f32 single-device operand on a
+    TPU backend, and (b) an explicit override (``SKYLARK_HASH_KERNEL``
+    = pallas | pallas_exact) or a measured plan-cache entry picks it.
+    Returns None to decline — the caller keeps the XLA scatter. The
+    conservative default (no plan, no override → decline) matches the
+    module's not-yet-on-chip-certified status."""
+    import os
+
+    from libskylark_tpu.sketch import params as sketch_params
+
+    if type(transform).__name__ != "CWT":
+        return None
+    if not sketch_params.get_use_pallas():
+        return None
+    from libskylark_tpu.sketch.dense import pallas_ambient_ok
+
+    if not pallas_ambient_ok(A):
+        return None
+    accum = None
+    env = os.environ.get("SKYLARK_HASH_KERNEL")
+    if env is not None:
+        env = env.strip().lower()
+        if env in ("pallas", "mxu", "1"):
+            accum = "mxu"
+        elif env in ("pallas_exact", "exact"):
+            accum = "exact"
+        else:
+            return None  # explicit xla/off
+    elif sketch_params.get_use_plan_cache():
+        try:
+            from libskylark_tpu import tune
+
+            w = tune.hash_workload(
+                "CWT", A.shape, A.dtype, transform.sketch_dim,
+                seq_axis=1 if rowwise else 0)
+            plan = tune.plan_for(w)
+        except Exception:
+            plan = None
+        if plan is not None and plan.backend == "pallas":
+            accum = "mxu"
+    if accum is None:
+        return None
+    n = A.shape[1] if rowwise else A.shape[0]
+    m = A.shape[0] if rowwise else A.shape[1]
+    ok, _why = qualify(transform.sketch_dim, n, m, A.dtype)
+    if not ok:
+        return None
+    import numpy as np
+
+    kd = np.asarray(jax.random.key_data(transform.allocation.key),
+                    dtype=np.uint32)
+    try:
+        return cwt_apply(kd, A, s_dim=transform.sketch_dim,
+                         rowwise=rowwise, accum=accum)
+    except Exception:  # noqa: BLE001 — decline, don't fail (module
+        # contract): Mosaic rejects as JaxRuntimeError, the Pallas
+        # lowering rules as trace-time NotImplementedError /
+        # LoweringError — all mean "keep the XLA scatter"
+        return None

@@ -39,9 +39,10 @@ __all__ = [
     "Plan", "PlanCache", "Workload", "analyze_jitted", "autotune_topk",
     "bucket_dim", "current_device_kind", "default_cache_path",
     "dense_workload", "enumerate_candidates", "fastfood_workload",
-    "get_cache", "normalize_device_kind", "plan_cost", "plan_for",
-    "plan_fingerprint", "rank_candidates", "rank_plans",
-    "record_measurement", "set_cache", "RATES",
+    "get_cache", "hash_workload", "normalize_device_kind", "plan_cost",
+    "plan_for", "plan_fingerprint", "rank_candidates", "rank_plans",
+    "record_measurement", "record_ranked", "serve_workload",
+    "set_cache", "RATES",
 ]
 
 
@@ -83,6 +84,47 @@ def fastfood_workload(transform_type: str, shape, dtype, s_dim: int, *,
                                  int(s_dim)))
 
 
+def hash_workload(sketch_type: str, shape, dtype, s_dim: int,
+                  seq_axis: int, *,
+                  device_kind: Optional[str] = None) -> Workload:
+    """Workload for a hash-sketch (CWT/CountSketch) direct apply —
+    the scatter-free kernel (sketch/pallas_hash.py) vs the XLA
+    ``segment_sum`` scatter. ``shape`` is the 2-D input's shape;
+    ``seq_axis`` its contracted (hashed) axis."""
+    m = int(shape[1 - seq_axis])
+    n = int(shape[seq_axis])
+    op = "hash_rowwise" if seq_axis == 1 else "hash_columnwise"
+    return Workload(
+        device_kind=device_kind or current_device_kind(),
+        op=op, transform=str(sketch_type), dtype=str(dtype),
+        shape=(m, n, int(s_dim)))
+
+
+def serve_workload(endpoint: str, family: str, dtype, lane_shape,
+                   s_dim: int, capacity: int, *, rowwise: bool = True,
+                   device_kind: Optional[str] = None) -> Workload:
+    """Workload for one microbatch serve bucket (engine/serve.py flush
+    builders): a batched-kernel-vs-vmapped-XLA decision per (endpoint /
+    orientation, transform family, dtype, pow2 lane shape class, batch
+    capacity class). ``lane_shape`` is ONE lane's padded class shape
+    ((m, n) rowwise / (n, m) columnwise for sketch_apply; (m, n_dim)
+    for fastfood_features); ``capacity`` the pow2 batch class."""
+    if endpoint == "sketch_apply":
+        op = "serve_sketch_rw" if rowwise else "serve_sketch_cw"
+        m = int(lane_shape[0]) if rowwise else int(lane_shape[1])
+        n = int(lane_shape[1]) if rowwise else int(lane_shape[0])
+    elif endpoint == "fastfood_features":
+        op = "serve_fastfood"
+        m, n = int(lane_shape[0]), int(lane_shape[1])
+    else:
+        raise ValueError(
+            f"endpoint {endpoint!r} has no serve-bucket workload")
+    return Workload(
+        device_kind=device_kind or current_device_kind(),
+        op=op, transform=str(family), dtype=str(dtype),
+        shape=(m, n, int(s_dim)), batch=int(capacity))
+
+
 # -- the three public verbs --
 
 def plan_for(w: Workload) -> Optional[Plan]:
@@ -106,6 +148,22 @@ def autotune_topk(w: Workload, k: int = 3,
     """The k plans a live TPU window should measure for ``w``, best
     modeled first — the offline half of the tuner."""
     return [p for p, _ in rank_candidates(w, allow_fast=allow_fast)[:k]]
+
+
+def record_ranked(w: Workload, allow_fast: bool = False):
+    """Offline half of the serve tuner: rank ``w``'s candidates with
+    the hardware-free model and persist the winner as a ``"ranked"``
+    cache entry — never displacing a measured one (a live window's
+    certification always outranks the model). Returns the ``(plan,
+    cost-record)`` winner either way."""
+    plan, cost = rank_candidates(w, allow_fast=allow_fast)[0]
+    cache = get_cache()
+    cur = cache.entry(w)
+    if cur is None or cur.get("source") != "measured":
+        cache.put(w, plan, source="ranked",
+                  extra={"modeled_s": cost["modeled_s"]})
+        cache.save()
+    return plan, cost
 
 
 def record_measurement(w: Workload, plan: Plan, value: float,

@@ -31,7 +31,10 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
-from libskylark_tpu.tune.plans import (FASTFOOD_OPS, Plan, Workload)
+from libskylark_tpu.tune.plans import (FASTFOOD_OPS, HASH_OPS,
+                                       SERVE_DENSE_FAMILIES, SERVE_OPS,
+                                       Plan, Workload,
+                                       normalize_device_kind)
 
 # --------------------------------------------------------------------------
 # compiled-HLO analysis (promoted from benchmarks/hlo_cost.py)
@@ -72,6 +75,11 @@ RATES = {
     # sweeps × 8192·1024 entries × ~50 ops); the model then reproduces
     # the measured f32 regime within ~20%
     "hbm_bytes_per_s": 820e9,    # HBM bandwidth
+    # XLA scatter-add update retire rate: the TPU scatter unit is
+    # row-serial (~1 update row/cycle at ~1 GHz-ish issue) — the cost
+    # structure that makes segment_sum the hash sketch's bottleneck.
+    # Only the ORDER vs the kernel's MXU one-hot contraction matters.
+    "scatter_rows_per_s": 1.2e9,
 }
 
 # VPU ops per generated operator entry: Threefry + inverse-CDF ≈ 50
@@ -118,6 +126,8 @@ def plan_cost(w: Workload, p: Plan, rates: Optional[dict] = None) -> dict:
     m, n, s = w.shape
     if w.op in FASTFOOD_OPS:
         return _fastfood_cost(w, p, rates)
+    if w.op in HASH_OPS or w.op in SERVE_OPS:
+        return _hash_or_serve_cost(w, p, rates)
 
     bytes_moved = 4.0 * (m * n + m * s)
     hbm_s = bytes_moved / rates["hbm_bytes_per_s"]
@@ -150,6 +160,109 @@ def plan_cost(w: Workload, p: Plan, rates: Optional[dict] = None) -> dict:
     modeled = max(hbm_s, compute_s)
     return {"flops": flops, "bytes": bytes_moved,
             "gen_entries": gen_entries, "modeled_s": modeled}
+
+
+def _device_runs_mosaic(device_kind: str) -> bool:
+    """Whether ``device_kind`` compiles Mosaic kernels natively. Off-TPU
+    a "pallas" plan means the pallas *interpreter* — a correctness
+    surface, not a speed surface — so the model must never rank it
+    above any XLA lowering there."""
+    kind = normalize_device_kind(device_kind)
+    return kind.startswith("tpu") or kind.startswith("axon")
+
+
+# Interpret-mode multiplier for pallas plans costed on a non-Mosaic
+# host. The exact value is irrelevant (only ordering is consumed); it
+# just has to dwarf every real kernel-vs-XLA ratio, so the serve tuner
+# on a CPU host ALWAYS certifies the XLA path — the honest outcome the
+# bench record and the CI pallas-serve gate pin.
+INTERPRET_PENALTY = 1e4
+
+
+def _hash_lane_cost(m: int, n: int, s: int, p: Plan,
+                    rates: dict) -> dict:
+    """One CWT/CountSketch lane (m non-contracted, n coordinates,
+    s buckets). XLA: the ``segment_sum`` scatter — n update rows
+    retired serially by the scatter unit, stream generation on the
+    VPU. Pallas: the scatter-free one-hot contraction — 2·m·n·s MXU
+    flops at HIGHEST (~6 bf16 passes), same generation bill, gen and
+    matmul serialized (the hash kernel has no pipelined variant)."""
+    bytes_moved = 4.0 * (m * n + m * s)
+    hbm_s = bytes_moved / rates["hbm_bytes_per_s"]
+    gen_entries = 2.0 * n          # h (bucket) + v (value) streams
+    gen_s = gen_entries * GEN_OPS_PER_ENTRY / rates["vpu_ops_per_s"]
+    if p.backend == "xla":
+        scatter_s = n / rates["scatter_rows_per_s"]
+        return {"flops": 2.0 * n * m, "bytes": bytes_moved,
+                "gen_entries": gen_entries,
+                "modeled_s": max(hbm_s, scatter_s + gen_s)}
+    flops = 2.0 * m * n * s * MXU_PASSES["f32"]
+    mxu_s = flops / rates["mxu_flops_per_s"]
+    return {"flops": flops, "bytes": bytes_moved,
+            "gen_entries": gen_entries,
+            "modeled_s": max(hbm_s, mxu_s + gen_s)}
+
+
+def _serve_dense_lane_cost(m: int, n: int, s: int, p: Plan,
+                           rates: dict) -> dict:
+    """One dense-family serve lane. XLA: materialize the operator +
+    HIGHEST gemm (the vmapped ``serve_apply``). Pallas: the batched
+    fused kernel at bf16x3 — no operator-cache scratch in the batched
+    launcher, so generation is paid once per m-tile sweep and
+    serialized against the MXU."""
+    bytes_moved = 4.0 * (m * n + m * s)
+    if p.backend == "xla":
+        flops = 2.0 * m * n * s * MXU_PASSES["f32"]
+        gen_entries = float(n * s)
+        xla_bytes = bytes_moved + 2.0 * 4.0 * n * s
+        compute_s = (flops / rates["mxu_flops_per_s"]
+                     + gen_entries * GEN_OPS_PER_ENTRY
+                     / rates["vpu_ops_per_s"])
+        return {"flops": flops, "bytes": xla_bytes,
+                "gen_entries": gen_entries,
+                "modeled_s": max(xla_bytes / rates["hbm_bytes_per_s"],
+                                 compute_s)}
+    m_tile = p.m_tile or 256
+    flops = 2.0 * m * n * s * MXU_PASSES["bf16x3"]
+    sweeps = max(1, -(-m // m_tile))
+    gen_entries = float(n * s * sweeps)
+    compute_s = (flops / rates["mxu_flops_per_s"]
+                 + gen_entries * GEN_OPS_PER_ENTRY
+                 / rates["vpu_ops_per_s"])
+    return {"flops": flops, "bytes": bytes_moved,
+            "gen_entries": gen_entries,
+            "modeled_s": max(bytes_moved / rates["hbm_bytes_per_s"],
+                             compute_s)}
+
+
+def _hash_or_serve_cost(w: Workload, p: Plan, rates: dict) -> dict:
+    """Cost record for the hash direct-apply sites and the serve-bucket
+    sites. Serve workloads scale one lane's cost by the batch capacity
+    class (``w.batch``); pallas plans costed for a non-Mosaic device
+    kind carry the interpret-mode penalty, so an offline ranking run on
+    a CPU host correctly certifies XLA for every serve bucket."""
+    if p.backend not in ("pallas", "xla"):
+        raise ValueError(
+            f"unknown {w.op} backend {p.backend!r} (pallas|xla)")
+    m, n, s = w.bucket()
+    if w.op == "serve_fastfood":
+        ff = Plan("fused" if p.backend == "pallas" else "xla_chain",
+                  precision=p.precision)
+        rec = _fastfood_cost(w, ff, rates)
+    elif w.op in HASH_OPS or w.transform == "CWT":
+        rec = _hash_lane_cost(m, n, s, p, rates)
+    elif w.transform in SERVE_DENSE_FAMILIES:
+        rec = _serve_dense_lane_cost(m, n, s, p, rates)
+    else:
+        raise ValueError(
+            f"serve workload family {w.transform!r} has no cost model")
+    lanes = max(int(w.batch), 1) if w.op in SERVE_OPS else 1
+    if lanes > 1:
+        rec = {k: v * lanes for k, v in rec.items()}
+    if p.backend == "pallas" and not _device_runs_mosaic(w.device_kind):
+        rec["modeled_s"] *= INTERPRET_PENALTY
+        rec["interpret"] = True
+    return rec
 
 
 def _fastfood_cost(w: Workload, p: Plan, rates: dict) -> dict:

@@ -43,6 +43,27 @@ FAST_PRECISIONS = ("bf16gen2", "bf16")
 DENSE_OPS = ("dense_rowwise", "dense_columnwise", "rft_rowwise")
 FASTFOOD_OPS = ("fastfood_rows",)
 
+# hash (CWT/CountSketch) direct-apply dispatch sites — the scatter-free
+# kernel (sketch/pallas_hash.py) vs the XLA segment_sum scatter
+HASH_OPS = ("hash_rowwise", "hash_columnwise")
+
+# serve-bucket dispatch sites (engine/serve.py flush builders): one
+# workload per (endpoint/orientation, transform family, dtype, pow2
+# shape class, batch capacity class). The ``batch`` field carries the
+# capacity class; backends are "pallas" (the endpoint's batched kernel
+# — hash, dense, or fused-fastfood) vs "xla" (the vmapped XLA flush).
+SERVE_OPS = ("serve_sketch_cw", "serve_sketch_rw", "serve_fastfood")
+
+# dense-family serve buckets enumerate a small m-tile ladder (the
+# batched kernel's only knob); CWT/fastfood serve kernels are knobless.
+SERVE_DENSE_M_TILES = (128, 256, 512)
+
+# serve families whose sketch operator is a dense virtual stream, and
+# the dense-kernel distribution each maps onto (the serve workload's
+# ``transform`` field carries the FAMILY tag, the cost model prices the
+# underlying stream)
+SERVE_DENSE_FAMILIES = {"JLT": "normal", "CT": "cauchy"}
+
 
 def bucket_dim(x: int) -> int:
     """Next power of two ≥ x (min 8): one cache entry serves the whole
@@ -86,14 +107,20 @@ class Workload:
     transform: str
     dtype: str
     shape: tuple[int, int, int]
+    # batch capacity class (serve workloads only; 0 = not batched).
+    # Appended to the key only when set, so every pre-serve cache key —
+    # including the committed benchmarks/plan_cache.json entries —
+    # is unchanged.
+    batch: int = 0
 
     def bucket(self) -> tuple[int, int, int]:
         return tuple(bucket_dim(d) for d in self.shape)
 
     def key(self) -> str:
         b = "x".join(str(d) for d in self.bucket())
-        return "|".join((normalize_device_kind(self.device_kind),
+        base = "|".join((normalize_device_kind(self.device_kind),
                          self.op, self.transform, str(self.dtype), b))
+        return f"{base}|b{self.batch}" if self.batch else base
 
 
 @dataclasses.dataclass(frozen=True)
@@ -163,12 +190,29 @@ def _fastfood_candidates(precisions: Sequence[str]) -> Iterator[Plan]:
     yield Plan("xla_chain")
 
 
+def _serve_candidates(w: Workload) -> Iterator[Plan]:
+    """Kernel-vs-XLA candidates for one serve bucket. The dense
+    families enumerate the batched kernel's m-tile ladder; the hash and
+    fastfood serve kernels are knobless — precision stays the serve
+    layer's own policy (oracle regimes only), so a committed cache
+    entry can never opt a flush into bf16."""
+    if w.transform in SERVE_DENSE_FAMILIES:
+        m, _n, _s = w.bucket()
+        for mt in SERVE_DENSE_M_TILES:
+            if mt <= max(m, SERVE_DENSE_M_TILES[0]):
+                yield Plan("pallas", m_tile=mt)
+    else:
+        yield Plan("pallas")
+    yield Plan("xla")
+
+
 def enumerate_candidates(w: Workload,
                          allow_fast: bool = False) -> list[Plan]:
     """Every plan worth ranking for ``w``. The dense list crosses
     m-tiles × precision regimes × pipeline on/off, plus the XLA
-    fallback; Fastfood crosses variant × precision plus the XLA chain.
-    ``allow_fast`` adds the accuracy-opt-in regimes (never
+    fallback; Fastfood crosses variant × precision plus the XLA chain;
+    hash and serve buckets cross the scatter-free kernel vs the XLA
+    path. ``allow_fast`` adds the accuracy-opt-in regimes (never
     auto-selected by default — see module doc)."""
     precisions = ORACLE_PRECISIONS + (FAST_PRECISIONS if allow_fast
                                       else ())
@@ -176,4 +220,8 @@ def enumerate_candidates(w: Workload,
         return list(_dense_candidates(w, precisions))
     if w.op in FASTFOOD_OPS:
         return list(_fastfood_candidates(precisions))
+    if w.op in HASH_OPS:
+        return [Plan("pallas"), Plan("xla")]
+    if w.op in SERVE_OPS:
+        return list(_serve_candidates(w))
     raise ValueError(f"unknown workload op {w.op!r}")

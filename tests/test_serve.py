@@ -192,6 +192,99 @@ class TestBitEquality:
             np.testing.assert_allclose(b, ref, rtol=1e-4, atol=1e-5)
 
 
+class TestFastfoodEndpoint:
+    """The Fastfood/RFT feature-map serve endpoint (r12): vmap-safe
+    pure apply + bucket statics, so the fused-chain kernel has real
+    serve traffic. Oracles mirror the sketch_apply ones: lane
+    invariance bitwise, numerical agreement with ``transform.apply``
+    (the vmapped chain may reorder f32 contractions)."""
+
+    def _reqs(self, n_reqs=8, seed=13, n=100, s=64):
+        rng = np.random.default_rng(seed)
+        ctx = Context(seed=seed)
+        T = sk.FastGaussianRFT(n, s, ctx, sigma=2.0)
+        return [(T, rng.standard_normal((2 + i % 4, n))
+                 .astype(np.float32)) for i in range(n_reqs)]
+
+    def test_batched_matches_apply_and_capacity1(self, fresh_engine):
+        reqs = self._reqs()
+        with _executor() as ex:
+            futs = [ex.submit_fastfood(T, A) for (T, A) in reqs]
+            batched = [np.asarray(f.result(timeout=60)) for f in futs]
+        seq = _capacity1_results(
+            reqs, lambda e, T, A: e.submit_fastfood(T, A))
+        for b, s in zip(batched, seq):
+            assert np.array_equal(b, s)       # lane invariance
+        for (T, A), b in zip(reqs, batched):
+            ref = np.asarray(T.apply(jnp.asarray(A), sk.ROWWISE))
+            assert b.shape == ref.shape
+            np.testing.assert_allclose(b, ref, rtol=1e-5, atol=1e-6)
+
+    def test_matern_and_1d_input(self, fresh_engine):
+        rng = np.random.default_rng(17)
+        ctx = Context(seed=17)
+        T = sk.FastMaternRFT(60, 32, ctx, nu=1.5, l=0.8)
+        x = rng.standard_normal((60,)).astype(np.float32)
+        with _executor(linger_us=500) as ex:
+            out = np.asarray(ex.submit_fastfood(T, x).result(timeout=60))
+        ref = np.asarray(T.apply(jnp.asarray(x)[None, :], sk.ROWWISE))
+        assert out.shape == (32,)
+        np.testing.assert_allclose(out, ref[0], rtol=1e-5, atol=1e-6)
+
+    def test_seed_sharing_one_bucket(self, fresh_engine):
+        """Transforms differing only by seed coalesce into ONE bucket
+        (streams rebuild from the stacked raw keys): the second cohort
+        is pure cache hits."""
+        rng = np.random.default_rng(19)
+        ctx = Context(seed=19)
+        Ts = [sk.FastGaussianRFT(80, 32, ctx, sigma=1.5)
+              for _ in range(8)]
+        ops = [rng.standard_normal((3, 80)).astype(np.float32)
+               for _ in range(8)]
+        with _executor(max_batch=4, linger_us=10_000_000) as ex:
+            futs = [ex.submit_fastfood(T, A)
+                    for T, A in zip(Ts[:4], ops[:4])]
+            [f.result(timeout=60) for f in futs]
+            m0 = engine.stats().misses
+            futs = [ex.submit_fastfood(T, A)
+                    for T, A in zip(Ts[4:], ops[4:])]
+            outs = [np.asarray(f.result(timeout=60)) for f in futs]
+        assert engine.stats().misses == m0
+        assert engine.stats().recompiles == 0
+        for T, A, o in zip(Ts[4:], ops[4:], outs):
+            ref = np.asarray(T.apply(jnp.asarray(A), sk.ROWWISE))
+            np.testing.assert_allclose(o, ref, rtol=1e-5, atol=1e-6)
+
+    def test_sigma_separates_buckets(self, fresh_engine):
+        """The Sm spec is a bucket static: transforms with different
+        sigma must not share a cohort (their streams differ by more
+        than the key)."""
+        rng = np.random.default_rng(23)
+        ctx = Context(seed=23)
+        Ta = sk.FastGaussianRFT(40, 16, ctx, sigma=1.0)
+        Tb = sk.FastGaussianRFT(40, 16, ctx, sigma=3.0)
+        A = rng.standard_normal((3, 40)).astype(np.float32)
+        with _executor(linger_us=500) as ex:
+            oa = np.asarray(ex.submit_fastfood(Ta, A).result(timeout=60))
+            ob = np.asarray(ex.submit_fastfood(Tb, A).result(timeout=60))
+        np.testing.assert_allclose(
+            oa, np.asarray(Ta.apply(jnp.asarray(A), sk.ROWWISE)),
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            ob, np.asarray(Tb.apply(jnp.asarray(A), sk.ROWWISE)),
+            rtol=1e-5, atol=1e-6)
+        assert not np.allclose(oa, ob)
+
+    def test_rejects_non_fastfood_and_bad_dim(self, fresh_engine):
+        with _executor() as ex:
+            with pytest.raises(TypeError, match="FastRFT"):
+                ex.submit_fastfood(sk.JLT(32, 8, Context(seed=0)),
+                                   np.zeros((2, 32), np.float32))
+            T = sk.FastGaussianRFT(40, 16, Context(seed=1))
+            with pytest.raises(ValueError, match="input dim"):
+                ex.submit_fastfood(T, np.zeros((2, 39), np.float32))
+
+
 class TestBucketingAndCache:
     def test_one_bucket_for_ragged_class_zero_recompiles(self,
                                                          fresh_engine):
