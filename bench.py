@@ -28,8 +28,10 @@ Other modes: ``--solver`` (engine compile-vs-execute split),
 dispatch, plus the r12 kernel-selection A/B: autotuned per-bucket
 pallas-vs-XLA flush selection against forced XLA, with per-bucket
 outcomes), ``--fleet`` (N-replica router vs single-executor A/B with a
-one-replica drain-failover leg), ``--stamp`` (oracle certification
-line).
+one-replica drain-failover leg), ``--boot`` (fleet-boot cold-start
+A/B: fresh-process time-to-first-result with vs without a warmup pack,
+zero-backend-compile proof — docs/performance), ``--stamp`` (oracle
+certification line).
 
 Each timed iteration consumes the FULL sketch output (the loop carries
 sum(abs(SA)) back into the next input), so XLA cannot dead-code-eliminate
@@ -1160,6 +1162,84 @@ def _fleet(n_requests: int = 64, n_replicas: int = 4,
 
 
 # ---------------------------------------------------------------------------
+# boot-level measurement: cold-start A/B, warmup pack vs fresh compile
+# ---------------------------------------------------------------------------
+
+
+def _boot(capacity: int = 16) -> None:
+    """Fleet-boot cold-start A/B (``python bench.py --boot``;
+    backend-agnostic — run with JAX_PLATFORMS=cpu for the hardware-free
+    record).
+
+    Builds a 2-bucket warmup pack (the ``--serve`` record's JLT class
+    plus a CWT class) in-process, then boots two FRESH python
+    processes serving the same canonical cohorts — one loading the
+    pack (``skylark_warmup boot-probe``), one compiling cold — and
+    records wall-from-spawn time-to-first-result for both, the warm
+    side's zero-backend-compile proof (``compiles == 0`` with every
+    executable arriving as an ``aot_load``), and bit-equality of both
+    sides against the builder's in-process results. Prints exactly one
+    JSON line."""
+    import shutil
+    import tempfile
+
+    from libskylark_tpu.engine import warmup
+
+    pack = tempfile.mkdtemp(prefix="skylark_boot_pack_")
+    try:
+        specs = [
+            # the --serve record's class: JLT rowwise (48..60)x(112..128)
+            # -> pad (64, 128), s=32
+            warmup.BucketSpec(endpoint="sketch_apply", family="JLT",
+                              n=128, m=60, s_dim=32, rowwise=True,
+                              capacities=(capacity,)),
+            warmup.BucketSpec(endpoint="sketch_apply", family="CWT",
+                              n=112, m=12, s_dim=32, rowwise=False,
+                              capacities=(capacity,)),
+        ]
+        manifest = warmup.build_pack(pack, specs)
+
+        # fresh children via the one shared launcher (hermetic env
+        # scrub included), so the bench record and the CI boot gate
+        # (benchmarks/boot_smoke.py) always measure the same thing
+        cold = warmup.spawn_boot_probe(pack, load=False)
+        warm = warmup.spawn_boot_probe(pack, load=True)
+    finally:
+        shutil.rmtree(pack, ignore_errors=True)
+
+    ttfr_cold = cold.get("wall_since_spawn_s")
+    ttfr_warm = warm.get("wall_since_spawn_s")
+    rec = {
+        "metric": "fleet_boot_cold_start",
+        "entries": len(manifest["entries"]),
+        "capacity": capacity,
+        "ttfr_cold_s": ttfr_cold,
+        "ttfr_pack_s": ttfr_warm,
+        "speedup_ttfr": (round(ttfr_cold / ttfr_warm, 4)
+                         if ttfr_cold and ttfr_warm else None),
+        "serve_wall_cold_s": cold.get("t_total_s"),
+        "serve_wall_pack_s": warm.get("t_total_s"),
+        "compiles_cold": cold["engine"]["compiles"],
+        "compile_seconds_cold": cold["engine"]["compile_seconds"],
+        "compiles_pack": warm["engine"]["compiles"],
+        "aot_loads_pack": warm["engine"]["aot_loads"],
+        "load_seconds_pack": warm["engine"]["load_seconds"],
+        "bit_equal_cold": cold["bit_equal"],
+        "bit_equal_pack": warm["bit_equal"],
+        "pack_loaded": (warm.get("warmup") or {}).get("loaded"),
+        "plan_fingerprint": manifest["plan_fingerprint"],
+        "backend": manifest["compat"]["backend"],
+        "host_note": (
+            "wall-from-spawn includes interpreter + jax import, which "
+            "both sides pay equally; the pack side replaces the "
+            "per-bucket XLA compiles with artifact deserializes "
+            "(compile_seconds vs load_seconds above)"),
+    }
+    rec["telemetry"] = _telemetry_snapshot()
+    print(json.dumps(rec), flush=True)
+
+
+# ---------------------------------------------------------------------------
 # parent: bounded orchestration
 # ---------------------------------------------------------------------------
 
@@ -1452,6 +1532,11 @@ if __name__ == "__main__":
         # N-replica router vs single-executor A/B + one-replica drain
         # failover; backend-agnostic, in-process like --serve
         _fleet()
+    elif "--boot" in sys.argv:
+        # fleet-boot cold-start A/B: fresh-process time-to-first-
+        # result with vs without a warmup pack (zero-compile proof +
+        # bit-equality); backend-agnostic
+        _boot()
     elif "--stamp" in sys.argv:
         # the certification line for benchmarks/.tpu_oracle_recert_r*:
         # steps scripts append `$(python bench.py --stamp)` so the stamp

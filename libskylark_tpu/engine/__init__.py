@@ -23,12 +23,16 @@ Public surface::
                                # docs/resilience (r9)
 
 Environment: ``SKYLARK_EXEC_CACHE_SIZE`` (LRU capacity, default 128),
-``SKYLARK_EXEC_CACHE_DIR`` (persistent cross-process cache dir),
+``SKYLARK_AOT_DIR`` (persistent AOT executable-artifact store —
+load-instead-of-compile plus cross-process single-flight; see
+:mod:`libskylark_tpu.engine.aot` and docs/performance),
+``SKYLARK_EXEC_CACHE_DIR`` (jax persistent compilation cache; also a
+deprecated alias for the artifact store at ``<dir>/aot``),
 ``SKYLARK_ENGINE_DONATE=1`` (solver entry points donate operands),
 ``SKYLARK_ENGINE_STATS_DUMP`` (write counters JSON at process exit).
 """
 
-from libskylark_tpu.engine import bucket
+from libskylark_tpu.engine import aot, bucket, warmup
 from libskylark_tpu.engine.cache import (CacheEntry, EngineStats,
                                          ExecutableCache)
 from libskylark_tpu.engine.compiled import (CompiledFn, cache, code_version,
@@ -45,8 +49,8 @@ from libskylark_tpu.engine.serve import (DEGRADED, DRAINING, SERVING,
 __all__ = [
     "CacheEntry", "CompiledFn", "DEGRADED", "DRAINING", "EngineStats",
     "ExecutableCache", "MicrobatchExecutor", "SERVING", "STOPPED",
-    "ServeOverloadedError", "bucket", "cache",
+    "ServeOverloadedError", "aot", "bucket", "cache",
     "code_version", "compiled", "digest", "donation_enabled", "dump_stats",
     "enable_persistent_cache", "maybe_donate", "plan_fingerprint",
-    "request_statics", "reset", "serve_stats", "stats",
+    "request_statics", "reset", "serve_stats", "stats", "warmup",
 ]

@@ -18,8 +18,20 @@ Counter vocabulary:
 ``evictions``
     LRU entries dropped at capacity (``SKYLARK_EXEC_CACHE_SIZE``,
     default 128 executables).
-``compile_seconds`` / ``execute_seconds``
-    cumulative wall time split the bench reports per solver.
+``compiles``
+    actual backend (XLA) compiles — misses that were NOT served from
+    the persistent AOT artifact store. Without ``SKYLARK_AOT_DIR`` this
+    equals ``misses``; with it, a warm store keeps it at 0 (the boot
+    gate's "zero backend compiles" reads exactly this).
+``aot_loads`` / ``aot_load_failures``
+    misses (or warmup-pack boot loads) resolved by deserializing a
+    persisted artifact, and artifacts that existed but failed the
+    compat probe / deserialize and fell back to a compile.
+``compile_seconds`` / ``load_seconds`` / ``execute_seconds``
+    cumulative wall time split the bench reports per solver —
+    ``load_seconds`` (artifact deserialize) is deliberately separate
+    from ``compile_seconds`` so the cold-start A/B is visible in the
+    counters themselves.
 """
 
 from __future__ import annotations
@@ -40,7 +52,11 @@ class EngineStats:
     recompiles: int = 0
     evictions: int = 0
     executions: int = 0
+    compiles: int = 0
+    aot_loads: int = 0
+    aot_load_failures: int = 0
     compile_seconds: float = 0.0
+    load_seconds: float = 0.0
     execute_seconds: float = 0.0
 
     def hit_rate(self) -> Optional[float]:
@@ -54,8 +70,10 @@ class EngineStats:
 
     def reset(self) -> None:
         self.hits = self.misses = self.recompiles = 0
-        self.evictions = self.executions = 0
-        self.compile_seconds = self.execute_seconds = 0.0
+        self.evictions = self.executions = self.compiles = 0
+        self.aot_loads = self.aot_load_failures = 0
+        self.compile_seconds = self.load_seconds = 0.0
+        self.execute_seconds = 0.0
 
     def merge(self, other: "EngineStats") -> None:
         """Accumulate ``other`` into this block (the lifetime rollup)."""
@@ -64,7 +82,11 @@ class EngineStats:
         self.recompiles += other.recompiles
         self.evictions += other.evictions
         self.executions += other.executions
+        self.compiles += other.compiles
+        self.aot_loads += other.aot_loads
+        self.aot_load_failures += other.aot_load_failures
         self.compile_seconds += other.compile_seconds
+        self.load_seconds += other.load_seconds
         self.execute_seconds += other.execute_seconds
 
 
@@ -72,10 +94,11 @@ class EngineStats:
 class CacheEntry:
     """One compiled executable plus its provenance."""
 
-    executable: Any           # jax.stages.Compiled
+    executable: Any           # jax.stages.Compiled (or AOT-deserialized)
     name: str                 # wrapped solver name
     compile_seconds: float
     calls: int = 0
+    loaded: bool = False      # deserialized from the AOT artifact store
 
 
 class ExecutableCache:
@@ -164,6 +187,27 @@ class ExecutableCache:
         if ev is not None:
             ev.set()
 
+    def note_compile(self) -> None:
+        """Record one actual backend (XLA) compile — bumped by the
+        engine exactly where ``jit(...).lower().compile()`` ran, never
+        for an artifact load, so ``compiles`` is the fleet-boot gate's
+        "zero backend compiles" counter."""
+        with self._lock:
+            self.stats.compiles += 1
+
+    def note_aot_load(self, seconds: float) -> None:
+        """Record one persisted-artifact deserialize (a miss or a
+        warmup-pack boot load resolved without a backend compile)."""
+        with self._lock:
+            self.stats.aot_loads += 1
+            self.stats.load_seconds += seconds
+
+    def note_aot_load_failure(self) -> None:
+        """Record one unusable artifact (compat/deserialize failure
+        that fell back to a fresh compile)."""
+        with self._lock:
+            self.stats.aot_load_failures += 1
+
     def note_execution(self, entry: CacheEntry, seconds: float) -> None:
         """Record one executable dispatch (entry call count + global
         execution counters) atomically."""
@@ -203,6 +247,7 @@ class ExecutableCache:
         with self._lock:
             return [
                 {"name": e.name, "calls": e.calls,
-                 "compile_seconds": round(e.compile_seconds, 4)}
+                 "compile_seconds": round(e.compile_seconds, 4),
+                 "loaded": e.loaded}
                 for e in self._entries.values()
             ]

@@ -26,11 +26,22 @@ are consumed — the caller's array is invalidated on every backend,
 including CPU. The tier-1 default is off because the public solvers
 take *user* operands (docs/performance.rst, "donation caveats").
 
-Cross-process reuse rides jax's persistent compilation cache:
-``SKYLARK_EXEC_CACHE_DIR=<dir>`` wires
-``jax.experimental.compilation_cache`` at first engine compile, so a
-serve-many process pays tracing but not XLA backend compilation for
-executables certified by an earlier process.
+Cross-process reuse has two tiers (docs/performance, "Persistent AOT
+artifacts & warmup packs"):
+
+- ``SKYLARK_AOT_DIR=<dir>`` — the **artifact store**
+  (:mod:`libskylark_tpu.engine.aot`): every AOT compile is serialized
+  under a digest of this exact cache key; a later process *loads
+  instead of compiling* (zero tracing, zero backend compile), with
+  compat probing and fall-back-to-compile on any deserialize failure,
+  and a per-key file lock extending the single-flight discipline
+  across processes — N racing cold replicas perform one compile
+  fleet-wide.
+- ``SKYLARK_EXEC_CACHE_DIR=<dir>`` — jax's persistent *compilation*
+  cache (tracing still paid, HLO-keyed), wired at first engine
+  compile. Deprecated as an artifact-store alias: when set without
+  ``SKYLARK_AOT_DIR``, artifacts additionally land in ``<dir>/aot``
+  with a one-time ``DeprecationWarning``.
 """
 
 from __future__ import annotations
@@ -42,11 +53,13 @@ import json
 import os
 import threading
 import time
+import warnings
 from typing import Callable, Optional, Sequence
 
 import jax
 
 from libskylark_tpu import telemetry as _telemetry
+from libskylark_tpu.engine import aot as _aot
 from libskylark_tpu.engine.cache import CacheEntry, EngineStats, ExecutableCache
 from libskylark_tpu.resilience import faults as _faults
 
@@ -72,6 +85,18 @@ _CACHE = ExecutableCache(maxsize=_cache_size())
 _COMPILE_HIST = _telemetry.histogram(
     "engine.compile_seconds",
     "Wall time of cold XLA compiles through the executable cache")
+_LOAD_HIST = _telemetry.histogram(
+    "engine.load_seconds",
+    "Wall time of persisted-AOT-artifact loads (deserialize instead "
+    "of compile) through the executable cache")
+_PERSIST_FAIL = _telemetry.counter(
+    "engine.persistent_cache_failures",
+    "enable_persistent_cache attempts that failed (jax persistent "
+    "compilation cache could not be wired)")
+
+# one warning per (reason) per process for unusable AOT artifacts —
+# the counter carries the volume, the warning carries the diagnosis
+_aot_warned: set = set()
 
 
 def _lifetime_rollup() -> EngineStats:
@@ -161,7 +186,15 @@ def enable_persistent_cache(path: Optional[str] = None) -> bool:
             pass
         _persistent_wired = True
         return True
-    except Exception:
+    except Exception as e:  # noqa: BLE001 — optimization, not failure
+        # observable, not silent (r13 satellite): one warning plus an
+        # always-on counter, so "the persistent cache never engaged"
+        # shows up in telemetry instead of as a mystery cold fleet
+        _PERSIST_FAIL.inc_always(reason=type(e).__name__)
+        warnings.warn(
+            f"jax persistent compilation cache could not be wired at "
+            f"{path!r}: {e!r} — continuing without it",
+            RuntimeWarning, stacklevel=2)
         return False
 
 
@@ -320,6 +353,108 @@ class CompiledFn:
             jax.default_backend(),
         )
 
+    # -- cold-key materialization: AOT load > single-flight compile --
+
+    def _aot_load_entry(self, key) -> Optional[CacheEntry]:
+        """Deserialize the key's persisted artifact into a cache entry
+        (None on plain miss). An artifact that exists but is unusable
+        — compat mismatch, torn file, deserialize failure — counts an
+        ``aot_load_failures``, warns once per reason, and returns None
+        so the caller compiles fresh."""
+        try:
+            got = _aot.load(key)
+        except _aot.AotLoadError as e:
+            with self._stats_lock:
+                self.stats.aot_load_failures += 1
+            _CACHE.note_aot_load_failure()
+            if e.reason not in _aot_warned:
+                _aot_warned.add(e.reason)
+                warnings.warn(
+                    f"persisted AOT artifact for {self.name!r} is "
+                    f"unusable ({e}); recompiling", RuntimeWarning,
+                    stacklevel=3)
+            return None
+        if got is None:
+            return None
+        executable, _header, dt = got
+        _LOAD_HIST.observe_always(dt, name=self.name)
+        with self._stats_lock:
+            self.stats.aot_loads += 1
+            self.stats.load_seconds += dt
+        _CACHE.note_aot_load(dt)
+        return CacheEntry(executable=executable, name=self.name,
+                          compile_seconds=0.0, loaded=True)
+
+    def _materialize(self, key, args, kwargs, donate_argnums) -> CacheEntry:
+        """Resolve one cold key, owning the in-process single-flight:
+        load the persisted artifact if the store has it; otherwise take
+        the cross-process file lock (so N racing cold *processes*
+        produce one compile fleet-wide — a lock wait usually ends with
+        the winner's artifact ready to load), and only then compile —
+        serializing the result back into the store for the next
+        process. The caller aborts the in-process single-flight on any
+        raise; the file lock is released here either way."""
+        lock = None
+        try:
+            if _aot.enabled():
+                had_artifact = os.path.exists(
+                    _aot.artifact_path(_aot.key_digest(key)))
+                entry = self._aot_load_entry(key)
+                if entry is not None:
+                    _CACHE.insert(key, entry)
+                    return entry
+                lock = _aot.lock_for(key)
+                if (lock.acquire(timeout=_aot.lock_timeout())
+                        and not had_artifact):
+                    # the wait may have spanned a peer's compile+save:
+                    # re-probe before compiling ourselves. Skip it when
+                    # an artifact was already present and judged
+                    # unusable — re-reading the same bytes would only
+                    # double-count the failure
+                    entry = self._aot_load_entry(key)
+                    if entry is not None:
+                        _CACHE.insert(key, entry)
+                        return entry
+                # acquire timeout: compile anyway (liveness) but skip
+                # the save — we are not the elected single writer
+            entry = self._backend_compile(key, args, kwargs,
+                                          donate_argnums)
+            if lock is not None and lock.held:
+                _aot.save(key, entry.executable, name=self.name,
+                          compile_seconds=entry.compile_seconds)
+            _CACHE.insert(key, entry)
+            return entry
+        finally:
+            if lock is not None:
+                lock.release()
+
+    def _backend_compile(self, key, args, kwargs,
+                         donate_argnums) -> CacheEntry:
+        t0 = time.perf_counter()
+        # chaos seam: a compile-path fault takes the same abort
+        # route as a real XLA failure, so injection exercises
+        # the single-flight waiter-release contract too
+        with _telemetry.span("engine.compile",
+                             attrs={"name": self.name}):
+            _faults.check("engine.compile", detail=self.name)
+            jitted = jax.jit(
+                self._fn,
+                static_argnames=self._static_argnames or None,
+                donate_argnums=donate_argnums or None,
+            )
+            executable = jitted.lower(*args, **kwargs).compile()
+        dt = time.perf_counter() - t0
+        # always recorded: compiles are seconds-scale (the
+        # histogram bump is noise) and the bench snapshot embeds
+        # compile-time data even with telemetry off
+        _COMPILE_HIST.observe_always(dt, name=self.name)
+        with self._stats_lock:
+            self.stats.compiles += 1
+            self.stats.compile_seconds += dt
+        _CACHE.note_compile()
+        return CacheEntry(executable=executable, name=self.name,
+                          compile_seconds=dt)
+
     # -- call --
 
     def __call__(self, *args, **kwargs):
@@ -338,40 +473,20 @@ class CompiledFn:
         )
         donate_argnums = self._effective_donate()
         key = self._key(args, statics, kwargs, donate_argnums)
-        # single-flight: on a cold key exactly one thread compiles while
-        # concurrent callers of the same key block in acquire()
+        # single-flight: on a cold key exactly one thread materializes
+        # (AOT artifact load, else compile) while concurrent callers of
+        # the same key block in acquire()
         entry = _CACHE.acquire(key)
         if entry is None:
             with self._stats_lock:
                 self.stats.misses += 1
             _maybe_wire_persistent()
-            t0 = time.perf_counter()
             try:
-                # chaos seam: a compile-path fault takes the same abort
-                # route as a real XLA failure, so injection exercises
-                # the single-flight waiter-release contract too
-                with _telemetry.span("engine.compile",
-                                     attrs={"name": self.name}):
-                    _faults.check("engine.compile", detail=self.name)
-                    jitted = jax.jit(
-                        self._fn,
-                        static_argnames=self._static_argnames or None,
-                        donate_argnums=donate_argnums or None,
-                    )
-                    executable = jitted.lower(*args, **kwargs).compile()
+                entry = self._materialize(key, args, kwargs,
+                                          donate_argnums)
             except BaseException:
                 _CACHE.abort(key)
                 raise
-            dt = time.perf_counter() - t0
-            # always recorded: compiles are seconds-scale (the
-            # histogram bump is noise) and the bench snapshot embeds
-            # compile-time data even with telemetry off
-            _COMPILE_HIST.observe_always(dt, name=self.name)
-            with self._stats_lock:
-                self.stats.compile_seconds += dt
-            entry = CacheEntry(executable=executable, name=self.name,
-                               compile_seconds=dt)
-            _CACHE.insert(key, entry)
         else:
             with self._stats_lock:
                 self.stats.hits += 1

@@ -202,6 +202,18 @@ def _pallas_native() -> bool:
     return available()
 
 
+def _parse_plan_token(token: str):
+    """Invert :meth:`libskylark_tpu.tune.Plan.plan_id` for warmup-pack
+    kernel restoration (``pallas/mt128/pipe`` → a Plan). None when the
+    token is not a plan id this build understands. The real decoder
+    lives next to the encoder (``Plan.from_plan_id``) so the formats
+    cannot drift apart; this wrapper only narrows the backends to the
+    serve-kernel set."""
+    from libskylark_tpu.tune import Plan
+
+    return Plan.from_plan_id(token, known_backends=_KERNEL_BACKENDS)
+
+
 def _decline_slug(msg: str) -> str:
     """Compact label-value form of a kernel decline reason (the
     ``by_reason`` Prometheus label set must not carry free prose)."""
@@ -1131,6 +1143,61 @@ class MicrobatchExecutor:
 
         memo_key = (b.statics, int(capacity), plan_fingerprint())
         self._kernel_memo[memo_key] = ("xla", None, "fallback", reason)
+
+    def restore_kernel_choice(self, statics, capacity: int,
+                              token: str) -> bool:
+        """Seed the flush-kernel memo for one (bucket statics,
+        capacity) with a warmup-pack-recorded decision — the r12
+        kernel choice ships *with* the compiled artifact instead of
+        being re-resolved (plan-cache consult + host qualification)
+        per process (docs/performance, "Persistent AOT artifacts &
+        warmup packs"). The seed is keyed under the CURRENT plan
+        fingerprint; the pack loader only calls this after verifying
+        the fingerprints match, so the memoized choice is exactly what
+        live resolution would certify. Returns whether the decision
+        was restored (an unparseable token falls back to live
+        resolution — a decline, not an error). An explicit pin —
+        executor ``kernel=`` argument or ``SKYLARK_SERVE_KERNEL`` —
+        outranks the pack: the memo is consulted before either, so
+        seeding it would silently override the operator's pin; decline
+        instead and let live resolution honor the precedence. The same
+        goes for a disabled plan cache (``SKYLARK_USE_PLAN_CACHE=0``)
+        — the pack's decisions ARE plan-cache decisions, and restoring
+        them would re-enable the selection the operator turned off."""
+        from libskylark_tpu.engine.compiled import plan_fingerprint
+        from libskylark_tpu.sketch import params as sketch_params
+
+        if self.kernel is not None or _serve_kernel_env() is not None:
+            return False
+        if not sketch_params.get_use_plan_cache():
+            return False
+        value = None
+        if token == "xla":
+            value = ("xla", None, "pack", None)
+        else:
+            plan = _parse_plan_token(token)
+            if plan is not None:
+                value = (plan.backend, plan, "pack", None)
+        if value is None:
+            return False
+        fp = plan_fingerprint()
+        if fp != self._kernel_memo_fp:
+            self._kernel_memo.clear()
+            self._kernel_memo_fp = fp
+        self._kernel_memo[(tuple(statics), int(capacity), fp)] = value
+        return True
+
+    def load_warmup_pack(self, pack_dir: str, *,
+                         strict: bool = False) -> dict:
+        """Boot this executor from a warmup pack: load every packed
+        executable into the process executable cache and restore the
+        packed per-bucket kernel decisions into this executor's memo
+        (:func:`libskylark_tpu.engine.warmup.load_pack`). Call before
+        accepting traffic; returns the loader's report."""
+        from libskylark_tpu.engine import warmup as _warmup
+
+        return _warmup.load_pack(pack_dir, executors=(self,),
+                                 strict=strict)
 
     def _build_batched(self, b: _Bucket):
         import jax

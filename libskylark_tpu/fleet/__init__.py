@@ -29,13 +29,15 @@ gated in CI by ``benchmarks/fleet_smoke.py``. See ``docs/fleet``.
 """
 
 from libskylark_tpu.fleet.pool import ReplicaPool
-from libskylark_tpu.fleet.replica import (ProcessReplica, Replica,
-                                          ThreadReplica)
+from libskylark_tpu.fleet.replica import (PROPAGATED_ENV, ProcessReplica,
+                                          Replica, ThreadReplica,
+                                          propagated_env)
 from libskylark_tpu.fleet.ring import HashRing
 from libskylark_tpu.fleet.router import (NoHealthyReplicaError, Router,
                                          fleet_stats)
 
 __all__ = [
-    "HashRing", "NoHealthyReplicaError", "ProcessReplica", "Replica",
-    "ReplicaPool", "Router", "ThreadReplica", "fleet_stats",
+    "HashRing", "NoHealthyReplicaError", "PROPAGATED_ENV",
+    "ProcessReplica", "Replica", "ReplicaPool", "Router",
+    "ThreadReplica", "fleet_stats", "propagated_env",
 ]

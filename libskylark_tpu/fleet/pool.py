@@ -48,6 +48,18 @@ class ReplicaPool:
     ``coordinator=`` — multi-host kwargs forwarded to
     ``parallel.multihost.initialize_distributed`` in the child).
 
+    ``warmup_pack`` boots every replica from a warmup pack
+    (docs/performance, "Persistent AOT artifacts & warmup packs"):
+    thread replicas load it into the shared process executable cache
+    (deserialized once — later replicas find the keys resident and
+    only seed their own flush-kernel memos);
+    process replicas each load it in their own interpreter BEFORE the
+    liveness probe resolves, and inherit the parent's AOT store / plan
+    cache / telemetry environment explicitly
+    (:data:`~libskylark_tpu.fleet.replica.PROPAGATED_ENV`), so a
+    process fleet of N cold children boots serving every packed bucket
+    with zero backend compiles.
+
     ``shared_workers`` (thread backend only) sizes flush concurrency
     to the HOST instead of to N: the pool owns one dispatch queue and
     that many flush worker threads, and every replica enqueues its
@@ -61,6 +73,7 @@ class ReplicaPool:
     def __init__(self, n: int = 2, *, backend: str = "thread",
                  names: Optional[List[str]] = None, coordinator=None,
                  shared_workers: Optional[int] = None,
+                 warmup_pack: Optional[str] = None,
                  **executor_kwargs):
         if n < 1:
             raise ValueError("a fleet needs at least one replica")
@@ -107,10 +120,11 @@ class ReplicaPool:
             for name in names:
                 if backend == "thread":
                     self._replicas[name] = ThreadReplica(
-                        name, **executor_kwargs)
+                        name, warmup_pack=warmup_pack, **executor_kwargs)
                 else:
                     self._replicas[name] = ProcessReplica(
-                        name, coordinator=coordinator, **executor_kwargs)
+                        name, coordinator=coordinator,
+                        warmup_pack=warmup_pack, **executor_kwargs)
         except Exception:
             for r in self._replicas.values():
                 r.shutdown()

@@ -30,8 +30,8 @@ Two backings:
 
 Process-replica protocol (one duplex pipe, length-tagged tuples):
 parent → child: ``("submit", rid, endpoint, kwargs)`` /
-``("stats"|"depth"|"flush", rid)`` / ``("drain", rid, timeout)`` /
-``("shutdown", rid)``; child → parent: ``("result", rid, value)`` /
+``("stats"|"env"|"depth"|"flush", rid)`` / ``("drain", rid, timeout)``
+/ ``("shutdown", rid)``; child → parent: ``("result", rid, value)`` /
 ``("error", rid, exception)`` / ``("rpc", rid, value)`` /
 ``("state", None, new_state)`` — the last forwarded from the child's
 health hub so the parent's hub (and any subscribed router) sees the
@@ -49,6 +49,54 @@ from concurrent.futures import Future
 from typing import Optional
 
 from libskylark_tpu.engine.serve import ServeOverloadedError
+
+# Environment a replica child must agree with its parent on — the AOT
+# artifact store, the tune plan cache (its fingerprint is in every
+# executable key: a child on a different cache file would never hit
+# the parent's warmup pack), and the telemetry switches. Propagated
+# EXPLICITLY through the spawn args and applied at child entry, not
+# left to the accident of what ``os.environ`` held when
+# ``Process.start()`` happened to run (a parent that configures its
+# store after constructing the pool — or a test that monkeypatches
+# around replica construction — must still produce children that
+# agree with it).
+PROPAGATED_ENV = (
+    "SKYLARK_AOT_DIR",
+    "SKYLARK_EXEC_CACHE_DIR",
+    "SKYLARK_PLAN_CACHE",
+    "SKYLARK_TELEMETRY",
+    "SKYLARK_TELEMETRY_DIR",
+    "SKYLARK_SERVE_KERNEL",
+)
+
+
+def propagated_env() -> dict:
+    """Snapshot of :data:`PROPAGATED_ENV` in this process (``None``
+    marks a variable to *unset* in the child)."""
+    return {k: os.environ.get(k) for k in PROPAGATED_ENV}
+
+
+def _apply_env(env: Optional[dict]) -> None:
+    """Apply a parent's snapshot in the child — set present values,
+    delete absent ones — then re-arm the lazy readers that already ran
+    at import time (telemetry's enable gate and JSONL exporter)."""
+    if env is None:
+        return
+    for k, v in env.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    try:
+        from libskylark_tpu import telemetry
+
+        telemetry.set_enabled(
+            os.environ.get("SKYLARK_TELEMETRY", "") not in ("", "0")
+            or bool(os.environ.get("SKYLARK_TELEMETRY_DIR")))
+        if os.environ.get("SKYLARK_TELEMETRY_DIR"):
+            telemetry.install_exporter()
+    except Exception:  # noqa: BLE001 — telemetry must not block boot
+        pass
 
 
 class Replica:
@@ -84,12 +132,20 @@ class ThreadReplica(Replica):
 
     backend = "thread"
 
-    def __init__(self, name: str, **executor_kwargs):
+    def __init__(self, name: str, warmup_pack: Optional[str] = None,
+                 **executor_kwargs):
         from libskylark_tpu import engine
 
         self.name = str(name)
         self.executor = engine.MicrobatchExecutor(name=self.name,
                                                   **executor_kwargs)
+        self.warmup_report: Optional[dict] = None
+        if warmup_pack:
+            # pack loading precedes any traffic by construction (the
+            # pool builds replicas before the router exists); a
+            # degraded/partial load serves via the compile path
+            self.warmup_report = self.executor.load_warmup_pack(
+                warmup_pack)
 
     def submit(self, endpoint: str, /, **kwargs) -> Future:
         return self.executor.submit(endpoint, **kwargs)
@@ -131,8 +187,15 @@ def _send_exception(send, rid, e: BaseException) -> None:
 
 
 def _worker_main(conn, name: str, executor_kwargs: dict,
-                 coordinator: Optional[dict]) -> None:
+                 coordinator: Optional[dict],
+                 env: Optional[dict] = None,
+                 warmup_pack: Optional[str] = None) -> None:
     """Child entry point (module-level: spawn pickles it by name)."""
+    # the parent's engine/telemetry environment first — everything
+    # below (jax config, engine import, executor construction, pack
+    # load) must see the parent's explicit snapshot, not whatever
+    # os.environ happened to hold at Process.start()
+    _apply_env(env)
     # the child honors the parent's platform pin the same way the
     # benchmarks do (env rides across spawn; sitecustomize may have
     # pre-imported jax with another platform)
@@ -155,6 +218,16 @@ def _worker_main(conn, name: str, executor_kwargs: dict,
     # the in-process preemption contract, scoped to this replica
     resilience.install_preemption_handler()
     ex = engine.MicrobatchExecutor(name=name, **executor_kwargs)
+    warmup_report = None
+    if warmup_pack:
+        # BEFORE the message loop: the parent's liveness RPC (its
+        # first "stats") only resolves after this, so a packed child
+        # is warm before it can ever accept traffic
+        try:
+            warmup_report = ex.load_warmup_pack(warmup_pack)
+        except Exception as e:  # noqa: BLE001 — boot must not die on
+            #                     a bad pack; the compile path serves
+            warmup_report = {"skipped": f"load failed: {e!r}"}
 
     send_lock = threading.Lock()
 
@@ -198,6 +271,16 @@ def _worker_main(conn, name: str, executor_kwargs: dict,
                 fut.add_done_callback(functools.partial(reply, rid))
             elif kind == "stats":
                 send(("rpc", rid, ex.stats()))
+            elif kind == "env":
+                # boot introspection: the applied engine environment +
+                # the pack-load report (the env-propagation regression
+                # test and fleet debugging read this)
+                send(("rpc", rid, {
+                    "env": {k: os.environ.get(k)
+                            for k in PROPAGATED_ENV},
+                    "warmup": warmup_report,
+                    "engine": engine.stats().to_dict(),
+                }))
             elif kind == "depth":
                 send(("rpc", rid, ex.queue_depth()))
             elif kind == "flush":
@@ -226,16 +309,21 @@ class ProcessReplica(Replica):
     backend = "process"
 
     def __init__(self, name: str, coordinator: Optional[dict] = None,
-                 start_timeout: float = 120.0, **executor_kwargs):
+                 start_timeout: float = 120.0,
+                 warmup_pack: Optional[str] = None,
+                 env: Optional[dict] = None, **executor_kwargs):
         import multiprocessing as mp
 
         self.name = str(name)
         ctx = mp.get_context("spawn")
         self._conn, child_conn = ctx.Pipe(duplex=True)
+        # the engine environment rides the spawn args, not os.environ
+        # timing (PROPAGATED_ENV): snapshot now, apply at child entry
+        self._env = dict(env) if env is not None else propagated_env()
         self._proc = ctx.Process(
             target=_worker_main,
             args=(child_conn, self.name, dict(executor_kwargs),
-                  coordinator),
+                  coordinator, self._env, warmup_pack),
             name=f"skylark-replica-{self.name}", daemon=True)
         self._proc.start()
         child_conn.close()
@@ -336,6 +424,11 @@ class ProcessReplica(Replica):
     def stats(self) -> dict:
         return self._rpc("stats") or {}
 
+    def boot_info(self) -> dict:
+        """The child's applied engine environment, warmup-pack report,
+        and engine counters — proof of what the replica booted with."""
+        return self._rpc("env") or {}
+
     def flush(self) -> None:
         self._rpc("flush")
 
@@ -379,4 +472,5 @@ class ProcessReplica(Replica):
         return source is self
 
 
-__all__ = ["ProcessReplica", "Replica", "ThreadReplica"]
+__all__ = ["PROPAGATED_ENV", "ProcessReplica", "Replica",
+           "ThreadReplica", "propagated_env"]

@@ -149,6 +149,34 @@ class Plan:
             parts.append("pipe")
         return "/".join(parts)
 
+    @classmethod
+    def from_plan_id(cls, token: str,
+                     known_backends=("pallas", "xla")) -> "Plan | None":
+        """Invert :meth:`plan_id` (the warmup-pack manifests persist
+        plan ids as the per-bucket kernel decision). Kept next to the
+        encoder so the two formats cannot drift apart silently.
+        Returns None for a token this build does not understand — an
+        unknown backend, an empty part, or (since every known
+        component is matched explicitly) more than one free-form
+        precision part."""
+        parts = str(token).split("/")
+        if not parts or parts[0] not in known_backends:
+            return None
+        m_tile = None
+        precision = None
+        pipeline = False
+        for p in parts[1:]:
+            if p.startswith("mt") and p[2:].isdigit():
+                m_tile = int(p[2:])
+            elif p == "pipe":
+                pipeline = True
+            elif p and precision is None:
+                precision = p
+            else:
+                return None
+        return cls(backend=parts[0], m_tile=m_tile,
+                   precision=precision, pipeline=pipeline)
+
     def to_dict(self) -> dict:
         d = {"backend": self.backend}
         if self.m_tile is not None:
