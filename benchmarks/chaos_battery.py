@@ -297,6 +297,28 @@ def main() -> int:
     # -- fleet leg: deterministic router failover -----------------------
     fleet_rec = _fleet_leg(T, ops, refs, violations)
 
+    # -- lock-order witness (instrumented-lock mode) --------------------
+    # With SKYLARK_LOCK_WITNESS=1 (the CI chaos gate sets it) every
+    # lock the storm touched — executor state/stats/pub, engine cache,
+    # health hub, fault plan, router/pool/ring — was constructed
+    # instrumented, and the recorded acquisition-order graph must be
+    # acyclic: the runtime half of the lock-discipline story, validated
+    # against `script/lint --graph`'s static half on the same battery.
+    from libskylark_tpu.base import locks as _locks
+
+    witness_rec = None
+    if _locks.witness_enabled():
+        witness_rec = _locks.witness_report()
+        if not witness_rec["acquisitions"]:
+            violations.append(
+                "lock witness enabled but recorded nothing — the "
+                "instrumented-lock leg went inert")
+        for v in witness_rec["violations"]:
+            violations.append(
+                f"lock-order cycle closed at runtime: "
+                f"{v['edge'][0]} -> {v['edge'][1]} "
+                f"(held {v['held']}, thread {v['thread']})")
+
     # -- zero leaked executables (the jit-leak counter) -----------------
     est = engine.stats()
     if est.recompiles:
@@ -321,6 +343,7 @@ def main() -> int:
         "engine_recompiles": est.recompiles,
         "deterministic": fired1 == fired2,
         "fleet": fleet_rec,
+        "lock_witness": witness_rec,
         "violations": violations,
     }
     print(json.dumps(rec), flush=True)

@@ -1,0 +1,41 @@
+"""``skylark-lint`` — repo-specific static analysis.
+
+The serving stack's core contracts (compile-once/serve-many, zero
+tracer leaks, deadlock-free drain, hermetic replica environments) were
+enforced only at runtime, by gates that catch one instance at a time.
+This package encodes them as AST-level invariants checked on every
+commit (``script/lint``; the ``script/ci`` lint gate). Four rule
+families:
+
+- ``jit-purity`` (:mod:`.rules.jit_purity`) — functions reaching
+  ``engine.compiled`` / ``jax.jit`` / the serve flush builders must
+  not read the environment, wall clocks, host RNG, or mutable module
+  globals;
+- ``lock-discipline`` (:mod:`.rules.lock_discipline`) — the static
+  lock-acquisition graph over the ``base.locks`` site names must stay
+  acyclic, and blocking calls / callback fan-outs must not run under a
+  held lock;
+- ``env-registry`` (:mod:`.rules.env_registry`) — every ``SKYLARK_*``
+  environment read goes through :mod:`libskylark_tpu.base.env`;
+- ``metric-names`` (:mod:`.rules.metric_names`) — every telemetry
+  instrument name is declared once
+  (:mod:`libskylark_tpu.telemetry.names`) and Prometheus-renderable.
+
+Workflow: findings suppress per line
+(``# skylark-lint: disable=<rule>`` on the line, or alone on the line
+above) or live in the committed shrink-only baseline
+(``libskylark_tpu/analysis/baseline.json``). See ``docs/analysis.rst``.
+"""
+
+from __future__ import annotations
+
+from libskylark_tpu.analysis.core import (
+    BASELINE_PATH, Finding, Project, baseline_load, baseline_save,
+    compare_to_baseline, registered_rules, run_rules,
+)
+
+__all__ = [
+    "BASELINE_PATH", "Finding", "Project", "baseline_load",
+    "baseline_save", "compare_to_baseline", "registered_rules",
+    "run_rules",
+]

@@ -1,0 +1,226 @@
+"""``jit-purity`` — traced functions must be pure.
+
+Motivating bug class: the jit-leak CI gate catches a *flapping cache
+key* only after it has thrashed the executable cache at runtime; a
+tracer that reads ``os.environ``, a wall clock, host RNG, or a mutable
+module global bakes a trace-time value into the compiled program — the
+executable silently disagrees with the environment the next process
+(or the next minute) runs in, and nothing invalidates it.
+
+Roots (the functions whose bodies trace):
+
+- functions decorated with ``jax.jit`` / ``@compiled`` /
+  ``@engine_compile`` (any alias of ``engine.compiled.compiled``,
+  including ``functools.partial(jax.jit, ...)`` decorators);
+- functions passed as the first argument to ``jax.jit(...)`` /
+  ``compiled(...)`` / ``engine_compile(...)`` — the serve layer's
+  flush-builder idiom (``_build_batched`` returns
+  ``engine_compile(inner_fn, ...)``).
+
+From each root the rule follows the project call graph (conservative:
+unresolved calls contribute nothing) and flags every reachable
+impurity:
+
+- ``os.environ`` / ``os.getenv`` / ``base.env`` registry reads;
+- wall clocks: ``time.time/monotonic/perf_counter/time_ns``,
+  ``datetime.now/utcnow``;
+- host RNG: the stdlib ``random`` module, ``np.random``;
+- reads of mutable module globals — names rebound via ``global``
+  somewhere in their module (the set-at-runtime knob pattern).
+
+A *deliberate* trace-time read (a precision policy resolved at trace
+time and captured in the cache key) is suppressed **at the impure
+line** with ``# skylark-lint: disable=jit-purity`` plus a comment
+saying why the key covers it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from libskylark_tpu.analysis.callgraph import CallGraph, iter_own_nodes
+from libskylark_tpu.analysis.core import Finding, Project, rule
+
+RULE = "jit-purity"
+
+ENV_MODULE = "libskylark_tpu.base.env"
+_COMPILE_WRAPPERS = {"libskylark_tpu.engine.compiled:compiled"}
+_CLOCK_ATTRS = {"time", "monotonic", "perf_counter", "time_ns",
+                "monotonic_ns", "perf_counter_ns"}
+_DATETIME_ATTRS = {"now", "utcnow", "today"}
+
+
+def _dotted(node: ast.AST) -> Optional[List[str]]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+class _ModuleFacts:
+    """Per-module context shared by root + impurity detection."""
+
+    def __init__(self, mod):
+        self.mod = mod
+        # names rebound via ``global`` in any function of the module
+        self.mutable_globals: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Global):
+                self.mutable_globals.update(node.names)
+
+    def alias_of(self, name: str) -> Optional[str]:
+        return self.mod.resolve_alias_module(name)
+
+    def is_jit_attr(self, node: ast.AST) -> bool:
+        """``jax.jit`` / ``jit`` imported from jax."""
+        d = _dotted(node)
+        if not d:
+            return False
+        if d[-1] != "jit":
+            return False
+        if len(d) == 1:
+            return self.mod.import_aliases.get("jit", "") == "jax:jit"
+        return self.alias_of(d[0]) == "jax"
+
+    def is_compile_wrapper(self, node: ast.AST) -> bool:
+        """Any alias of engine.compiled.compiled (``compiled``,
+        ``engine_compile``, ``engine.compiled.compiled``...)."""
+        if isinstance(node, ast.Name):
+            return (self.mod.import_aliases.get(node.id, "")
+                    in {w.replace(":", ":") for w in _COMPILE_WRAPPERS}
+                    or self.mod.import_aliases.get(node.id, "")
+                    == "libskylark_tpu.engine.compiled:compiled")
+        d = _dotted(node)
+        if d and d[-1] == "compiled" and len(d) >= 2:
+            target = self.alias_of(d[0])
+            if target and "engine" in target:
+                return True
+        return False
+
+
+def _direct_impurities(graph: CallGraph,
+                       facts: Dict[str, _ModuleFacts]
+                       ) -> Dict[str, Set[Tuple[str, str, int]]]:
+    """qualname -> {(kind, detail, lineno)} of impurities written
+    directly in that function's own body (suppressed lines skipped)."""
+    out: Dict[str, Set[Tuple[str, str, int]]] = {}
+    for qn, fn in graph.functions.items():
+        mod = fn.module
+        mf = facts[mod.modname]
+        found: Set[Tuple[str, str, int]] = set()
+
+        def note(kind, detail, lineno):
+            if not mod.is_suppressed(RULE, lineno):
+                found.add((kind, detail, lineno))
+
+        for node in iter_own_nodes(fn.node, ast.AST):
+            d = _dotted(node) if isinstance(node, ast.Attribute) else None
+            if d:
+                root_target = mf.alias_of(d[0])
+                # os.environ / os.getenv
+                if root_target == "os" and len(d) >= 2 and d[1] in (
+                        "environ", "getenv"):
+                    note("env", ".".join(d[:2]), node.lineno)
+                # base.env registry access
+                elif root_target == ENV_MODULE and len(d) >= 2:
+                    note("env", f"base.env.{d[1]}", node.lineno)
+                # clocks
+                elif (root_target == "time" and len(d) == 2
+                        and d[1] in _CLOCK_ATTRS):
+                    note("clock", ".".join(d), node.lineno)
+                elif (root_target == "datetime" and d[-1]
+                        in _DATETIME_ATTRS):
+                    note("clock", ".".join(d), node.lineno)
+                # host RNG
+                elif root_target == "random" and len(d) >= 2:
+                    note("host-rng", ".".join(d[:2]), node.lineno)
+                elif (root_target in ("numpy", "np")
+                        and len(d) >= 2 and d[1] == "random"):
+                    note("host-rng", ".".join(d[:2]), node.lineno)
+                elif (root_target == "numpy.random"):
+                    note("host-rng", "numpy.random", node.lineno)
+            elif (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in mf.mutable_globals):
+                # reading a module global some function rebinds
+                note("mutable-global",
+                     f"{mod.modname}:{node.id}", node.lineno)
+        if found:
+            out[qn] = found
+    return out
+
+
+def _roots(graph: CallGraph,
+           facts: Dict[str, _ModuleFacts]) -> Dict[str, int]:
+    """qualname -> lineno of every jit/compile root."""
+    roots: Dict[str, int] = {}
+    for qn, fn in graph.functions.items():
+        mf = facts[fn.module.modname]
+        for deco in getattr(fn.node, "decorator_list", []):
+            target = deco.func if isinstance(deco, ast.Call) else deco
+            if mf.is_jit_attr(target) or mf.is_compile_wrapper(target):
+                roots[qn] = fn.node.lineno
+            elif (isinstance(deco, ast.Call)
+                    and _dotted(deco.func)
+                    and _dotted(deco.func)[-1] == "partial"
+                    and deco.args
+                    and (mf.is_jit_attr(deco.args[0])
+                         or mf.is_compile_wrapper(deco.args[0]))):
+                roots[qn] = fn.node.lineno
+    # call-form roots: jax.jit(f) / compiled(f) / engine_compile(f),
+    # inside functions (full scope resolution) ...
+    for qn, fn in graph.functions.items():
+        mf = facts[fn.module.modname]
+        for call in iter_own_nodes(fn.node, ast.Call):
+            if not (mf.is_jit_attr(call.func)
+                    or mf.is_compile_wrapper(call.func)):
+                continue
+            if not call.args:
+                continue
+            arg = call.args[0]
+            if isinstance(arg, ast.Name):
+                callee = graph._resolve_name(fn.module, fn, arg.id)
+                if callee and callee not in roots:
+                    roots[callee] = graph.functions[callee].node.lineno
+    # ... and at module level (``_svd_compiled = engine.compiled(fn,
+    # ...)`` — the solver-module idiom), resolving against top-level
+    # function names only
+    for mod in (fn.module for fn in graph.functions.values()):
+        mf = facts[mod.modname]
+        for call in ast.walk(mod.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if not (mf.is_jit_attr(call.func)
+                    or mf.is_compile_wrapper(call.func)):
+                continue
+            if not (call.args and isinstance(call.args[0], ast.Name)):
+                continue
+            callee = f"{mod.modname}:{call.args[0].id}"
+            if callee in graph.functions and callee not in roots:
+                roots[callee] = graph.functions[callee].node.lineno
+    return roots
+
+
+@rule(RULE,
+      "functions reaching jax.jit/engine.compiled must not read env, "
+      "clocks, host RNG, or mutable module globals")
+def check(project: Project) -> List[Finding]:
+    graph = CallGraph(project)
+    facts = {m.modname: _ModuleFacts(m)
+             for m in project.modules.values()}
+    direct = _direct_impurities(graph, facts)
+    trans = graph.propagate(direct)
+    findings: List[Finding] = []
+    for qn, lineno in sorted(_roots(graph, facts).items()):
+        fn = graph.functions[qn]
+        for kind, detail in sorted({(k, d)
+                                    for k, d, _ in trans.get(qn, ())}):
+            findings.append(Finding(
+                RULE, fn.module.relpath, lineno, qn,
+                f"traced root reaches {kind} impurity ({detail})"))
+    return findings

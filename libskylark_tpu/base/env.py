@@ -1,0 +1,349 @@
+"""Typed registry of every ``SKYLARK_*`` environment variable.
+
+Before this module existed, ~45 scattered ``os.environ`` reads each
+re-implemented the repo's env conventions (off-words, typo-degrades-to-
+default) and — worse — a newly added variable had to be *remembered*
+into :data:`libskylark_tpu.fleet.replica.PROPAGATED_ENV` or process
+replicas silently booted with a different engine environment than their
+parent (the r13 poisoned-``os.environ``-child class of bug). Declaring
+every variable here once, with its parser, default, doc string and
+propagate-to-children flag, makes both problems structural:
+
+- the ``env-registry`` lint rule (:mod:`libskylark_tpu.analysis`)
+  rejects any raw ``os.environ`` read of a ``SKYLARK_*`` name outside
+  this module, and any reference to an undeclared variable;
+- :func:`propagated_names` / :func:`snapshot_propagated` mechanically
+  feed the replica spawn path, so a declared-propagating variable can
+  never again miss process-replica propagation;
+- ``script/lint --env-table`` renders the registry as the generated
+  reference table in ``docs/env_vars.rst`` — the docs cannot drift
+  from the code because they are emitted from it.
+
+Reads are **never cached here**: ``EnvVar.get()`` consults
+``os.environ`` on every call, so tests monkeypatching variables keep
+working exactly as before. Modules that deliberately latch a value at
+import time (``telemetry.metrics.enabled``, ``utility.timer``) keep
+their own latch and read through the registry when they do read.
+
+Parse conventions (the repo's, now in one place):
+
+- *flag*: set-and-not-``"0"``/empty is on (``SKYLARK_TELEMETRY``);
+- *off-words*: ``0/off/no/false/""`` disable a path-valued variable
+  (``SKYLARK_PLAN_CACHE=off``);
+- *typo degrades to default*: a malformed int/float never crashes a
+  sketch apply — it falls back to the declared default.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional, Tuple
+
+_UNSET = object()
+
+#: Values that disable a path-valued variable when set explicitly.
+OFF_WORDS = ("", "0", "off", "no", "false")
+
+
+def parse_flag(raw: str) -> bool:
+    """On unless empty/``"0"`` (the telemetry/profiler convention)."""
+    return raw not in ("", "0")
+
+
+def parse_bool_default_on(raw: str) -> bool:
+    """Off only for an explicit off-word (``SKYLARK_USE_PLAN_CACHE``)."""
+    return raw.strip().lower() not in OFF_WORDS
+
+
+def parse_path_or_off(raw: str) -> Optional[str]:
+    """A path, or ``None`` when the value is an off-word."""
+    return None if raw.strip().lower() in OFF_WORDS else raw
+
+
+def parse_int(raw: str) -> int:
+    return int(raw)
+
+
+def parse_positive_int(raw: str) -> int:
+    n = int(raw)
+    if n <= 0:
+        raise ValueError(f"expected a positive integer, got {n}")
+    return n
+
+
+def parse_float(raw: str) -> float:
+    return float(raw)
+
+
+def parse_one(raw: str) -> bool:
+    """Strict opt-in: only the literal ``"1"`` enables."""
+    return raw == "1"
+
+
+class EnvVar:
+    """One declared variable. ``get()`` parses the live environment
+    value (typos degrade to the default); ``raw()``/``is_set()`` serve
+    the call sites whose semantics the common parsers can't express —
+    both still count as going "through the registry" because the
+    *declaration* is what the lint rule, the propagation snapshot and
+    the doc table key off."""
+
+    __slots__ = ("name", "default", "parser", "doc", "propagate", "kind")
+
+    def __init__(self, name: str, *, default=None,
+                 parser: Optional[Callable[[str], object]] = None,
+                 doc: str = "", propagate: bool = False,
+                 kind: str = "str"):
+        self.name = name
+        self.default = default
+        self.parser = parser
+        self.doc = doc
+        self.propagate = propagate
+        self.kind = kind
+
+    def raw(self) -> Optional[str]:
+        """The unparsed environment value (``None`` when unset)."""
+        return os.environ.get(self.name)
+
+    def is_set(self) -> bool:
+        return self.name in os.environ
+
+    def get(self, default=_UNSET):
+        """Parsed value; the declared default (or ``default=``) when
+        unset or malformed — a typo degrades, it never raises."""
+        fallback = self.default if default is _UNSET else default
+        raw = os.environ.get(self.name)
+        if raw is None:
+            return fallback
+        if self.parser is None:
+            return raw
+        try:
+            return self.parser(raw)
+        except (ValueError, TypeError):
+            return fallback
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"EnvVar({self.name!r}, default={self.default!r}, "
+                f"propagate={self.propagate})")
+
+
+REGISTRY: Dict[str, EnvVar] = {}
+
+
+def declare(name: str, *, default=None,
+            parser: Optional[Callable[[str], object]] = None,
+            doc: str = "", propagate: bool = False,
+            kind: str = "str") -> EnvVar:
+    """Register one variable (module-definition time only). Raises on a
+    duplicate declaration — "declared once" is the whole point."""
+    if name in REGISTRY:
+        raise ValueError(f"environment variable {name!r} declared twice")
+    v = REGISTRY[name] = EnvVar(name, default=default, parser=parser,
+                                doc=doc, propagate=propagate, kind=kind)
+    return v
+
+
+def lookup(name: str) -> EnvVar:
+    """The declared variable, for dynamic access (the lint rule checks
+    literal arguments here against the registry)."""
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"{name!r} is not a declared SKYLARK environment variable; "
+            f"declare it in libskylark_tpu/base/env.py") from None
+
+
+def propagated_names() -> Tuple[str, ...]:
+    """Names a process replica must agree with its parent on — every
+    declaration with ``propagate=True``, in declaration order. Feeds
+    ``fleet.replica.PROPAGATED_ENV`` mechanically."""
+    return tuple(v.name for v in REGISTRY.values() if v.propagate)
+
+
+def snapshot_propagated() -> Dict[str, Optional[str]]:
+    """Raw snapshot of every propagating variable in this process
+    (``None`` marks a variable the child must *unset*)."""
+    return {name: os.environ.get(name) for name in propagated_names()}
+
+
+# ---------------------------------------------------------------------------
+# declarations — one per SKYLARK_* variable, grouped by subsystem
+# ---------------------------------------------------------------------------
+
+# -- telemetry --------------------------------------------------------------
+
+TELEMETRY = declare(
+    "SKYLARK_TELEMETRY", default=False, parser=parse_flag, kind="flag",
+    propagate=True,
+    doc="Enable telemetry recording (any value but empty/``0``). "
+        "``SKYLARK_TELEMETRY_DIR`` also enables it implicitly.")
+
+TELEMETRY_DIR = declare(
+    "SKYLARK_TELEMETRY_DIR", default=None, kind="path", propagate=True,
+    doc="Directory for the JSONL telemetry exporter; setting it both "
+        "enables telemetry and auto-installs the exporter at first "
+        "import (docs/observability).")
+
+TPU_PROFILE = declare(
+    "SKYLARK_TPU_PROFILE", default=False, parser=parse_flag, kind="flag",
+    doc="Enable the phase timers (``utility.timer``); latched at first "
+        "use, ``timer.set_enabled`` overrides programmatically.")
+
+# -- engine / executable cache ---------------------------------------------
+
+EXEC_CACHE_SIZE = declare(
+    "SKYLARK_EXEC_CACHE_SIZE", default=128, parser=parse_positive_int,
+    kind="int",
+    doc="Capacity of the in-process executable LRU "
+        "(``engine.compiled``); read once at engine import.")
+
+ENGINE_DONATE = declare(
+    "SKYLARK_ENGINE_DONATE", default=False, parser=parse_one, kind="flag",
+    doc="``1`` lets the public solver entry points donate user operands "
+        "(invalidates the caller's arrays on every backend; "
+        "docs/performance \"donation caveats\").")
+
+EXEC_CACHE_DIR = declare(
+    "SKYLARK_EXEC_CACHE_DIR", default=None, parser=parse_path_or_off,
+    kind="path", propagate=True,
+    doc="jax persistent *compilation* cache directory (HLO-keyed, "
+        "tracing still paid). Deprecated as an AOT artifact-store "
+        "alias — set ``SKYLARK_AOT_DIR`` for artifacts.")
+
+ENGINE_STATS_DUMP = declare(
+    "SKYLARK_ENGINE_STATS_DUMP", default=None, kind="path",
+    doc="Write the engine's reset-proof stats rollup to this path at "
+        "process exit (the CI jit-leak gate's artifact).")
+
+AOT_DIR = declare(
+    "SKYLARK_AOT_DIR", default=None, parser=parse_path_or_off,
+    kind="path", propagate=True,
+    doc="Persistent AOT executable artifact store "
+        "(``engine.aot``); an off-word disables even when the "
+        "deprecated ``SKYLARK_EXEC_CACHE_DIR`` alias is present.")
+
+AOT_LOCK_STALE = declare(
+    "SKYLARK_AOT_LOCK_STALE", default=600.0, parser=parse_float,
+    kind="float",
+    doc="Age in seconds past which a peer's AOT file lock is presumed "
+        "dead and broken.")
+
+AOT_LOCK_TIMEOUT = declare(
+    "SKYLARK_AOT_LOCK_TIMEOUT", default=600.0, parser=parse_float,
+    kind="float",
+    doc="Seconds a cold process waits on the cross-process AOT compile "
+        "lock before compiling anyway (liveness over single-flight).")
+
+# -- serving / fleet --------------------------------------------------------
+
+#: The flush-kernel backends (the authority — ``engine.serve`` imports
+#: this as its ``_KERNEL_BACKENDS``, so the env parser and the
+#: executor's ``kernel=`` validation can never accept different sets).
+SERVE_KERNEL_BACKENDS = ("pallas", "xla")
+
+SERVE_KERNEL = declare(
+    "SKYLARK_SERVE_KERNEL", default=None, kind="choice", propagate=True,
+    parser=lambda raw: (raw.strip().lower()
+                        if raw.strip().lower() in SERVE_KERNEL_BACKENDS
+                        else None),
+    doc="One-shot flush-kernel override between the executor argument "
+        "and the tune plan cache (``pallas`` | ``xla``; anything else "
+        "degrades to cache consultation).")
+
+BOOT_T0 = declare(
+    "SKYLARK_BOOT_T0", default=None, parser=parse_float, kind="float",
+    doc="Parent's ``time.time()`` at replica spawn; the boot probe "
+        "reports honest wall-from-spawn time-to-first-result.")
+
+FAULT_PLAN = declare(
+    "SKYLARK_FAULT_PLAN", default=None, kind="json",
+    doc="Deterministic fault-injection plan (inline JSON or a path); "
+        "activates the chaos sites process-wide "
+        "(docs/resilience).")
+
+LOCK_WITNESS = declare(
+    "SKYLARK_LOCK_WITNESS", default=False, parser=parse_flag, kind="flag",
+    doc="Instrumented-lock mode: locks built by ``base.locks`` record "
+        "their runtime acquisition order and the witness fails on "
+        "cycles (enabled in the CI chaos battery; docs/analysis).")
+
+# -- tune / plan cache ------------------------------------------------------
+
+PLAN_CACHE = declare(
+    "SKYLARK_PLAN_CACHE", default=None, parser=parse_path_or_off,
+    kind="path", propagate=True,
+    doc="Autotuner plan-cache file. Unset: the repo/benchmarks or "
+        "``~/.cache`` default; an off-word disables persistence.")
+
+USE_PLAN_CACHE = declare(
+    "SKYLARK_USE_PLAN_CACHE", default=True, parser=parse_bool_default_on,
+    kind="flag",
+    doc="Consult the plan cache at dispatch time (default on); "
+        "``0`` disables all cached-plan consultation.")
+
+# -- sketch kernels ---------------------------------------------------------
+
+PALLAS_MTILE = declare(
+    "SKYLARK_PALLAS_MTILE", default=None, parser=parse_int, kind="int",
+    doc="Explicit Pallas m-tile (>= 8); a valid value is a user pin "
+        "that beats any cached plan (on-chip sweeps).")
+
+MATMUL_PRECISION = declare(
+    "SKYLARK_MATMUL_PRECISION", default=None, kind="choice",
+    doc="Ambient jax matmul precision installed at package import "
+        "(default ``highest``; ``default`` opts out of installation).")
+
+FASTFOOD_PRECISION = declare(
+    "SKYLARK_FASTFOOD_PRECISION", default=None, kind="choice",
+    doc="Contraction regime inside the fused fastfood kernel "
+        "(``f32`` | ``bf16x3`` | ``bf16``); overrides cached plans.")
+
+PALLAS_PIPELINE = declare(
+    "SKYLARK_PALLAS_PIPELINE", default=None, kind="choice",
+    doc="Tri-state pipelined-kernel override: unset lets a cached plan "
+        "decide, ``1`` forces on, anything else forces off.")
+
+HASH_KERNEL = declare(
+    "SKYLARK_HASH_KERNEL", default=None, kind="choice",
+    doc="CWT/CountSketch flush kernel override: ``pallas``/``mxu``/"
+        "``1``, ``pallas_exact``/``exact``, else the XLA scatter.")
+
+PALLAS_VMEM_BUDGET = declare(
+    "SKYLARK_PALLAS_VMEM_BUDGET", default=16 * 1024 * 1024,
+    parser=parse_int, kind="bytes",
+    doc="Per-core VMEM budget the Pallas kernels plan against "
+        "(~16 MiB on current generations; no runtime query API).")
+
+PALLAS_SCRATCH_CAP = declare(
+    "SKYLARK_PALLAS_SCRATCH_CAP", default=8 * 1024 * 1024,
+    parser=parse_int, kind="bytes",
+    doc="VMEM cap for caching the generated operator across m-tiles "
+        "(must leave room for the double-buffered pipeline tiles).")
+
+AUTO_MATERIALIZE = declare(
+    "SKYLARK_AUTO_MATERIALIZE", default=True,
+    parser=parse_bool_default_on, kind="flag",
+    doc="Automatic materialize-and-reuse dispatch for OperatorCache "
+        "transforms (default on; ``0`` disables — "
+        "``sketch/params.py``).")
+
+# -- io ---------------------------------------------------------------------
+
+STREAM_PREFETCH = declare(
+    "SKYLARK_STREAM_PREFETCH", default=2, parser=parse_int, kind="int",
+    doc="Prefetch depth of the double-buffered streaming overlap "
+        "(``io.chunked``); 0 disables the overlap.")
+
+WEBHDFS_RETRIES = declare(
+    "SKYLARK_WEBHDFS_RETRIES", default=4, parser=parse_int, kind="int",
+    doc="Attempt bound of the WebHDFS transport's default retry "
+        "policy.")
+
+
+__all__ = [
+    "EnvVar", "OFF_WORDS", "REGISTRY", "declare", "lookup",
+    "parse_flag", "parse_bool_default_on", "parse_path_or_off",
+    "parse_int", "parse_positive_int", "parse_float", "parse_one",
+    "propagated_names", "snapshot_propagated",
+]

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import contextlib
 import functools
-import os
 
 import jax
 
@@ -92,15 +91,17 @@ def install_default_matmul_precision() -> None:
     wrong, not fast. Opt out (or pick another regime) with
     ``SKYLARK_MATMUL_PRECISION`` ∈ {default, high, highest, ...jax names};
     throughput paths opt into bf16 explicitly via sketch/params.py."""
+    from libskylark_tpu.base import env as _env
+
     global _INSTALLED_AMBIENT
-    value = os.environ.get("SKYLARK_MATMUL_PRECISION", "highest")
+    value = _env.MATMUL_PRECISION.get("highest")
     if value == "default":
         return
     try:
         jax.config.update("jax_default_matmul_precision", value)
         _INSTALLED_AMBIENT = value
     except Exception:
-        if "SKYLARK_MATMUL_PRECISION" in os.environ:
+        if _env.MATMUL_PRECISION.is_set():
             # a typo must not silently leave the bf16 factory lowering in
             # place — that is the exact failure this function prevents
             import warnings

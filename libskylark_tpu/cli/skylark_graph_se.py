@@ -36,7 +36,6 @@ def main(argv=None) -> int:
 
     honor_platform_env()
     args = build_parser().parse_args(argv)
-    import numpy as np
 
     from libskylark_tpu.base.context import Context
     from libskylark_tpu.cli import write_ascii_matrix

@@ -56,7 +56,7 @@ def main(argv=None) -> int:
 
     import libskylark_tpu.io as skio
     from libskylark_tpu.base.context import Context
-    from libskylark_tpu.cli import read_dataset, write_ascii_matrix
+    from libskylark_tpu.cli import write_ascii_matrix
     from libskylark_tpu.nla.svd import (
         ApproximateSVDParams,
         approximate_svd,

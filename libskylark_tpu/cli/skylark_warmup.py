@@ -164,21 +164,18 @@ def _cmd_verify(args) -> int:
 
 
 def _cmd_boot_probe(args) -> int:
-    import os
     import time
 
+    from libskylark_tpu.base import env as _env
     from libskylark_tpu.engine import warmup
 
     report = warmup.serve_probe(args.pack, load=not args.no_load)
     # wall time since the parent spawned us (SKYLARK_BOOT_T0 = parent's
     # time.time() at spawn): the honest time-to-first-result including
     # interpreter + jax import — what a cold autoscaled replica pays
-    t0 = os.environ.get("SKYLARK_BOOT_T0")
-    if t0:
-        try:
-            report["wall_since_spawn_s"] = round(time.time() - float(t0), 4)
-        except ValueError:
-            pass
+    t0 = _env.BOOT_T0.get()
+    if t0 is not None:
+        report["wall_since_spawn_s"] = round(time.time() - t0, 4)
     print("BOOT_PROBE " + json.dumps(report))
     ok = report["bit_equal"]
     if not args.no_load:

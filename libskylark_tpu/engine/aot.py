@@ -55,12 +55,12 @@ import time
 import warnings
 from typing import Any, Optional
 
+from libskylark_tpu.base import env as _env
+
 AOT_SCHEMA = 1
 
 _MAGIC = b"SKYAOT1\n"
 _SUFFIX = ".skyaot"
-_OFF = ("", "0", "off", "no", "false")
-
 # builder-scoped dir override (engine.warmup writes a pack's artifacts
 # without touching the process environment)
 _DIR_OVERRIDE: Optional[str] = None
@@ -90,11 +90,12 @@ def aot_dir() -> Optional[str]:
     global _alias_warned
     if _DIR_OVERRIDE is not None:
         return _DIR_OVERRIDE
-    v = os.environ.get("SKYLARK_AOT_DIR")
-    if v is not None:
-        return None if v.strip().lower() in _OFF else v
-    legacy = os.environ.get("SKYLARK_EXEC_CACHE_DIR")
-    if legacy and legacy.strip().lower() not in _OFF:
+    if _env.AOT_DIR.is_set():
+        # set: the parsed value (an off-word parses to None — disabled,
+        # and the legacy alias below must NOT resurrect the store)
+        return _env.AOT_DIR.get()
+    legacy = _env.EXEC_CACHE_DIR.get()
+    if legacy:
         if not _alias_warned:
             _alias_warned = True
             warnings.warn(
@@ -127,17 +128,11 @@ def override_dir(path: Optional[str]):
 
 
 def lock_stale_seconds() -> float:
-    try:
-        return float(os.environ.get("SKYLARK_AOT_LOCK_STALE", "600"))
-    except ValueError:
-        return 600.0
+    return _env.AOT_LOCK_STALE.get()
 
 
 def lock_timeout() -> float:
-    try:
-        return float(os.environ.get("SKYLARK_AOT_LOCK_TIMEOUT", "600"))
-    except ValueError:
-        return 600.0
+    return _env.AOT_LOCK_TIMEOUT.get()
 
 
 # ---------------------------------------------------------------------------

@@ -41,6 +41,8 @@ import threading
 from collections import OrderedDict
 from typing import Any, Hashable, Optional
 
+from libskylark_tpu.base import locks as _locks
+
 
 @dataclasses.dataclass
 class EngineStats:
@@ -119,7 +121,7 @@ class ExecutableCache:
         self.maxsize = int(maxsize)
         self._entries: "OrderedDict[Hashable, CacheEntry]" = OrderedDict()
         self._seen: set = set()
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("engine.cache")
         # key -> Event for compiles in flight (single-flight discipline)
         self._inflight: dict = {}
         self.stats = EngineStats()

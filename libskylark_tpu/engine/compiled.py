@@ -51,7 +51,6 @@ import hashlib
 import inspect
 import json
 import os
-import threading
 import time
 import warnings
 from typing import Callable, Optional, Sequence
@@ -59,6 +58,8 @@ from typing import Callable, Optional, Sequence
 import jax
 
 from libskylark_tpu import telemetry as _telemetry
+from libskylark_tpu.base import env as _env
+from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.engine import aot as _aot
 from libskylark_tpu.engine.cache import CacheEntry, EngineStats, ExecutableCache
 from libskylark_tpu.resilience import faults as _faults
@@ -69,11 +70,7 @@ from libskylark_tpu.resilience import faults as _faults
 
 
 def _cache_size() -> int:
-    try:
-        n = int(os.environ.get("SKYLARK_EXEC_CACHE_SIZE", "128"))
-        return n if n > 0 else 128
-    except ValueError:
-        return 128
+    return _env.EXEC_CACHE_SIZE.get()
 
 
 _CACHE = ExecutableCache(maxsize=_cache_size())
@@ -138,7 +135,7 @@ def donation_enabled() -> bool:
     """Whether solver entry points donate their operands
     (``SKYLARK_ENGINE_DONATE=1``). Off by default: donation invalidates
     the caller's arrays (on every backend, CPU included)."""
-    return os.environ.get("SKYLARK_ENGINE_DONATE", "0") == "1"
+    return _env.ENGINE_DONATE.get()
 
 
 def maybe_donate(argnums: Sequence[int]) -> tuple[int, ...]:
@@ -160,7 +157,7 @@ def enable_persistent_cache(path: Optional[str] = None) -> bool:
     raises — the persistent cache is an optimization, not a failure
     mode."""
     global _persistent_wired
-    path = path or os.environ.get("SKYLARK_EXEC_CACHE_DIR")
+    path = path or _env.EXEC_CACHE_DIR.raw()
     if not path or path.strip().lower() in ("0", "off", "no", "false"):
         return False
     try:
@@ -200,7 +197,7 @@ def enable_persistent_cache(path: Optional[str] = None) -> bool:
 
 def _maybe_wire_persistent() -> None:
     global _persistent_wired
-    if not _persistent_wired and "SKYLARK_EXEC_CACHE_DIR" in os.environ:
+    if not _persistent_wired and _env.EXEC_CACHE_DIR.is_set():
         _persistent_wired = True  # one attempt per process
         enable_persistent_cache()
 
@@ -319,7 +316,7 @@ class CompiledFn:
         self.stats = EngineStats()
         # per-wrapper counters are bumped from serve worker threads too;
         # bare += on a dataclass field is a read-modify-write race
-        self._stats_lock = threading.Lock()
+        self._stats_lock = _locks.make_lock("engine.fn_stats")
         self._code_version = None
         functools.update_wrapper(self, fn)
 
@@ -553,7 +550,7 @@ def dump_stats(path: str) -> None:
 
 
 def _install_stats_dump() -> None:
-    path = os.environ.get("SKYLARK_ENGINE_STATS_DUMP")
+    path = _env.ENGINE_STATS_DUMP.get()
     if not path:
         return
     import atexit

@@ -83,6 +83,8 @@ from typing import Optional
 import numpy as np
 
 from libskylark_tpu import telemetry as _telemetry
+from libskylark_tpu.base import env as _env
+from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.engine import bucket as bucketing
 from libskylark_tpu.engine.compiled import compiled as engine_compile
 from libskylark_tpu.engine.compiled import digest as engine_digest
@@ -99,7 +101,7 @@ ENDPOINTS = ("sketch_apply", "fastfood_features", "solve_l2_sketched",
 # through the vmapped XLA path
 _KERNEL_ENDPOINTS = ("sketch_apply", "fastfood_features")
 
-_KERNEL_BACKENDS = ("pallas", "xla")
+_KERNEL_BACKENDS = _env.SERVE_KERNEL_BACKENDS
 
 # auto-assigned replica identity labels ("ex-0", "ex-1", ...) for
 # executors constructed without an explicit ``name`` — every executor
@@ -181,14 +183,8 @@ def _serve_kernel_env():
     executor argument and the tune plan cache in the flush-kernel
     precedence (``pallas`` | ``xla``; anything else is ignored so a
     typo degrades to cache consultation, the repo's env-parse
-    convention)."""
-    import os
-
-    v = os.environ.get("SKYLARK_SERVE_KERNEL")
-    if v is None:
-        return None
-    v = v.strip().lower()
-    return v if v in _KERNEL_BACKENDS else None
+    convention — the registry parser encodes exactly that)."""
+    return _env.SERVE_KERNEL.get()
 
 
 def _pallas_native() -> bool:
@@ -461,7 +457,7 @@ class MicrobatchExecutor:
             self._batch_axis = tuple(mesh.shape.keys())[0]
             self._ndev = int(mesh.shape[self._batch_axis])
 
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("serve.state")
         self._work_cv = threading.Condition(self._lock)   # flusher wakeups
         self._space_cv = threading.Condition(self._lock)  # backpressure
         self._idle_cv = threading.Condition(self._lock)   # drain quiescence
@@ -472,7 +468,7 @@ class MicrobatchExecutor:
         self._draining = False
 
         self._compiled: dict = {}          # bucket key -> CompiledFn
-        self._compiled_lock = threading.Lock()
+        self._compiled_lock = _locks.make_lock("serve.compiled")
         # flush-kernel selection (docs/performance "Serve-bucket kernel
         # selection"): the explicit argument tops the precedence; the
         # memo makes key_fn's per-call re-resolution a dict hit, keyed
@@ -482,7 +478,7 @@ class MicrobatchExecutor:
         self._kernel_memo: dict = {}
         self._kernel_memo_fp: Optional[str] = None
 
-        self._stats_lock = threading.Lock()
+        self._stats_lock = _locks.make_lock("serve.stats")
         self._counts = collections.Counter()
         # flush-kernel selection counters (per flush): backend ->
         # flushes served, decline-reason -> flushes that fell back
@@ -500,7 +496,7 @@ class MicrobatchExecutor:
         # the resilience hub (fleet routers subscribe); guarded by its
         # own lock so a flush worker and a drain can race a transition
         # without serializing on the executor lock
-        self._pub_lock = threading.Lock()
+        self._pub_lock = _locks.make_lock("serve.pub")
         self._published_state = SERVING
 
         import queue as _queue

@@ -26,6 +26,7 @@ import threading
 import warnings
 from typing import Callable, Dict, List, Optional
 
+from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.engine import bucket as bucketing
 from libskylark_tpu.fleet.replica import (ProcessReplica, Replica,
                                           ThreadReplica)
@@ -89,7 +90,7 @@ class ReplicaPool:
         self.pad_floor = int(executor_kwargs.get(
             "pad_floor", bucketing.PAD_FLOOR))
         self.max_batch = int(executor_kwargs.get("max_batch", 8))
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("fleet.pool")
         self._drain_hooks: Dict[str, list] = {name: [] for name in names}
         self._drained: set = set()
         self._replicas: Dict[str, Replica] = {}

@@ -48,6 +48,8 @@ import warnings
 from concurrent.futures import Future
 from typing import Optional
 
+from libskylark_tpu.base import env as _env
+from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.engine.serve import ServeOverloadedError
 
 # Environment a replica child must agree with its parent on — the AOT
@@ -59,21 +61,17 @@ from libskylark_tpu.engine.serve import ServeOverloadedError
 # ``Process.start()`` happened to run (a parent that configures its
 # store after constructing the pool — or a test that monkeypatches
 # around replica construction — must still produce children that
-# agree with it).
-PROPAGATED_ENV = (
-    "SKYLARK_AOT_DIR",
-    "SKYLARK_EXEC_CACHE_DIR",
-    "SKYLARK_PLAN_CACHE",
-    "SKYLARK_TELEMETRY",
-    "SKYLARK_TELEMETRY_DIR",
-    "SKYLARK_SERVE_KERNEL",
-)
+# agree with it). The tuple is DERIVED from the typed registry
+# (``base/env.py``: every declaration with ``propagate=True``), so a
+# newly declared variable can never again silently miss propagation —
+# the registry declaration is the single place that decides.
+PROPAGATED_ENV = _env.propagated_names()
 
 
 def propagated_env() -> dict:
     """Snapshot of :data:`PROPAGATED_ENV` in this process (``None``
     marks a variable to *unset* in the child)."""
-    return {k: os.environ.get(k) for k in PROPAGATED_ENV}
+    return _env.snapshot_propagated()
 
 
 def _apply_env(env: Optional[dict]) -> None:
@@ -90,10 +88,9 @@ def _apply_env(env: Optional[dict]) -> None:
     try:
         from libskylark_tpu import telemetry
 
-        telemetry.set_enabled(
-            os.environ.get("SKYLARK_TELEMETRY", "") not in ("", "0")
-            or bool(os.environ.get("SKYLARK_TELEMETRY_DIR")))
-        if os.environ.get("SKYLARK_TELEMETRY_DIR"):
+        telemetry.set_enabled(bool(_env.TELEMETRY.get())
+                              or bool(_env.TELEMETRY_DIR.get()))
+        if _env.TELEMETRY_DIR.get():
             telemetry.install_exporter()
     except Exception:  # noqa: BLE001 — telemetry must not block boot
         pass
@@ -229,7 +226,7 @@ def _worker_main(conn, name: str, executor_kwargs: dict,
             #                     a bad pack; the compile path serves
             warmup_report = {"skipped": f"load failed: {e!r}"}
 
-    send_lock = threading.Lock()
+    send_lock = _locks.make_lock("fleet.replica_send")
 
     def send(msg) -> None:
         with send_lock:
@@ -276,8 +273,7 @@ def _worker_main(conn, name: str, executor_kwargs: dict,
                 # the pack-load report (the env-propagation regression
                 # test and fleet debugging read this)
                 send(("rpc", rid, {
-                    "env": {k: os.environ.get(k)
-                            for k in PROPAGATED_ENV},
+                    "env": _env.snapshot_propagated(),
                     "warmup": warmup_report,
                     "engine": engine.stats().to_dict(),
                 }))
@@ -327,7 +323,7 @@ class ProcessReplica(Replica):
             name=f"skylark-replica-{self.name}", daemon=True)
         self._proc.start()
         child_conn.close()
-        self._lock = threading.Lock()          # send + bookkeeping
+        self._lock = _locks.make_lock("fleet.replica")  # send + bookkeeping
         self._rids = itertools.count()
         self._futures: "dict[int, Future]" = {}
         self._state = "SERVING"

@@ -35,8 +35,9 @@ from __future__ import annotations
 
 import bisect
 import hashlib
-import threading
 from typing import Iterable, Iterator, List, Tuple
+
+from libskylark_tpu.base import locks as _locks
 
 
 def ring_hash(data: str) -> int:
@@ -60,7 +61,7 @@ class HashRing:
         if vnodes < 1:
             raise ValueError("vnodes must be >= 1")
         self._vnodes = int(vnodes)
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("fleet.ring")
         self._members: set = set()
         self._points: List[Tuple[int, str]] = []   # sorted (point, member)
         for m in members:

@@ -48,12 +48,12 @@ span (same request id), and a ``fleet`` collector block in
 from __future__ import annotations
 
 import collections
-import threading
 import weakref
 from concurrent.futures import Future
 from typing import Iterable, Optional
 
 from libskylark_tpu import telemetry as _telemetry
+from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.engine import serve as _serve
 from libskylark_tpu.fleet.pool import ReplicaPool
 from libskylark_tpu.fleet.ring import HashRing
@@ -105,7 +105,7 @@ class Router:
         self.spill_threshold = int(
             spill_threshold if spill_threshold is not None
             else 4 * pool.max_batch)
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("fleet.router")
         self._degraded: set = set()
         self._removed: set = set()
         self._counts = collections.Counter()

@@ -12,7 +12,6 @@ a local :class:`SparseMatrix` whose device COO can be sharded by the caller.
 
 from __future__ import annotations
 
-from typing import Tuple
 
 import numpy as np
 

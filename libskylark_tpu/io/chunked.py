@@ -24,7 +24,6 @@ arrival-order streaming sketch, ref: python-skylark/skylark/streaming.py).
 
 from __future__ import annotations
 
-import os
 import queue
 import threading
 from typing import Iterable, Iterator, Optional, Tuple, Union
@@ -33,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from libskylark_tpu.base import env as _env
 from libskylark_tpu.base import errors
 from libskylark_tpu.resilience import faults
 from libskylark_tpu.resilience.policy import RetryPolicy
@@ -61,16 +61,10 @@ def _io_retry() -> RetryPolicy:
 # 2 slots = the classic double buffer (one batch on device computing,
 # the next one parsing/transferring). SKYLARK_STREAM_PREFETCH sets the
 # depth; 0 disables the overlap everywhere it defaults on.
-_PREFETCH_DEPTH_DEFAULT = 2
 
 
 def default_prefetch_depth() -> int:
-    try:
-        d = int(os.environ.get("SKYLARK_STREAM_PREFETCH",
-                               _PREFETCH_DEPTH_DEFAULT))
-    except ValueError:
-        return _PREFETCH_DEPTH_DEFAULT
-    return max(0, d)
+    return max(0, _env.STREAM_PREFETCH.get())
 
 
 class _PrefetchDone:

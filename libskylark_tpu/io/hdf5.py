@@ -36,6 +36,8 @@ def _require_h5py():
         return h5py
     except ImportError as e:
         raise errors.UnsupportedError(
+            # SKYLARK_HAVE_HDF5 is the reference repo's C++ config
+            # symbol, not an env var  # skylark-lint: disable=env-registry
             "h5py not available; HDF5 IO disabled "
             "(ref: config.h.in SKYLARK_HAVE_HDF5 gate)"
         ) from e

@@ -28,7 +28,7 @@ mirroring the reference's pure-Python sketch fallbacks
 from __future__ import annotations
 
 import os
-from typing import List, Optional, Sequence, Tuple, Union
+from typing import List, Sequence, Tuple, Union
 
 import numpy as np
 

@@ -12,7 +12,7 @@ Python layer's lib-missing fallbacks (ref: python sketch.py:752).
 from __future__ import annotations
 
 import ctypes
-from typing import Optional, Tuple
+from typing import Optional
 
 import numpy as np
 

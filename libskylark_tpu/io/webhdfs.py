@@ -43,12 +43,12 @@ protocol on another host).
 from __future__ import annotations
 
 import dataclasses
-import os
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Iterator, Optional
 
+from libskylark_tpu.base import env as _env
 from libskylark_tpu.base import errors
 from libskylark_tpu.resilience import faults
 from libskylark_tpu.resilience.policy import DeadlineExceededError, RetryPolicy
@@ -83,11 +83,7 @@ def _is_transient(e: BaseException) -> bool:
 def default_retry() -> RetryPolicy:
     """The transport's default policy (``SKYLARK_WEBHDFS_RETRIES``
     bounds attempts, default 4)."""
-    try:
-        attempts = max(1, int(os.environ.get("SKYLARK_WEBHDFS_RETRIES",
-                                             "4")))
-    except ValueError:
-        attempts = 4
+    attempts = max(1, _env.WEBHDFS_RETRIES.get())
     return RetryPolicy(max_attempts=attempts, base_delay=0.1,
                        max_delay=2.0, retry_on=_is_transient)
 

@@ -17,7 +17,7 @@ the accelerator.
 from __future__ import annotations
 
 import math
-from typing import Dict, Hashable, Iterable, Optional, Sequence, Set, Tuple
+from typing import Dict, Hashable, Iterable, Optional, Set, Tuple
 
 import jax.numpy as jnp
 import numpy as np

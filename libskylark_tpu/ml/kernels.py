@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import json
 import math
-from typing import Any, Optional, Union
+from typing import Any, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -56,7 +56,7 @@ def _inner_gram(X, Y=None) -> jnp.ndarray:
     """X·Yᵀ for the inner-product kernels (linear/polynomial), staying O(nnz)
     for :class:`SparseMatrix` inputs instead of densifying
     (ref: base/Gemm.hpp:335-519 sparse×dense kernels)."""
-    from libskylark_tpu.base.sparse import SparseMatrix, spmm, spmm_t
+    from libskylark_tpu.base.sparse import SparseMatrix, spmm
 
     if isinstance(X, SparseMatrix):
         Yd = _as_dense(X if Y is None else Y)

@@ -9,7 +9,7 @@ sketch, SVD the cross product, truncate.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax.numpy as jnp
 

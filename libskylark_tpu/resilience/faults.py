@@ -73,9 +73,11 @@ import json
 import os
 import random
 import threading
-from typing import Iterable, Optional, Sequence
+from typing import Iterable, Optional
 
+from libskylark_tpu.base import env as _env
 from libskylark_tpu.base import errors
+from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.telemetry import metrics as _metrics
 
 _VALID_KEYS = {"site", "error", "message", "on_hit", "every", "prob",
@@ -164,7 +166,7 @@ class FaultPlan:
         self.specs = [FaultSpec(d, self.seed, i)
                       for i, d in enumerate(doc.get("faults", []))]
         self._sites = {s.site for s in self.specs}
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("resilience.fault_plan")
         self.fired: list[tuple] = []      # (site, matching-hit, error name)
 
     @classmethod
@@ -215,7 +217,7 @@ class FaultPlan:
 # ---------------------------------------------------------------------------
 
 _STACK: list[FaultPlan] = []
-_STACK_LOCK = threading.Lock()
+_STACK_LOCK = _locks.make_lock("resilience.fault_stack")
 _ENV_RAW: Optional[str] = None
 _ENV_PLAN: Optional[FaultPlan] = None
 
@@ -226,7 +228,7 @@ def active_plan() -> Optional[FaultPlan]:
     else ``None`` (every site a no-op)."""
     if _STACK:
         return _STACK[-1]
-    env = os.environ.get("SKYLARK_FAULT_PLAN")
+    env = _env.FAULT_PLAN.raw()
     if not env:
         return None
     global _ENV_RAW, _ENV_PLAN

@@ -26,13 +26,13 @@ records carry the state history for free.
 
 from __future__ import annotations
 
-import threading
 import warnings
 from typing import Callable
 
+from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.telemetry import metrics as _metrics
 
-_LOCK = threading.Lock()
+_LOCK = _locks.make_lock("resilience.health")
 _SUBSCRIBERS: "list[Callable[[object, str, str], None]]" = []
 
 # always-on (the transition itself — a drain, a DEGRADED flip — dwarfs

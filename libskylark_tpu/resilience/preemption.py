@@ -42,7 +42,9 @@ import threading
 import warnings
 from typing import Callable, Optional, Sequence
 
-_LOCK = threading.Lock()
+from libskylark_tpu.base import locks as _locks
+
+_LOCK = _locks.make_lock("resilience.preemption")
 _EVENT = threading.Event()
 _PREV: dict[int, object] = {}          # signum -> previous handler
 _HOOKS: list[Callable[[], None]] = []

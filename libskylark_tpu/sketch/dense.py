@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from libskylark_tpu.base import errors, randgen
+from libskylark_tpu.base import randgen
 from libskylark_tpu.sketch import params as sketch_params
 from libskylark_tpu.sketch.transform import (OperatorCache,
                                              SketchTransform, register)

@@ -45,11 +45,10 @@ def fut_apply_policy(fut_obj, fut_name: str, W):
     the serve-layer pure apply so the two paths cannot drift."""
     if fut_name != "wht":
         return fut_obj.apply(W, axis=-1)
-    import os
-
+    from libskylark_tpu.base import env as _env
     from libskylark_tpu.base import precision as bprec
 
-    prec = (None if os.environ.get("SKYLARK_MATMUL_PRECISION")
+    prec = (None if _env.MATMUL_PRECISION.raw()
             or bprec.ambient_precision_pinned_by_user()
             else jax.lax.Precision.HIGH)
     return fut_obj.apply(W, axis=-1, precision=prec)

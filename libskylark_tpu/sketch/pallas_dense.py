@@ -17,12 +17,12 @@ kernel can't take fall back to the XLA path in sketch/dense.py.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+from libskylark_tpu.base import env as _env
 from libskylark_tpu.base import randgen, threefry as tf
 
 try:  # import guarded so non-TPU environments can import the module
@@ -159,22 +159,11 @@ def _dot(lhs, rhs, dims, precision, gen_side=1):
     )
 
 
-def _env_bytes(name: str, default: int) -> int:
-    """Env-overridable byte count; malformed values fall back (a typo
-    must degrade to the default, not crash every sketch apply — the
-    repo's env-parse convention, cf. params._env_m_tile)."""
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
 # Per-core VMEM budget the kernel plans against. ~16 MiB/core is the
 # common figure across current generations (v4/v5e/v5p; pallas_guide.md
 # memory-hierarchy table) — there is no runtime query API, so the default
 # is conservative and env-overridable for parts that have more.
-_VMEM_BUDGET_BYTES = _env_bytes(
-    "SKYLARK_PALLAS_VMEM_BUDGET", 16 * 1024 * 1024)
+_VMEM_BUDGET_BYTES = _env.PALLAS_VMEM_BUDGET.get()
 
 # VMEM budget for caching the generated operator across m-tiles. When the
 # full virtual S fits, each block is generated ONCE (first m-tile sweep)
@@ -184,8 +173,7 @@ _VMEM_BUDGET_BYTES = _env_bytes(
 # double-buffered A/out tiles inside _VMEM_BUDGET_BYTES (advisor r2
 # medium finding: the old 48 MiB default exceeded whole-VMEM on v5e and
 # could fail Mosaic compilation outright on the shard_map path).
-_SCRATCH_CAP_BYTES = _env_bytes(
-    "SKYLARK_PALLAS_SCRATCH_CAP", 8 * 1024 * 1024)
+_SCRATCH_CAP_BYTES = _env.PALLAS_SCRATCH_CAP.get()
 
 
 def _vmem_estimate(m_tile: int, s_dim: int, scratch_bytes: int) -> int:
@@ -274,7 +262,10 @@ def _pipeline_env() -> bool | None:
     Read at TRACE time: _fused_call's jit cache is keyed by shapes and
     static args only, so toggle the env before the first call of a
     given shape (the bench A/Bs in separate processes)."""
-    v = os.environ.get("SKYLARK_PALLAS_PIPELINE")
+    # deliberate trace-time env read (see docstring): the pipeline
+    # regime is resolved once per (shape, statics) trace and the env
+    # contract is toggle-before-first-call — not a flapping key
+    v = _env.PALLAS_PIPELINE.raw()  # skylark-lint: disable=jit-purity
     if v is None:
         return None
     return v == "1"

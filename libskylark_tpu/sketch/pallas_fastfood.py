@@ -35,18 +35,18 @@ tests/test_pallas_fastfood.py.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 
+from libskylark_tpu.base import env as _env
 from libskylark_tpu.sketch.fut import _hadamard_np
 from libskylark_tpu.sketch.pallas_dense import (_VMEM_BUDGET_BYTES, _dot,
                                                 available)
 
 try:  # same import seam as pallas_dense: CPU-only hosts lack TPU pallas
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401 — availability probe
 
     _PALLAS = True
 except Exception:  # pragma: no cover
@@ -432,7 +432,7 @@ def features_rows(transform, At, *, interpret: bool = False,
     # always win — the documented dispatch precedence,
     # sketch/params.py ``use_plan_cache``)
     prec_open = (precision is None
-                 and os.environ.get("SKYLARK_FASTFOOD_PRECISION") is None)
+                 and _env.FASTFOOD_PRECISION.raw() is None)
     plan = (_consult_cache(T, At)
             if variant == "auto" or prec_open else None)
     cache_pinned_variant = False
@@ -455,7 +455,7 @@ def features_rows(transform, At, *, interpret: bool = False,
         # f32 would silently run an explicit fused certification at f32)
         plan = None
     if precision is None:
-        precision = os.environ.get("SKYLARK_FASTFOOD_PRECISION")
+        precision = _env.FASTFOOD_PRECISION.raw()
     if precision is None:
         # honor an explicit user matmul-precision policy exactly like
         # the XLA chain does (frft._fut_apply / r4 advisor): pins with
@@ -467,7 +467,7 @@ def features_rows(transform, At, *, interpret: bool = False,
         # decline and let the XLA chain run under the ambient setting
         from libskylark_tpu.base import precision as bprec
 
-        pinned = (os.environ.get("SKYLARK_MATMUL_PRECISION")
+        pinned = (_env.MATMUL_PRECISION.raw()
                   or (bprec.ambient_matmul_precision()
                       if bprec.ambient_precision_pinned_by_user()
                       else None))

@@ -495,8 +495,7 @@ def try_apply(transform, A, *, rowwise: bool) -> Optional[jnp.ndarray]:
     Returns None to decline — the caller keeps the XLA scatter. The
     conservative default (no plan, no override → decline) matches the
     module's not-yet-on-chip-certified status."""
-    import os
-
+    from libskylark_tpu.base import env as _env
     from libskylark_tpu.sketch import params as sketch_params
 
     if type(transform).__name__ != "CWT":
@@ -508,7 +507,7 @@ def try_apply(transform, A, *, rowwise: bool) -> Optional[jnp.ndarray]:
     if not pallas_ambient_ok(A):
         return None
     accum = None
-    env = os.environ.get("SKYLARK_HASH_KERNEL")
+    env = _env.HASH_KERNEL.raw()
     if env is not None:
         env = env.strip().lower()
         if env in ("pallas", "mxu", "1"):

@@ -9,6 +9,8 @@ generation into the matmul and HBM is large — callers with huge N opt in.
 (ref: sketch/sketch_params.hpp:19).
 """
 
+from libskylark_tpu.base import env as _env
+
 _blocksize = 0
 _factor = 20
 
@@ -75,16 +77,7 @@ def set_use_pallas(on: bool) -> None:
 # a cached winner) > cached plan > heuristic default. Disabled entirely
 # with SKYLARK_USE_PLAN_CACHE=0 (or set_use_plan_cache(False)); the
 # cache file location is SKYLARK_PLAN_CACHE (tune/cache.py).
-def _env_flag(name: str, default: bool) -> bool:
-    import os
-
-    v = os.environ.get(name)
-    if v is None:
-        return default
-    return v.strip().lower() not in ("0", "false", "off", "no", "")
-
-
-_use_plan_cache = _env_flag("SKYLARK_USE_PLAN_CACHE", True)
+_use_plan_cache = _env.USE_PLAN_CACHE.get()
 
 
 def get_use_plan_cache() -> bool:
@@ -160,13 +153,7 @@ _PALLAS_M_TILE_DEFAULT = 512
 
 
 def _env_m_tile() -> int:
-    import os
-
-    try:
-        v = int(os.environ.get("SKYLARK_PALLAS_MTILE",
-                               _PALLAS_M_TILE_DEFAULT))
-    except ValueError:
-        return _PALLAS_M_TILE_DEFAULT
+    v = _env.PALLAS_MTILE.get(_PALLAS_M_TILE_DEFAULT)
     return v if v >= 8 else _PALLAS_M_TILE_DEFAULT
 
 
@@ -185,15 +172,8 @@ def pallas_m_tile_overridden() -> bool:
     beat a cached winner or the sweep can't explore."""
     if _pallas_m_tile != _PALLAS_M_TILE_DEFAULT:
         return True
-    import os
-
-    v = os.environ.get("SKYLARK_PALLAS_MTILE")
-    if v is None:
-        return False
-    try:
-        return int(v) >= 8
-    except ValueError:
-        return False
+    v = _env.PALLAS_MTILE.get()
+    return v is not None and v >= 8
 
 
 def set_pallas_m_tile(t: int) -> None:
@@ -219,7 +199,7 @@ def set_pallas_m_tile(t: int) -> None:
 # change results vs the first (OperatorCache._materialize_changes_numerics;
 # explicit materialize() remains the visible way to choose the cached
 # regime on TPU). SKYLARK_AUTO_MATERIALIZE=0 disables the dispatch.
-_auto_materialize = _env_flag("SKYLARK_AUTO_MATERIALIZE", True)
+_auto_materialize = _env.AUTO_MATERIALIZE.get()
 _auto_materialize_after = 3
 _auto_materialize_bytes = 64 * 1024 * 1024
 

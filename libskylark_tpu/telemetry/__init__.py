@@ -30,8 +30,7 @@ cheap enough that the timing-sensitive tier-1 tests run with it off.
 
 from __future__ import annotations
 
-import os
-
+from libskylark_tpu.base import env as _env
 from libskylark_tpu.telemetry.metrics import (
     DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry, counter,
     enabled, gauge, histogram, register_collector, registry, set_enabled,
@@ -49,7 +48,7 @@ from libskylark_tpu.telemetry.export import (
 # Auto-install the JSONL exporter when the environment asks for it —
 # first telemetry import (the engine pulls this package) wires the
 # whole export path with zero host code.
-if os.environ.get("SKYLARK_TELEMETRY_DIR"):
+if _env.TELEMETRY_DIR.get():
     install_exporter()
 
 __all__ = [

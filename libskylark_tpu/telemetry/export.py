@@ -40,6 +40,8 @@ import threading
 import time
 from typing import Optional
 
+from libskylark_tpu.base import env as _env
+from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.telemetry import metrics as _metrics
 from libskylark_tpu.telemetry import trace as _trace
 
@@ -60,7 +62,7 @@ class JsonlExporter:
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._flush_interval = float(flush_interval_s)
         self._closed = False
-        self._io_lock = threading.Lock()
+        self._io_lock = _locks.make_lock("telemetry.export_io")
         self._wake = threading.Event()
         self._unsink = _trace.add_sink(self._on_span)
         self._unhook = self._register_preemption()
@@ -144,7 +146,7 @@ class JsonlExporter:
 
 
 _EXPORTER: Optional[JsonlExporter] = None
-_EXPORTER_LOCK = threading.Lock()
+_EXPORTER_LOCK = _locks.make_lock("telemetry.exporter")
 
 
 def install_exporter(directory: Optional[str] = None) -> Optional[JsonlExporter]:
@@ -153,7 +155,7 @@ def install_exporter(directory: Optional[str] = None) -> Optional[JsonlExporter]
     neither names a directory. Idempotent: one exporter per process
     (a second call with a different directory closes the first)."""
     global _EXPORTER
-    directory = directory or os.environ.get("SKYLARK_TELEMETRY_DIR")
+    directory = directory or _env.TELEMETRY_DIR.get()
     if not directory:
         return None
     with _EXPORTER_LOCK:

@@ -30,9 +30,10 @@ programmatically.
 
 from __future__ import annotations
 
-import os
-import threading
 from typing import Callable, Dict, Optional, Sequence, Tuple
+
+from libskylark_tpu.base import env as _env
+from libskylark_tpu.base import locks as _locks
 
 # ---------------------------------------------------------------------------
 # enablement: one module-level bool, read without a lock on the hot path
@@ -46,10 +47,8 @@ def enabled() -> bool:
     ``SKYLARK_TELEMETRY_DIR`` set / :func:`set_enabled`)."""
     global _ENABLED
     if _ENABLED is None:
-        _ENABLED = (
-            os.environ.get("SKYLARK_TELEMETRY", "") not in ("", "0")
-            or bool(os.environ.get("SKYLARK_TELEMETRY_DIR"))
-        )
+        _ENABLED = (bool(_env.TELEMETRY.get())
+                    or bool(_env.TELEMETRY_DIR.get()))
     return _ENABLED
 
 
@@ -84,7 +83,7 @@ class Metric:
                  registry: "Optional[MetricsRegistry]" = None):
         self.name = name
         self.help = help
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("telemetry.metric")
         self._values: Dict[Tuple, float] = {}
         self._registry = registry
 
@@ -224,7 +223,7 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("telemetry.registry")
         self._metrics: Dict[str, Metric] = {}
         self._collectors: Dict[str, Callable[[], dict]] = {}
 

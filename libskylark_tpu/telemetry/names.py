@@ -1,0 +1,55 @@
+"""Declared metric names: the single source of truth the
+``metric-names`` lint rule checks call sites against.
+
+Every counter/gauge/histogram recorded anywhere in
+``libskylark_tpu`` must be declared here once — (name, kind, one-line
+role) — and created at exactly one call site. The rule
+(:mod:`libskylark_tpu.analysis.rules.metric_names`) flags:
+
+- a creation call whose name is not declared here (typo'd or
+  undocumented metric);
+- a name created at more than one site (two sites would silently share
+  one instrument — or worse, disagree on its kind and raise at import);
+- a declaration with no remaining call site (stale — delete it);
+- a name that cannot render as a valid Prometheus metric (the exporter
+  maps ``.`` to ``_``; everything else must already conform).
+
+Naming convention: ``<subsystem>.<noun>`` (dots become underscores on
+the Prometheus surface, and counters grow ``_total`` there —
+``engine.compile_seconds`` scrapes as
+``skylark_engine_compile_seconds``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+#: name -> kind ("counter" | "gauge" | "histogram")
+METRICS: Dict[str, str] = {
+    # engine (engine/compiled.py)
+    "engine.compile_seconds": "histogram",
+    "engine.load_seconds": "histogram",
+    "engine.persistent_cache_failures": "counter",
+    # telemetry's own bookkeeping (telemetry/trace.py)
+    "telemetry.spans": "counter",
+    # tune (tune/cache.py)
+    "tune.plan_cache_lookups": "counter",
+    # ml (ml/admm.py)
+    "ml.admm.iterations": "counter",
+    "ml.admm.objective": "gauge",
+    "ml.admm.reldel": "gauge",
+    # io (io/chunked.py, io/webhdfs.py)
+    "io.chunked.batches": "counter",
+    "io.webhdfs.reconnects": "counter",
+    # resilience (resilience/faults.py, policy.py, health.py)
+    "resilience.faults_fired": "counter",
+    "resilience.retries": "counter",
+    "resilience.health_transitions": "counter",
+    # fleet (fleet/router.py)
+    "fleet.routed": "counter",
+    "fleet.affinity_hit": "counter",
+    "fleet.failover": "counter",
+    "fleet.spilled": "counter",
+}
+
+__all__ = ["METRICS"]

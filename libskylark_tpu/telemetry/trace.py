@@ -48,6 +48,7 @@ import time
 from collections import deque
 from typing import Callable, Iterator, Optional
 
+from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.telemetry import metrics as _metrics
 
 # ---------------------------------------------------------------------------
@@ -157,7 +158,7 @@ _CURRENT: "contextvars.ContextVar[Optional[object]]" = \
 
 _FINISHED: "deque[Span]" = deque(maxlen=2048)
 _SINKS: "list[Callable[[Span], None]]" = []
-_SINK_LOCK = threading.Lock()
+_SINK_LOCK = _locks.make_lock("telemetry.sink")
 
 _span_count = _metrics.counter(
     "telemetry.spans", "Finished telemetry spans, by name and status")

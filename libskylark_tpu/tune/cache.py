@@ -35,9 +35,10 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 from typing import Optional
 
+from libskylark_tpu.base import env as _env
+from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.telemetry import metrics as _metrics
 from libskylark_tpu.tune.plans import Plan, Workload
 
@@ -64,11 +65,9 @@ def _utcnow() -> str:
 def default_cache_path() -> Optional[str]:
     """Resolved cache location, or None when persistence is disabled
     (SKYLARK_PLAN_CACHE=0/off/empty)."""
-    env = os.environ.get("SKYLARK_PLAN_CACHE")
-    if env is not None:
-        if env.strip().lower() in ("", "0", "off", "no", "false"):
-            return None
-        return env
+    if _env.PLAN_CACHE.is_set():
+        # set: the parsed value (an off-word parses to None — disabled)
+        return _env.PLAN_CACHE.get()
     here = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     repo_bench = os.path.join(here, "benchmarks")
@@ -86,7 +85,7 @@ class PlanCache:
                  entries: Optional[dict] = None):
         self.path = path
         self.entries: dict[str, dict] = dict(entries or {})
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("tune.plan_cache")
         self._fingerprint: Optional[str] = None
         self.load_error: Optional[str] = None
 
@@ -277,7 +276,7 @@ class PlanCache:
 # -- process-global cache used by the dispatchers --
 
 _global: Optional[PlanCache] = None
-_global_lock = threading.Lock()
+_global_lock = _locks.make_lock("tune.global_cache")
 
 
 def get_cache() -> PlanCache:

@@ -34,9 +34,10 @@ serializing the pipeline the rest of the time).
 
 from __future__ import annotations
 
-import os
 from contextlib import contextmanager
 from typing import Dict, Optional
+
+from libskylark_tpu.base import env as _env
 
 _ENABLED: Optional[bool] = None
 
@@ -44,7 +45,7 @@ _ENABLED: Optional[bool] = None
 def timers_enabled() -> bool:
     global _ENABLED
     if _ENABLED is None:
-        _ENABLED = os.environ.get("SKYLARK_TPU_PROFILE", "") not in ("", "0")
+        _ENABLED = bool(_env.TPU_PROFILE.get())
     return _ENABLED
 
 

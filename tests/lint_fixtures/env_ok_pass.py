@@ -1,0 +1,18 @@
+"""Must-pass: registry reads, non-SKYLARK reads, env writes."""
+
+import os
+
+from libskylark_tpu.base import env as _env
+
+
+def read_ok():
+    a = _env.TELEMETRY.get()                  # registry accessor
+    b = os.environ.get("JAX_PLATFORMS")       # non-SKYLARK literal
+    return a, b
+
+
+def write_ok(snapshot):
+    # writes and whole-env snapshots are allowed (replica apply path)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("JAX_TRACEBACK_FILTERING", None)
+    return dict(os.environ), snapshot

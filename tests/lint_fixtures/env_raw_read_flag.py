@@ -1,0 +1,17 @@
+"""Must-flag: raw SKYLARK_* env reads outside base/env.py."""
+
+import os
+
+
+def read_flag():
+    # each of these is one env-registry finding
+    a = os.environ.get("SKYLARK_BOGUS_FLAG")
+    b = os.environ["SKYLARK_BOGUS_SUBSCRIPT"]
+    c = os.getenv("SKYLARK_BOGUS_GETENV")
+    d = "SKYLARK_BOGUS_MEMBER" in os.environ
+    e = os.environ.get(compute_name())        # dynamic key
+    return a, b, c, d, e
+
+
+def compute_name():
+    return "SKYLARK_" + "DYNAMIC"

@@ -1,0 +1,34 @@
+"""Must-flag: a jit-impure closure — the traced function reads the
+environment, a wall clock, host RNG, and a mutable module global
+(directly and through a helper)."""
+
+import os
+import random
+import time
+
+import jax
+
+_KNOB = 1.0
+
+
+def set_knob(v):
+    global _KNOB
+    _KNOB = v
+
+
+def _helper():
+    # impurity reached transitively from the root
+    return float(os.environ.get("SKYLARK_BOGUS_JIT", "0"))
+
+
+@jax.jit
+def impure_root(x):
+    # env via helper, clock, host RNG, and a mutable module global
+    return x * _helper() * time.time() * random.random() * _KNOB
+
+
+def build():
+    def inner(x):
+        return x + _helper()
+
+    return jax.jit(inner)

@@ -1,0 +1,20 @@
+"""Must-pass: pure traced functions; impure work outside the trace."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+_SCALE = 2.0  # assigned once, never rebound — not a mutable global
+
+
+@jax.jit
+def pure_root(x):
+    return jnp.sin(x) * _SCALE
+
+
+def timed_call(x):
+    # clocks OUTSIDE the traced function are fine
+    t0 = time.perf_counter()
+    y = pure_root(x)
+    return y, time.perf_counter() - t0

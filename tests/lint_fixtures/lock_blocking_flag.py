@@ -1,0 +1,28 @@
+"""Must-flag: blocking calls and callback fan-out under a held lock,
+and a direct ``threading.Lock()`` construction."""
+
+import threading
+import time
+
+from libskylark_tpu.base import locks as _locks
+
+_LOCK = _locks.make_lock("fixture.blocking")
+_BARE = threading.Lock()          # must-flag: unnamed, invisible to
+#                                   the witness and the static graph
+_CALLBACKS = []
+
+
+def bad_result(fut):
+    with _LOCK:
+        return fut.result()       # must-flag: Future.result under lock
+
+
+def bad_sleep():
+    with _LOCK:
+        time.sleep(0.1)           # must-flag: sleep under lock
+
+
+def bad_fanout(event):
+    with _LOCK:
+        for cb in _CALLBACKS:
+            cb(event)             # must-flag: callbacks under lock

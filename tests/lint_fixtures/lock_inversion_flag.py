@@ -1,0 +1,33 @@
+"""Must-flag (static) AND must-detect (runtime): a deliberate
+two-lock order inversion.
+
+``path_one`` acquires fixture.alpha -> fixture.beta; ``path_two``
+acquires fixture.beta -> fixture.alpha. Run sequentially this never
+deadlocks — which is exactly why the ordering, not the deadlock, is
+what both the static ``lock-discipline`` rule and the runtime witness
+must catch. ``tests/test_analysis.py`` asserts both do, on this same
+file.
+"""
+
+from libskylark_tpu.base import locks as _locks
+
+_ALPHA = _locks.make_lock("fixture.alpha")
+_BETA = _locks.make_lock("fixture.beta")
+
+
+def path_one():
+    with _ALPHA:
+        with _BETA:
+            return 1
+
+
+def path_two():
+    with _BETA:
+        with _ALPHA:
+            return 2
+
+
+def run_inversion():
+    """Exercise both orders (sequentially — safe) so an instrumented-
+    lock run records the cycle."""
+    return path_one() + path_two()

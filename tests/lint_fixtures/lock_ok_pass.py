@@ -1,0 +1,24 @@
+"""Must-pass: consistent nesting order and condition waits."""
+
+import threading
+
+from libskylark_tpu.base import locks as _locks
+
+_OUTER = _locks.make_lock("fixture.outer")
+_INNER = _locks.make_lock("fixture.inner")
+
+
+class Worker:
+    def __init__(self):
+        self._lock = _locks.make_lock("fixture.worker")
+        self._cv = threading.Condition(self._lock)
+
+    def both(self):
+        with _OUTER:
+            with _INNER:       # always outer -> inner: no cycle
+                return 1
+
+    def wait_ok(self, pred):
+        with self._lock:
+            while not pred():
+                self._cv.wait(timeout=0.01)   # condition wait is fine

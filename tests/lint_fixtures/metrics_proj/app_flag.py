@@ -1,0 +1,12 @@
+"""Must-flag creations: undeclared name, kind mismatch, duplicate
+site, bad Prometheus name, dynamic name."""
+
+from libskylark_tpu.telemetry import metrics as _metrics
+
+_BOGUS = _metrics.counter("demo.bogus", "Not declared")
+_WRONG = _metrics.gauge("demo.requests", "Declared as counter")
+_BADCHARS = _metrics.counter("Demo-Bad.Name", "Invalid characters")
+
+
+def dynamic(name):
+    return _metrics.counter(name, "Unauditable")

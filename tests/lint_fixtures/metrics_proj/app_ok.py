@@ -1,0 +1,6 @@
+"""Must-pass creations: declared names, one site each, right kinds."""
+
+from libskylark_tpu.telemetry import metrics as _metrics
+
+_REQS = _metrics.counter("demo.requests", "Requests served")
+_DEPTH = _metrics.gauge("demo.depth", "Queue depth")

@@ -1,0 +1,9 @@
+"""Mini names module for the metric-names fixture project."""
+
+from typing import Dict
+
+METRICS: Dict[str, str] = {
+    "demo.requests": "counter",
+    "demo.depth": "gauge",
+    "demo.never_created": "counter",   # stale on purpose (must-flag)
+}

@@ -1,0 +1,12 @@
+"""Must-pass: violations neutralized by per-line suppressions."""
+
+import os
+
+
+def justified_read():
+    # (a justification comment belongs here in real code)
+    v = os.environ.get("SKYLARK_BOGUS_OK")  # skylark-lint: disable=env-registry
+    # standalone-comment form covers the NEXT line:
+    # skylark-lint: disable=env-registry
+    w = os.environ.get("SKYLARK_BOGUS_NEXT_LINE")
+    return v, w
