@@ -30,7 +30,14 @@ asserts the resilience subsystem's contract end to end:
   fired-fault count, and two same-seed runs replay the identical
   fired sequence. Route checks run on the submitting thread, so the
   hit order — unlike flush-side hits under concurrent workers — is
-  deterministic by construction.
+  deterministic by construction;
+- **hedged-straggler rescue** (the hedge leg): a tag-pinned
+  ``stall_s`` fault makes one request's primary flush a straggler; a
+  hedging router must mirror it after its fixed delay, take the
+  mirror's result (``hedge_wins``), let the stalled loser complete
+  (verify mode), and prove the determinism guard: both executions
+  bit-equal, zero mismatches, zero orphans, and the identical fired
+  sequence across two same-seed runs.
 
 Usage: ``python benchmarks/chaos_battery.py --gate`` (script/ci wires
 ``JAX_PLATFORMS=cpu`` and the canned ``SKYLARK_FAULT_PLAN``). Prints
@@ -225,6 +232,122 @@ def _fleet_leg(T, ops, refs, violations):
     }
 
 
+# The hedge leg's canned plan: a tag-pinned STALL on the primary's
+# flush (a straggler, not an error — stall_s sleeps and proceeds).
+# The router's watchdog must mirror the request to the second ring-
+# preference replica after its fixed hedge delay and take the mirror's
+# result; verify mode lets the stalled loser complete and compares
+# both bitwise — the determinism guard (the endpoints are pure, so the
+# two executions must agree to the bit).
+HEDGE_PLAN = {
+    "seed": 17,
+    "faults": [
+        {"site": "serve.flush", "stall_s": 0.35, "tag": "hedge-stall"},
+    ],
+}
+HEDGE_DELAY_MS = 50
+
+
+def _hedge_storm(T, ops):
+    import time as _time
+
+    from concurrent.futures import wait as cf_wait
+
+    from libskylark_tpu import fleet
+    from libskylark_tpu.resilience import faults
+
+    pool = fleet.ReplicaPool(2, max_batch=MAX_BATCH, linger_us=1000)
+    router = fleet.Router(pool, hedge=True,
+                          hedge_delay_ms=HEDGE_DELAY_MS,
+                          hedge_verify=True)
+    # warm BOTH replicas for the class: the mirror must answer from a
+    # warm cache so the race is about queueing, not compiles
+    for name in pool.names():
+        pool.get(name).submit("sketch_apply", transform=T, A=ops[0],
+                              dimension=None).result(timeout=120)
+    # ONE tagged request: the leg isolates the straggler-rescue
+    # mechanism (storm semantics are the fleet leg's job) — on a
+    # loaded 1-core host a full storm would hedge on ordinary backlog
+    # too, making "exactly one hedge" unassertable
+    with faults.tag("hedge-stall"):
+        futs = [router.submit_sketch(T, ops[0])]
+    cf_wait(futs, timeout=120)
+    # both-attempts-complete: wait until every executor quiesces (the
+    # stalled loser's flush finishes and resolves its future)
+    deadline = _time.monotonic() + 30
+    while _time.monotonic() < deadline and any(
+            pool.get(n).queue_depth() for n in pool.names()):
+        _time.sleep(0.02)
+    inflight = sum(pool.get(n).queue_depth() for n in pool.names())
+    outcomes = []
+    for f in futs:
+        if not f.done():
+            outcomes.append(("ORPHANED", None))
+        elif f.exception() is not None:
+            outcomes.append(("ERROR", type(f.exception()).__name__))
+        else:
+            outcomes.append(("OK", np.asarray(f.result())))
+    stats = router.stats()
+    fired = faults.fired()
+    router.close()
+    pool.shutdown()
+    return outcomes, fired, stats, inflight
+
+
+def _hedge_leg(T, ops, refs, violations):
+    from libskylark_tpu.resilience import faults
+
+    runs = []
+    for _ in range(2):
+        with faults.fault_plan(dict(HEDGE_PLAN)):
+            runs.append(_hedge_storm(T, ops))
+    (out1, fired1, stats1, in1), (out2, fired2, stats2, in2) = runs
+
+    orphans = sum(1 for s, _ in out1 + out2 if s == "ORPHANED")
+    if orphans or in1 or in2:
+        violations.append(
+            f"hedge leg: {orphans} orphaned future(s), "
+            f"{in1 + in2} stuck in-flight")
+    for run, out in (("run1", out1), ("run2", out2)):
+        status, val = out[0]
+        if status != "OK":
+            violations.append(
+                f"hedge leg {run}: request got {status}/{val}")
+        elif not np.array_equal(val, refs[0]):
+            violations.append(
+                f"hedge leg {run}: result not bit-equal to the "
+                "unhedged oracle")
+    for run, st in (("run1", stats1), ("run2", stats2)):
+        if st["hedged"] != 1:
+            violations.append(
+                f"hedge leg {run}: hedged {st['hedged']} != 1 — the "
+                "injected stall did not trigger exactly one hedge")
+        if st["hedge_wins"] != 1:
+            violations.append(
+                f"hedge leg {run}: the mirror did not win against a "
+                f"{HEDGE_PLAN['faults'][0]['stall_s']}s straggler")
+        if st["hedge_mismatches"]:
+            violations.append(
+                f"hedge leg {run}: {st['hedge_mismatches']} hedge "
+                "result mismatch(es) — an endpoint is no longer "
+                "deterministic")
+    if fired1 != fired2:
+        violations.append(
+            f"hedge leg: fired sequences differ across same-seed "
+            f"runs: {fired1} vs {fired2}")
+    if not fired1 or any(e[2] != "stall" for e in fired1):
+        violations.append(
+            f"hedge leg: expected only stall firings, got {fired1}")
+    return {
+        "fired": [list(f) for f in fired1],
+        "hedged": stats1["hedged"],
+        "hedge_wins": stats1["hedge_wins"],
+        "hedge_mismatches": stats1["hedge_mismatches"],
+        "deterministic": fired1 == fired2 and [s for s, _ in out1]
+        == [s for s, _ in out2],
+    }
+
+
 def main() -> int:
     from libskylark_tpu import engine
     from libskylark_tpu.base import errors  # noqa: F401 — class names
@@ -297,6 +420,9 @@ def main() -> int:
     # -- fleet leg: deterministic router failover -----------------------
     fleet_rec = _fleet_leg(T, ops, refs, violations)
 
+    # -- hedge leg: injected stall -> mirrored request ------------------
+    hedge_rec = _hedge_leg(T, ops, refs, violations)
+
     # -- lock-order witness (instrumented-lock mode) --------------------
     # With SKYLARK_LOCK_WITNESS=1 (the CI chaos gate sets it) every
     # lock the storm touched — executor state/stats/pub, engine cache,
@@ -343,6 +469,7 @@ def main() -> int:
         "engine_recompiles": est.recompiles,
         "deterministic": fired1 == fired2,
         "fleet": fleet_rec,
+        "hedge": hedge_rec,
         "lock_witness": witness_rec,
         "violations": violations,
     }

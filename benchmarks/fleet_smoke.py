@@ -19,7 +19,17 @@ to end, fast enough for the per-commit gate:
   seeded ``serve.flush`` fault fires — bisection absorbs the fault,
   the router sheds the drained replica's traffic to its peer, and the
   gate asserts zero client-visible failures, zero orphaned futures,
-  the drained replica off the ring, and its final drain hook fired.
+  the drained replica off the ring, and its final drain hook fired;
+- **autoscale round-trip from a warmup pack**: a 1-replica pool booted
+  from a freshly built pack (cache reset in between, so the pack —
+  not the builder's warm cache — supplies every executable) rides a
+  throttled queue storm: the queue-depth controller scales up to 2
+  (the new replica joins the router's ring via the SERVING publish),
+  every storm future resolves bit-equal with zero client-visible
+  failures and **zero backend compiles**, then sustained idleness
+  drains the grown replica back away (the r11 SIGTERM-drain path) —
+  also with zero failures. Leaked ``/dev/shm`` transport segments are
+  asserted zero at exit.
 
 Usage: ``python benchmarks/fleet_smoke.py`` (script/ci wires
 ``JAX_PLATFORMS=cpu``). Prints one JSON record; exits nonzero on any
@@ -65,6 +75,126 @@ DRAIN_FAULT_PLAN = {
          "tag": "drain-poison", "times": 1},
     ],
 }
+
+
+def _autoscale_leg(violations) -> dict:
+    """Queue storm -> scale-up observed -> idle -> scale-down drain,
+    zero client-visible failures, zero compiles via the warmup pack
+    (see module doc)."""
+    import shutil
+    import tempfile
+    import time
+
+    import jax.numpy as jnp
+
+    from libskylark_tpu import Context, engine, fleet
+    from libskylark_tpu import sketch as sk
+    from libskylark_tpu.engine import warmup
+    from libskylark_tpu.resilience import faults
+
+    rng = np.random.default_rng(1)
+    ctx = Context(seed=0)
+    T = sk.CWT(CLASSES[0], S_DIM, ctx)
+    ops = [rng.standard_normal((CLASSES[0], 3 + i % 4))
+           .astype(np.float32) for i in range(24)]
+    refs = [np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+            for A in ops]
+
+    pack_dir = tempfile.mkdtemp(prefix="skylark_fleet_pack_")
+    rec: dict = {"pack_entries": None}
+    try:
+        spec = warmup.BucketSpec(
+            endpoint="sketch_apply", family="CWT", n=CLASSES[0], m=6,
+            s_dim=S_DIM, rowwise=False, capacities=(1, 2, 4, 8))
+        manifest = warmup.build_pack(pack_dir, [spec])
+        rec["pack_entries"] = len(manifest.get("entries", []))
+        # reset: the pack, not the builder's warm cache, must supply
+        # every executable the leg runs
+        engine.reset()
+        compiles0 = engine.stats().compiles
+        pool = fleet.ReplicaPool(1, max_batch=MAX_BATCH,
+                                 linger_us=2000, warmup_pack=pack_dir)
+        router = fleet.Router(pool)
+        scaler = fleet.Autoscaler(
+            pool, router, min_replicas=1, max_replicas=2, up_depth=2,
+            down_depth=1, up_ticks=1, down_ticks=4, cooldown_s=0.3,
+            interval_s=0.05)
+        try:
+            # throttled storm: +10 ms per flush so the controller's
+            # ticks deterministically observe the backlog
+            plan = {"seed": 2, "faults": [
+                {"site": "serve.flush", "stall_s": 0.01, "every": 1}]}
+            failures = 0
+            with faults.fault_plan(plan):
+                futs = [router.submit_sketch(T, A)
+                        for A in ops for _ in range(4)]
+                deadline = time.monotonic() + 20
+                while (time.monotonic() < deadline
+                       and len(pool.names()) < 2):
+                    time.sleep(0.05)
+                scaled_up = len(pool.names()) == 2
+                grown = [n for n in pool.names() if n != "r0"]
+                if not scaled_up:
+                    violations.append(
+                        "autoscale leg: queue storm never scaled up")
+                elif grown[0] not in router.routable():
+                    violations.append(
+                        "autoscale leg: grown replica never joined "
+                        "the router ring")
+                for i, f in enumerate(futs):
+                    try:
+                        out = f.result(timeout=120)
+                    except Exception:  # noqa: BLE001 — counted
+                        failures += 1
+                        continue
+                    if not np.array_equal(np.asarray(out),
+                                          refs[i // 4]):
+                        violations.append(
+                            f"autoscale leg: request {i} diverged")
+                        break
+            if failures:
+                violations.append(
+                    f"autoscale leg: {failures} client-visible "
+                    "failure(s) during scale-up storm")
+            # idle: the controller must drain back to the floor
+            deadline = time.monotonic() + 30
+            while (time.monotonic() < deadline
+                   and len(pool.names()) > 1):
+                time.sleep(0.1)
+            if len(pool.names()) != 1:
+                violations.append(
+                    "autoscale leg: idle fleet never scaled down")
+            # post-shrink traffic still lands, still compile-free
+            out = router.submit_sketch(T, ops[0]).result(timeout=60)
+            if not np.array_equal(np.asarray(out), refs[0]):
+                violations.append(
+                    "autoscale leg: post-scale-down request diverged")
+            compiles = engine.stats().compiles - compiles0
+            if compiles:
+                violations.append(
+                    f"autoscale leg: {compiles} backend compile(s) — "
+                    "the warmup pack did not cover the leg")
+            st = scaler.stats()
+            rec.update({
+                "scaled_up": scaled_up,
+                "scale_ups": st["scale_ups"],
+                "scale_downs": st["scale_downs"],
+                "client_visible_failures": failures,
+                "compiles": compiles,
+                "aot_loads": engine.stats().aot_loads,
+                "replicas_final": len(pool.names()),
+            })
+        finally:
+            scaler.close()
+            router.close()
+            pool.shutdown()
+    finally:
+        shutil.rmtree(pack_dir, ignore_errors=True)
+    leaked = fleet.shm_entries()
+    if leaked:
+        violations.append(
+            f"autoscale leg: leaked /dev/shm entries: {leaked}")
+    return rec
 
 
 def main() -> int:
@@ -207,21 +337,28 @@ def main() -> int:
         violations.append(
             "no drain-leg traffic reached the surviving replica")
 
+    router_stats = router.stats()
+    replica_names = pool.names()
+    router.close()
+    pool.shutdown()
+
+    # -- autoscale leg: pack-booted elastic pool -------------------------
+    autoscale_rec = _autoscale_leg(violations)
+
     rec = {
         "metric": "fleet_smoke",
         "n_requests": N_REQUESTS,
-        "replicas": pool.names(),
+        "replicas": replica_names,
+        "router": router_stats,
         "affinity_hit_rate": round(hit_rate, 4),
         "misses_measured_window": misses,
         "recompiles": st1.recompiles,
         "drain_victim": victim,
         "drain_fault_fired": [list(f) for f in fired],
         "client_visible_failures": drain_failures,
-        "router": router.stats(),
+        "autoscale": autoscale_rec,
         "violations": violations,
     }
-    router.close()
-    pool.shutdown()
     print(json.dumps(rec), flush=True)
     if violations:
         print("fleet smoke FAILED:", file=sys.stderr)

@@ -256,6 +256,104 @@ BOOT_T0 = declare(
     doc="Parent's ``time.time()`` at replica spawn; the boot probe "
         "reports honest wall-from-spawn time-to-first-result.")
 
+#: The fleet replica backends (``fleet.ReplicaPool`` imports this so
+#: the env parser and the pool's ``backend=`` validation agree).
+FLEET_BACKENDS = ("thread", "process", "auto")
+
+FLEET_BACKEND = declare(
+    "SKYLARK_FLEET_BACKEND", default="thread", kind="choice",
+    parser=lambda raw: (raw.strip().lower()
+                        if raw.strip().lower() in FLEET_BACKENDS
+                        else "thread"),
+    doc="Default ``ReplicaPool`` backend when the constructor does not "
+        "pin one: ``thread`` | ``process`` | ``auto`` (process on "
+        "hosts with >= 4 cores, thread below — the production "
+        "many-core default; docs/fleet \"Process replicas\").")
+
+FLEET_SHM = declare(
+    "SKYLARK_FLEET_SHM", default=True, parser=parse_bool_default_on,
+    kind="flag",
+    doc="Shared-memory operand/result transport for process replicas "
+        "(default on; ``0`` forces every payload onto the pickle "
+        "pipe — docs/fleet \"Shared-memory transport\").")
+
+FLEET_SHM_MIN_BYTES = declare(
+    "SKYLARK_FLEET_SHM_MIN_BYTES", default=16 * 1024,
+    parser=parse_int, kind="bytes",
+    doc="Arrays at or above this size ride the shared-memory ring; "
+        "smaller ones (and non-array values) stay on the pickle pipe "
+        "where serialization is cheaper than slot bookkeeping.")
+
+FLEET_SHM_SLOTS = declare(
+    "SKYLARK_FLEET_SHM_SLOTS", default=8, parser=parse_positive_int,
+    kind="int",
+    doc="Slots per shared-memory ring direction (parent->child and "
+        "child->parent each get this many); an exhausted ring degrades "
+        "to the pickle pipe, never blocks.")
+
+FLEET_SHM_SLOT_BYTES = declare(
+    "SKYLARK_FLEET_SHM_SLOT_BYTES", default=1 << 20,
+    parser=parse_positive_int, kind="bytes",
+    doc="Bytes per shared-memory slot; an operand larger than one slot "
+        "falls back to the pickle pipe (counted, not an error).")
+
+FLEET_AUTOSCALE_MIN = declare(
+    "SKYLARK_FLEET_AUTOSCALE_MIN", default=1, parser=parse_positive_int,
+    kind="int",
+    doc="Default ``Autoscaler`` floor: the pool never drains below "
+        "this many replicas.")
+
+FLEET_AUTOSCALE_MAX = declare(
+    "SKYLARK_FLEET_AUTOSCALE_MAX", default=8, parser=parse_positive_int,
+    kind="int",
+    doc="Default ``Autoscaler`` ceiling: the pool never grows past "
+        "this many replicas.")
+
+FLEET_AUTOSCALE_INTERVAL = declare(
+    "SKYLARK_FLEET_AUTOSCALE_INTERVAL", default=0.25, parser=parse_float,
+    kind="float",
+    doc="Seconds between autoscaler control-loop ticks (the cadence "
+        "of the queue-depth evaluation).")
+
+FLEET_AUTOSCALE_UP_DEPTH = declare(
+    "SKYLARK_FLEET_AUTOSCALE_UP_DEPTH", default=8, parser=parse_int,
+    kind="int",
+    doc="Mean queued+in-flight requests per replica at or above which "
+        "sustained ticks trigger a scale-up (pack boot).")
+
+FLEET_AUTOSCALE_DOWN_DEPTH = declare(
+    "SKYLARK_FLEET_AUTOSCALE_DOWN_DEPTH", default=1, parser=parse_int,
+    kind="int",
+    doc="Mean queued+in-flight requests per replica below which "
+        "sustained ticks trigger a scale-down (SIGTERM drain).")
+
+FLEET_AUTOSCALE_COOLDOWN = declare(
+    "SKYLARK_FLEET_AUTOSCALE_COOLDOWN", default=5.0, parser=parse_float,
+    kind="float",
+    doc="Seconds after any scale event before the controller may act "
+        "again (hysteresis against flapping).")
+
+FLEET_HEDGE = declare(
+    "SKYLARK_FLEET_HEDGE", default=False, parser=parse_flag, kind="flag",
+    propagate=False,
+    doc="Router-level hedged requests: mirror a straggling in-flight "
+        "request to the second ring-preference replica after a "
+        "p99-derived delay and take the first result "
+        "(docs/fleet \"Hedged requests\").")
+
+FLEET_HEDGE_DELAY_MS = declare(
+    "SKYLARK_FLEET_HEDGE_DELAY_MS", default=None, parser=parse_float,
+    kind="float",
+    doc="Fixed hedge delay in milliseconds; unset derives the delay "
+        "from the live p99 request latency (the r10 histograms).")
+
+FLEET_HEDGE_VERIFY = declare(
+    "SKYLARK_FLEET_HEDGE_VERIFY", default=False, parser=parse_flag,
+    kind="flag",
+    doc="Determinism guard: let the hedge loser complete (instead of "
+        "cancelling it) and compare both results bitwise, counting "
+        "``fleet.hedge_mismatches`` on divergence (chaos battery).")
+
 FAULT_PLAN = declare(
     "SKYLARK_FAULT_PLAN", default=None, kind="json",
     doc="Deterministic fault-injection plan (inline JSON or a path); "

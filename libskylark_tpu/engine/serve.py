@@ -1452,7 +1452,12 @@ class MicrobatchExecutor:
         # per-request eager device slice would cost a dispatched XLA op
         # per lane — at microbatch request sizes that's comparable to
         # the whole flush. Serving results terminate at the client, so
-        # they come back as host arrays (near zero-copy on CPU).
+        # they come back as host arrays (near zero-copy on CPU), and
+        # each future resolves to a VIEW into this one buffer (_unpad
+        # slices, never copies) — the handoff a process replica's
+        # shared-memory transport writes straight out of (fleet/shm:
+        # np.copyto from the strided view into the slot, no
+        # ascontiguousarray staging copy in between).
         out = np.asarray(out)
 
         now = time.monotonic()
@@ -1547,6 +1552,17 @@ class MicrobatchExecutor:
         gauge."""
         with self._lock:
             return self._pending + self._inflight
+
+    def latency_quantile(self, q: float = 0.99) -> Optional[float]:
+        """One quantile of the r10 request-latency histogram (seconds;
+        ``None`` before any completion). Cheaper than :meth:`stats`
+        (no counter snapshot) — the fleet router derives its hedge
+        delay from this, and the autoscaler reads it at tick cadence,
+        so it must not contend with the flush path for more than the
+        stats lock."""
+        with self._stats_lock:
+            lat = sorted(self._latency)
+        return _percentile(lat, q)
 
     def _maybe_publish_state(self) -> None:
         """Publish a health-state transition to the resilience hub

@@ -20,7 +20,16 @@ users" layer above it (ROADMAP item 1):
 - :mod:`~libskylark_tpu.fleet.router` — :class:`Router`: the front
   door whose ``submit`` mirrors the executor API and routes on
   affinity + live queue depth + subscribed health states, failing over
-  past refusing/draining replicas with zero client-visible failures.
+  past refusing/draining replicas with zero client-visible failures —
+  and, when enabled, *hedging* stragglers to a second replica after a
+  p99-derived delay.
+- :mod:`~libskylark_tpu.fleet.shm` — :class:`ShmTransport`: the
+  shared-memory operand/result rings that keep a process replica's
+  ndarrays off the pickle pipe (zero-copy receive, pickle fallback,
+  leak-proof unlink-at-boot lifecycle).
+- :mod:`~libskylark_tpu.fleet.autoscale` — :class:`Autoscaler`: the
+  queue-depth controller growing the pool via the r13 pack boot and
+  shrinking it via the r11 SIGTERM drain, with hysteresis.
 
 Measured by ``bench.py --fleet`` (N-replica vs single-executor A/B,
 affinity hit-rate, drain failover), chaos-replayed by
@@ -28,16 +37,19 @@ affinity hit-rate, drain failover), chaos-replayed by
 gated in CI by ``benchmarks/fleet_smoke.py``. See ``docs/fleet``.
 """
 
-from libskylark_tpu.fleet.pool import ReplicaPool
+from libskylark_tpu.fleet.autoscale import Autoscaler, autoscale_stats
+from libskylark_tpu.fleet.pool import ReplicaPool, resolve_backend
 from libskylark_tpu.fleet.replica import (PROPAGATED_ENV, ProcessReplica,
                                           Replica, ThreadReplica,
                                           propagated_env)
 from libskylark_tpu.fleet.ring import HashRing
 from libskylark_tpu.fleet.router import (NoHealthyReplicaError, Router,
                                          fleet_stats)
+from libskylark_tpu.fleet.shm import ShmTransport, shm_entries
 
 __all__ = [
-    "HashRing", "NoHealthyReplicaError", "PROPAGATED_ENV",
+    "Autoscaler", "HashRing", "NoHealthyReplicaError", "PROPAGATED_ENV",
     "ProcessReplica", "Replica", "ReplicaPool", "Router",
-    "ThreadReplica", "fleet_stats", "propagated_env",
+    "ShmTransport", "ThreadReplica", "autoscale_stats", "fleet_stats",
+    "propagated_env", "resolve_backend", "shm_entries",
 ]

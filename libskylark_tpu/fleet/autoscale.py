@@ -1,0 +1,315 @@
+"""Queue-depth autoscaler: elastic replica count under live load.
+
+A fixed-N :class:`~libskylark_tpu.fleet.pool.ReplicaPool` forces the
+operator to size for the peak — idle replicas burn memory (a process
+replica is a whole interpreter plus an executable cache) and an
+under-sized fleet sheds. The :class:`Autoscaler` closes that loop with
+the two mechanisms the fleet already has:
+
+- **scale-up is the r13 pack boot**:
+  :meth:`~libskylark_tpu.fleet.pool.ReplicaPool.add_replica` builds the
+  new replica from the pool's warmup pack, so added capacity serves
+  its packed buckets with zero compiles from its first request, and
+  the pool's per-replica ``coordinator``/``replica_env`` seats pin it
+  to its own device subset;
+- **scale-down is the r11 SIGTERM drain**:
+  :meth:`~libskylark_tpu.fleet.pool.ReplicaPool.remove_replica`
+  preempts the victim (a real SIGTERM for process replicas), the
+  health hub announces DRAINING before the queue empties, the router
+  sheds its traffic to peers, in-flight futures resolve, and its final
+  drain hooks fire — zero client-visible failures by the same
+  contract the fleet gate replays.
+
+The control signal is the **queue-depth gauge** (each replica's
+``queued + in-flight`` count — the same number the router's spill
+heuristic and the telemetry ``queued`` gauge read) plus the **shed
+evidence** a subscribed router accumulates (a replica refusing at its
+shed bound surfaces as a router failover). The loop is deliberately
+dumb and hysteretic:
+
+- scale **up** when the mean depth per replica holds at or above
+  ``up_depth`` (or sheds appear) for ``up_ticks`` consecutive ticks;
+- scale **down** when it holds at or below ``down_depth`` with no
+  sheds for ``down_ticks`` consecutive ticks;
+- never outside ``[min_replicas, max_replicas]``, and never within
+  ``cooldown_s`` of the previous scale event — a storm's trailing
+  edge must not flap the fleet.
+
+Ticks run on one daemon controller thread; a scale event blocks that
+thread (a process-replica boot takes seconds) which is itself a
+hysteresis — the controller cannot react faster than capacity can
+actually change.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import warnings
+import weakref
+from typing import Optional
+
+from libskylark_tpu.base import env as _env
+from libskylark_tpu.base import locks as _locks
+from libskylark_tpu.fleet.pool import ReplicaPool
+from libskylark_tpu.telemetry import metrics as _metrics
+
+_UP = _metrics.counter(
+    "fleet.autoscale_up", "Replicas added by the autoscaler")
+_DOWN = _metrics.counter(
+    "fleet.autoscale_down", "Replicas drained away by the autoscaler")
+_REPLICAS = _metrics.gauge(
+    "fleet.replicas", "Live replica count of an autoscaled pool, by "
+    "scaler (one process can autoscale several pools)")
+
+_SCALERS: "weakref.WeakSet[Autoscaler]" = weakref.WeakSet()
+_SCALER_SEQ = itertools.count()
+
+# process-lifetime rollup: scale events survive their Autoscaler (a
+# telemetry snapshot taken after an episode's scaler is gone must
+# still carry the counts — collectors report live objects only)
+_LIFETIME = _metrics.LifetimeCounter(
+    "fleet.autoscale_life", kinds=("scale_ups", "scale_downs"))
+
+
+class Autoscaler:
+    """Controller thread scaling a :class:`ReplicaPool` between
+    ``min_replicas`` and ``max_replicas`` (see module doc).
+
+    ::
+
+        pool = fleet.ReplicaPool(2, backend="process",
+                                 warmup_pack=pack_dir, max_batch=16)
+        router = fleet.Router(pool)
+        scaler = fleet.Autoscaler(pool, router,
+                                  min_replicas=2, max_replicas=8)
+        ...
+        scaler.close(); router.close(); pool.shutdown()
+
+    ``router`` is optional but recommended: its failover counter is
+    the shed evidence that lets the controller react to refusals even
+    when queue depths look tame. Every unset knob defaults from the
+    ``SKYLARK_FLEET_AUTOSCALE_*`` registry entries (:doc:`env_vars`).
+    """
+
+    def __init__(self, pool: ReplicaPool, router=None, *,
+                 name: Optional[str] = None,
+                 min_replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 up_depth: Optional[int] = None,
+                 down_depth: Optional[int] = None,
+                 up_ticks: int = 2, down_ticks: int = 8,
+                 cooldown_s: Optional[float] = None,
+                 interval_s: Optional[float] = None,
+                 drain_timeout: float = 30.0,
+                 start: bool = True):
+        self.pool = pool
+        self.router = router
+        # gauge label: two autoscaled pools in one process must not
+        # clobber each other's replica count
+        self.name = str(name) if name else f"as{next(_SCALER_SEQ)}"
+        self.min_replicas = int(
+            min_replicas if min_replicas is not None
+            else _env.FLEET_AUTOSCALE_MIN.get())
+        self.max_replicas = int(
+            max_replicas if max_replicas is not None
+            else _env.FLEET_AUTOSCALE_MAX.get())
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"[{self.min_replicas}, {self.max_replicas}]")
+        self.up_depth = int(up_depth if up_depth is not None
+                            else _env.FLEET_AUTOSCALE_UP_DEPTH.get())
+        self.down_depth = int(
+            down_depth if down_depth is not None
+            else _env.FLEET_AUTOSCALE_DOWN_DEPTH.get())
+        self.up_ticks = max(int(up_ticks), 1)
+        self.down_ticks = max(int(down_ticks), 1)
+        self.cooldown_s = float(
+            cooldown_s if cooldown_s is not None
+            else _env.FLEET_AUTOSCALE_COOLDOWN.get())
+        self.interval_s = float(
+            interval_s if interval_s is not None
+            else _env.FLEET_AUTOSCALE_INTERVAL.get())
+        self.drain_timeout = float(drain_timeout)
+        self._cond = threading.Condition(
+            _locks.make_lock("fleet.autoscale"))
+        self._stats_lock = _locks.make_lock("fleet.autoscale_stats")
+        self._stop = False
+        self._up_run = 0
+        self._down_run = 0
+        self._last_event = 0.0          # monotonic stamp of last scale
+        self._failover_seen = 0
+        self._counts = {"scale_ups": 0, "scale_downs": 0, "ticks": 0}
+        self._events: list = []
+        self._added: list = []          # LIFO scale-down preference
+        self._thread: Optional[threading.Thread] = None
+        _SCALERS.add(self)
+        _REPLICAS.set(len(pool.names()), scaler=self.name)
+        if start:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="skylark-fleet-autoscaler",
+            daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        """Stop the controller (the pool keeps its current size)."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=self.drain_timeout + 10.0)
+            self._thread = None
+
+    def __enter__(self) -> "Autoscaler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- control loop --------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                self._cond.wait(timeout=self.interval_s)
+                if self._stop:
+                    return
+            try:
+                self._tick()
+            except Exception as e:  # noqa: BLE001 — controller lives
+                warnings.warn(f"autoscaler tick failed: {e}",
+                              RuntimeWarning, stacklevel=1)
+
+    def _shed_delta(self) -> int:
+        """New router failovers since the last tick — the shed
+        evidence (a replica refusing at its shed bound is a failover
+        from the router's point of view)."""
+        if self.router is None:
+            return 0
+        seen = int(self.router.stats().get("failover", 0))
+        delta = seen - self._failover_seen
+        self._failover_seen = seen
+        return max(delta, 0)
+
+    def _tick(self) -> None:
+        pool = self.pool
+        names = pool.names()
+        n = len(names)
+        if n == 0:
+            return
+        depth = 0
+        for name in names:
+            try:
+                depth += pool.get(name).queue_depth()
+            except KeyError:
+                continue               # removed mid-walk
+        mean = depth / n
+        shed = self._shed_delta()
+        with self._stats_lock:
+            self._counts["ticks"] += 1
+        up_sig = mean >= self.up_depth or shed > 0
+        down_sig = mean <= self.down_depth and shed == 0
+        self._up_run = self._up_run + 1 if up_sig else 0
+        self._down_run = self._down_run + 1 if down_sig else 0
+        now = time.monotonic()
+        if now - self._last_event < self.cooldown_s:
+            return
+        if (self._up_run >= self.up_ticks
+                and n < self.max_replicas):
+            self._scale_up(mean, shed)
+        elif (self._down_run >= self.down_ticks
+              and n > self.min_replicas):
+            self._scale_down(mean)
+
+    def _record(self, kind: str, name: str, mean: float,
+                shed: int) -> None:
+        with self._stats_lock:
+            self._counts["scale_ups" if kind == "up"
+                         else "scale_downs"] += 1
+            self._events.append({
+                "kind": kind, "replica": name,
+                "mean_depth": round(mean, 2), "shed": shed,
+                "replicas": len(self.pool.names()),
+            })
+            del self._events[:-32]
+        _REPLICAS.set(len(self.pool.names()), scaler=self.name)
+
+    def _scale_up(self, mean: float, shed: int) -> None:
+        # stamp BEFORE the boot attempt: a persistently failing
+        # add_replica (spawn EAGAIN under the very pressure that
+        # triggered the scale-up) must get the same cooldown as a
+        # success, not a full boot retry every tick
+        self._last_event = time.monotonic()
+        self._up_run = self._down_run = 0
+        name = self.pool.add_replica()   # pack boot (pool.warmup_pack)
+        self._added.append(name)
+        _UP.inc()
+        _LIFETIME.inc("scale_ups")
+        self._record("up", name, mean, shed)
+
+    def _scale_down(self, mean: float) -> None:
+        # prefer un-growing what we grew (LIFO), else the highest name
+        # under NATURAL order — plain lexicographic max would pick
+        # "r9" over "r10" and drain an operator-founded replica while
+        # a later-grown one survives. Deterministic either way.
+        names = set(self.pool.names())
+        victim = None
+        while self._added:
+            cand = self._added.pop()
+            if cand in names:
+                victim = cand
+                break
+        if victim is None:
+            victim = max(names, key=lambda n: (len(n), n))
+        self._last_event = time.monotonic()
+        self._up_run = self._down_run = 0
+        self.pool.remove_replica(victim, timeout=self.drain_timeout)
+        _DOWN.inc()
+        _LIFETIME.inc("scale_downs")
+        self._record("down", victim, mean, 0)
+
+    # -- introspection -------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            c = dict(self._counts)
+            events = list(self._events)
+        return {
+            "replicas": len(self.pool.names()),
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "scale_ups": c["scale_ups"],
+            "scale_downs": c["scale_downs"],
+            "ticks": c["ticks"],
+            "events": events,
+        }
+
+
+def autoscale_stats() -> dict:
+    """Rollup over every live autoscaler plus the process-lifetime
+    scale-event totals (folded into the ``fleet`` telemetry collector
+    by :func:`libskylark_tpu.fleet.router.fleet_stats`)."""
+    agg = {"scalers": 0, "scale_ups": 0, "scale_downs": 0,
+           "replicas": 0}
+    for scaler in list(_SCALERS):
+        s = scaler.stats()
+        agg["scalers"] += 1
+        agg["scale_ups"] += s["scale_ups"]
+        agg["scale_downs"] += s["scale_downs"]
+        agg["replicas"] += s["replicas"]
+    agg.update(_LIFETIME.snapshot())
+    return agg
+
+
+__all__ = ["Autoscaler", "autoscale_stats"]

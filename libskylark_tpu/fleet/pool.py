@@ -5,7 +5,12 @@ The pool is the fleet's capacity layer: it constructs N replicas with
 — the router derives affinity keys and spill bounds from the pool, so
 a heterogeneous fleet would break sticky routing), names them
 ``r0..r{N-1}``, and gives the router one place to resolve health-hub
-event sources back to replica names.
+event sources back to replica names. Membership is elastic:
+:meth:`~ReplicaPool.add_replica` grows the pool by one pack-booted
+replica (announced to subscribed routers via the health hub's SERVING
+publish) and :meth:`~ReplicaPool.remove_replica` shrinks it through
+the r11 preemption drain — the two verbs the autoscaler
+(:mod:`libskylark_tpu.fleet.autoscale`) drives.
 
 Preemption composition (the tentpole contract): the pool registers one
 :func:`~libskylark_tpu.resilience.on_preemption` hook, so a
@@ -22,15 +27,35 @@ to peers mid-drain.
 
 from __future__ import annotations
 
+import os
 import threading
 import warnings
 from typing import Callable, Dict, List, Optional
 
+from libskylark_tpu.base import env as _env
 from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.engine import bucket as bucketing
+from libskylark_tpu.engine import serve as _serve
 from libskylark_tpu.fleet.replica import (ProcessReplica, Replica,
                                           ThreadReplica)
+from libskylark_tpu.resilience import health as _health
 from libskylark_tpu.resilience import preemption as _preemption
+
+_UNSET = object()
+
+
+def resolve_backend(backend: Optional[str]) -> str:
+    """The effective replica backend: an explicit argument wins, else
+    ``SKYLARK_FLEET_BACKEND``; ``auto`` resolves to process replicas
+    on hosts with >= 4 cores (where per-replica cores exist for them
+    to use) and thread replicas below (where a spawned interpreter
+    per replica buys nothing but boot time)."""
+    if backend is None:
+        backend = str(_env.FLEET_BACKEND.get())
+    backend = str(backend)
+    if backend == "auto":
+        return "process" if (os.cpu_count() or 1) >= 4 else "thread"
+    return backend
 
 
 class ReplicaPool:
@@ -71,16 +96,19 @@ class ReplicaPool:
     equal to a well-tuned single executor's (docs/fleet, "Tuning N").
     """
 
-    def __init__(self, n: int = 2, *, backend: str = "thread",
+    def __init__(self, n: int = 2, *, backend: Optional[str] = None,
                  names: Optional[List[str]] = None, coordinator=None,
                  shared_workers: Optional[int] = None,
                  warmup_pack: Optional[str] = None,
+                 replica_env=None,
                  **executor_kwargs):
         if n < 1:
             raise ValueError("a fleet needs at least one replica")
+        backend = resolve_backend(backend)
         if backend not in ("thread", "process"):
             raise ValueError(
-                f"backend must be 'thread' or 'process', got {backend!r}")
+                f"backend must be 'thread', 'process' or 'auto', "
+                f"got {backend!r}")
         names = list(names) if names else [f"r{i}" for i in range(n)]
         if len(names) != n or len(set(names)) != n:
             raise ValueError(f"need {n} distinct replica names, "
@@ -90,10 +118,22 @@ class ReplicaPool:
         self.pad_floor = int(executor_kwargs.get(
             "pad_floor", bucketing.PAD_FLOOR))
         self.max_batch = int(executor_kwargs.get("max_batch", 8))
+        # per-replica seats: ``coordinator`` / ``replica_env`` may be a
+        # dict applied to every process replica, or a callable
+        # ``name -> dict`` pinning each replica to its own seat in the
+        # multihost pool / its own device subset (env overrides like
+        # CUDA_VISIBLE_DEVICES applied at child entry) — the
+        # "one replica, one device subset" knob
+        self._coordinator = coordinator
+        self._replica_env = replica_env
+        self.warmup_pack = warmup_pack
         self._lock = _locks.make_lock("fleet.pool")
         self._drain_hooks: Dict[str, list] = {name: [] for name in names}
         self._drained: set = set()
         self._replicas: Dict[str, Replica] = {}
+        self._booting: set = set()
+        self._shutdown = False
+        self._next_idx = n
         self._dispatchq = None
         self._dispatchers: list = []
         if shared_workers is not None:
@@ -117,15 +157,13 @@ class ReplicaPool:
                 t.start()
             executor_kwargs = dict(executor_kwargs,
                                    dispatch_queue=self._dispatchq)
+        # the FULL construction kwargs (including the shared dispatch
+        # queue) — add_replica must build future replicas exactly like
+        # the initial ones
+        self._replica_kwargs = dict(executor_kwargs)
         try:
             for name in names:
-                if backend == "thread":
-                    self._replicas[name] = ThreadReplica(
-                        name, warmup_pack=warmup_pack, **executor_kwargs)
-                else:
-                    self._replicas[name] = ProcessReplica(
-                        name, coordinator=coordinator,
-                        warmup_pack=warmup_pack, **executor_kwargs)
+                self._replicas[name] = self._build_replica(name)
         except Exception:
             for r in self._replicas.values():
                 r.shutdown()
@@ -136,6 +174,21 @@ class ReplicaPool:
         # (hook order: drain_serving first) so the per-replica final
         # checkpoints see quiesced replicas
         self._unhook = _preemption.on_preemption(self._run_all_drain_hooks)
+
+    def _per_replica(self, seat, name: str):
+        return seat(name) if callable(seat) else seat
+
+    def _build_replica(self, name: str,
+                       warmup_pack=_UNSET) -> Replica:
+        pack = (self.warmup_pack if warmup_pack is _UNSET
+                else warmup_pack)
+        if self.backend == "thread":
+            return ThreadReplica(name, warmup_pack=pack,
+                                 **self._replica_kwargs)
+        return ProcessReplica(
+            name, coordinator=self._per_replica(self._coordinator, name),
+            env_overrides=self._per_replica(self._replica_env, name),
+            warmup_pack=pack, **self._replica_kwargs)
 
     # -- addressing ----------------------------------------------------
 
@@ -152,10 +205,85 @@ class ReplicaPool:
         """Map a health-hub event source (an executor for thread
         replicas, the replica object for process replicas) to its
         replica name; ``None`` for sources outside this pool."""
-        for name, r in self._replicas.items():
+        for name, r in list(self._replicas.items()):
             if r.owns_source(source):
                 return name
         return None
+
+    # -- elastic membership (the autoscaler's seam) --------------------
+
+    def add_replica(self, name: Optional[str] = None, *,
+                    warmup_pack=_UNSET) -> str:
+        """Grow the pool by one replica (same backend, same uniform
+        executor configuration; process replicas get their own
+        ``coordinator``/``replica_env`` seat from the per-replica
+        callables). Boots from the pool's warmup pack by default — the
+        scale-up path is the r13 pack boot, so a grown fleet serves
+        its packed buckets with zero compiles. Publishes ``SERVING``
+        to the health hub once the replica is live, which is how a
+        subscribed router learns to add it to the ring. Returns the
+        new replica's name."""
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("ReplicaPool is shut down")
+            if name is None:
+                while (f"r{self._next_idx}" in self._replicas
+                       or f"r{self._next_idx}" in self._booting):
+                    self._next_idx += 1
+                name = f"r{self._next_idx}"
+                self._next_idx += 1
+            name = str(name)
+            if name in self._replicas or name in self._booting:
+                raise ValueError(f"replica {name!r} already exists")
+            # reserve the name so two concurrent add_replica calls
+            # cannot race one name (construction happens unlocked —
+            # a process replica boot takes seconds)
+            self._booting.add(name)
+        try:
+            replica = self._build_replica(name, warmup_pack=warmup_pack)
+        except Exception:
+            with self._lock:
+                self._booting.discard(name)
+            raise
+        with self._lock:
+            self._booting.discard(name)
+            if self._shutdown:
+                # the pool died while we were booting (a slow spawn
+                # outliving an autoscaler close + pool.shutdown):
+                # registering now would hand a subscribed router a
+                # replica nothing will ever stop
+                late = replica
+            else:
+                late = None
+                self._replicas[name] = replica
+                self._drain_hooks.setdefault(name, [])
+                self._drained.discard(name)
+        if late is not None:
+            late.shutdown()
+            raise RuntimeError(
+                "ReplicaPool was shut down during the replica boot")
+        _health.publish(replica, "NEW", _serve.SERVING)
+        return name
+
+    def remove_replica(self, name: str,
+                       timeout: Optional[float] = 30.0) -> bool:
+        """Shrink the pool by one replica: preempt it (the r11 SIGTERM
+        drain for process replicas — DRAINING/STOPPED reach the hub,
+        a subscribed router sheds its traffic, in-flight futures
+        resolve, its final drain hooks fire), then forget it. Returns
+        whether the drain reached quiescence inside ``timeout``."""
+        with self._lock:
+            if name not in self._replicas:
+                raise KeyError(f"no replica named {name!r}")
+        drained = self.preempt_replica(name, timeout=timeout)
+        replica = None
+        with self._lock:
+            replica = self._replicas.pop(name, None)
+            self._drain_hooks.pop(name, None)
+            self._drained.discard(name)
+        if replica is not None:
+            replica.shutdown()
+        return drained
 
     # -- traffic helpers -----------------------------------------------
 
@@ -249,6 +377,8 @@ class ReplicaPool:
         self._dispatchers = []
 
     def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
         self._unhook()
         for r in self.replicas():
             try:

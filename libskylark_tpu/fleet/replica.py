@@ -36,6 +36,17 @@ parent → child: ``("submit", rid, endpoint, kwargs)`` /
 ``("state", None, new_state)`` — the last forwarded from the child's
 health hub so the parent's hub (and any subscribed router) sees the
 child's transitions with the :class:`ProcessReplica` as the source.
+Both directions additionally carry ``("shmfree", None, [slots])``
+acks for the shared-memory transport below.
+
+**Shared-memory transport** (:mod:`libskylark_tpu.fleet.shm`, default
+on — ``SKYLARK_FLEET_SHM=0`` disables): large ndarrays inside
+``submit`` kwargs and results do NOT ride the pickle pipe. The sender
+copies them into a slot of the replica pair's shared-memory ring and
+the pipe carries a tiny header; the receiver gets a zero-copy view
+over the slot, released back to the writer when the view is
+garbage-collected. Small values, oversize arrays and ring exhaustion
+fall back to pickle — transport choice never changes a result.
 """
 
 from __future__ import annotations
@@ -122,6 +133,13 @@ class Replica:
     def shutdown(self) -> None:
         raise NotImplementedError
 
+    def latency_quantile(self, q: float = 0.99) -> Optional[float]:
+        """One quantile of the replica's r10 request-latency histogram
+        (seconds; ``None`` when unknown). The router's hedge-delay
+        seed — cheap for thread replicas; a process replica returns
+        ``None`` rather than pay a pipe RPC on the submit path."""
+        return None
+
 
 class ThreadReplica(Replica):
     """In-process replica: a named ``MicrobatchExecutor`` plus the
@@ -165,6 +183,9 @@ class ThreadReplica(Replica):
     def shutdown(self) -> None:
         self.executor.shutdown()
 
+    def latency_quantile(self, q: float = 0.99) -> Optional[float]:
+        return self.executor.latency_quantile(q)
+
     def owns_source(self, source: object) -> bool:
         """Whether a health-hub event source is this replica (the
         executor publishes for thread replicas)."""
@@ -183,16 +204,39 @@ def _send_exception(send, rid, e: BaseException) -> None:
         send(("error", rid, RuntimeError(repr(e))))
 
 
+def _resolve(fut: Future, result=None, exception=None) -> None:
+    """Resolve a parent-side future, tolerating one already resolved —
+    a hedge winner cancels the loser, and the loser's pipe result may
+    still arrive afterwards (InvalidStateError is the race's benign
+    face, not an error)."""
+    try:
+        if exception is not None:
+            fut.set_exception(exception)
+        else:
+            fut.set_result(result)
+    except Exception:  # noqa: BLE001 — already done/cancelled
+        pass
+
+
 def _worker_main(conn, name: str, executor_kwargs: dict,
                  coordinator: Optional[dict],
                  env: Optional[dict] = None,
-                 warmup_pack: Optional[str] = None) -> None:
+                 warmup_pack: Optional[str] = None,
+                 shm_spec: Optional[dict] = None) -> None:
     """Child entry point (module-level: spawn pickles it by name)."""
     # the parent's engine/telemetry environment first — everything
     # below (jax config, engine import, executor construction, pack
     # load) must see the parent's explicit snapshot, not whatever
     # os.environ happened to hold at Process.start()
     _apply_env(env)
+    # attach the shared-memory rings BEFORE the heavy imports: the
+    # parent unlinks the names the moment our liveness RPC resolves,
+    # and the attach is what keeps the mapping alive past that
+    transport = None
+    if shm_spec is not None:
+        from libskylark_tpu.fleet.shm import ShmTransport
+
+        transport = ShmTransport.attach(shm_spec)
     # the child honors the parent's platform pin the same way the
     # benchmarks do (env rides across spawn; sitecustomize may have
     # pre-imported jax with another platform)
@@ -232,6 +276,19 @@ def _worker_main(conn, name: str, executor_kwargs: dict,
         with send_lock:
             conn.send(msg)
 
+    def flush_acks() -> None:
+        """Ship slots whose operand views have been collected back to
+        the parent (the p2c ring's writer). Best-effort: a dead pipe
+        means the whole pair is going down anyway."""
+        if transport is None:
+            return
+        acks = transport.drain_acks()
+        if acks:
+            try:
+                send(("shmfree", None, acks))
+            except Exception:  # noqa: BLE001 — parent gone
+                pass
+
     def forward_state(source, old, new) -> None:
         if source is ex:
             try:
@@ -243,13 +300,32 @@ def _worker_main(conn, name: str, executor_kwargs: dict,
 
     def reply(rid, fut: Future) -> None:
         try:
-            send(("result", rid, fut.result()))
+            value = fut.result()
         except BaseException as e:  # noqa: BLE001 — future's exception
+            _send_exception(send, rid, e)
+            return
+        try:
+            if transport is None:
+                send(("result", rid, value))
+            else:
+                # result handoff without a serialization copy: the
+                # future's value is a view into the flush's one host
+                # batch (engine/serve._execute); encode copies those
+                # bytes straight into a ring slot and the parent maps
+                # them zero-copy
+                payload, claimed = transport.encode(value)
+                try:
+                    send(("result", rid, payload))
+                except BaseException:
+                    transport.unclaim(claimed)
+                    raise
+        except BaseException as e:  # noqa: BLE001 — containment
             _send_exception(send, rid, e)
 
     import functools
 
     while True:
+        flush_acks()
         try:
             if not conn.poll(0.1):
                 if (resilience.preemption_requested()
@@ -261,9 +337,22 @@ def _worker_main(conn, name: str, executor_kwargs: dict,
         except (EOFError, OSError):
             break
         kind, rid = msg[0], msg[1]
+        if kind == "shmfree":
+            if transport is not None:
+                transport.release(msg[2])
+            continue
         try:
             if kind == "submit":
                 endpoint, kwargs = msg[2], msg[3]
+                if transport is not None:
+                    try:
+                        kwargs = transport.decode(kwargs)
+                    except Exception:
+                        # request lost, ring capacity recovered; the
+                        # outer handler errors the parent's future
+                        transport.recover(kwargs)
+                        flush_acks()
+                        raise
                 fut = ex.submit(endpoint, **kwargs)
                 fut.add_done_callback(functools.partial(reply, rid))
             elif kind == "stats":
@@ -276,6 +365,8 @@ def _worker_main(conn, name: str, executor_kwargs: dict,
                     "env": _env.snapshot_propagated(),
                     "warmup": warmup_report,
                     "engine": engine.stats().to_dict(),
+                    "shm": (transport.stats()
+                            if transport is not None else None),
                 }))
             elif kind == "depth":
                 send(("rpc", rid, ex.queue_depth()))
@@ -307,19 +398,40 @@ class ProcessReplica(Replica):
     def __init__(self, name: str, coordinator: Optional[dict] = None,
                  start_timeout: float = 120.0,
                  warmup_pack: Optional[str] = None,
-                 env: Optional[dict] = None, **executor_kwargs):
+                 env: Optional[dict] = None,
+                 env_overrides: Optional[dict] = None,
+                 shm: Optional[bool] = None, **executor_kwargs):
         import multiprocessing as mp
 
         self.name = str(name)
         ctx = mp.get_context("spawn")
         self._conn, child_conn = ctx.Pipe(duplex=True)
         # the engine environment rides the spawn args, not os.environ
-        # timing (PROPAGATED_ENV): snapshot now, apply at child entry
+        # timing (PROPAGATED_ENV): snapshot now, apply at child entry.
+        # ``env_overrides`` layers on top — the device-pinning seat (a
+        # pool can give each replica its own accelerator subset via
+        # e.g. CUDA_VISIBLE_DEVICES/TPU flags without mutating the
+        # parent's environment)
         self._env = dict(env) if env is not None else propagated_env()
+        if env_overrides:
+            self._env.update({str(k): (None if v is None else str(v))
+                              for k, v in env_overrides.items()})
+        # shared-memory operand/result transport (fleet/shm): created
+        # before spawn so the names ride the args; unlinked the moment
+        # the liveness probe proves the child attached
+        if shm is None:
+            shm = bool(_env.FLEET_SHM.get())
+        self._transport = None
+        shm_spec = None
+        if shm:
+            from libskylark_tpu.fleet.shm import ShmTransport
+
+            self._transport = ShmTransport.create(self.name)
+            shm_spec = self._transport.child_spec()
         self._proc = ctx.Process(
             target=_worker_main,
             args=(child_conn, self.name, dict(executor_kwargs),
-                  coordinator, self._env, warmup_pack),
+                  coordinator, self._env, warmup_pack, shm_spec),
             name=f"skylark-replica-{self.name}", daemon=True)
         self._proc.start()
         child_conn.close()
@@ -340,6 +452,11 @@ class ProcessReplica(Replica):
             raise ServeOverloadedError(
                 f"process replica {self.name!r} failed to come up "
                 f"within {start_timeout}s")
+        if self._transport is not None:
+            # the child is alive, so it holds its own mapping: drop
+            # the /dev/shm names NOW — from here on there is nothing a
+            # SIGKILL on either side could leak
+            self._transport.unlink()
 
     # -- child → parent ------------------------------------------------
 
@@ -356,15 +473,36 @@ class ProcessReplica(Replica):
                 old, self._state = self._state, payload
                 _health.publish(self, old, payload)
                 continue
+            if kind == "shmfree":
+                if self._transport is not None:
+                    self._transport.release(payload)
+                continue
             with self._lock:
                 fut = self._futures.pop(rid, None)
             if fut is None:
                 continue
             if kind == "error":
-                fut.set_exception(payload)
+                _resolve(fut, exception=payload)
             else:                      # "result" / "rpc"
-                fut.set_result(payload)
-        # child gone: nothing pending can ever resolve
+                if kind == "result" and self._transport is not None:
+                    try:
+                        payload = self._transport.decode(payload)
+                    except Exception as e:  # noqa: BLE001 — torn slot
+                        # the request is lost; the slots must not be —
+                        # ack whatever the payload referenced
+                        self._transport.recover(payload)
+                        _resolve(fut, exception=ServeOverloadedError(
+                            f"replica {self.name!r} shm decode failed: "
+                            f"{e!r}"))
+                        self._flush_shm_acks()
+                        continue
+                _resolve(fut, result=payload)
+            # result views released since the last turnaround free
+            # their slots on the child (the c2p ring's writer)
+            self._flush_shm_acks()
+        # child gone: nothing pending can ever resolve — and nothing
+        # can arrive over the rings either, so tear the transport down
+        # (unlink is long done; this drops the parent-side mapping)
         with self._lock:
             dead = list(self._futures.values())
             self._futures.clear()
@@ -376,6 +514,23 @@ class ProcessReplica(Replica):
         if self._state not in ("STOPPED",):
             old, self._state = self._state, "STOPPED"
             _health.publish(self, old, "STOPPED")
+        if self._transport is not None:
+            self._transport.destroy()
+
+    def _flush_shm_acks(self) -> None:
+        """Best-effort ``shmfree`` turnaround for released result
+        views (parent side). A dead pipe is fine — the pair is going
+        down and the mappings die with the processes."""
+        if self._transport is None:
+            return
+        acks = self._transport.drain_acks()
+        if not acks:
+            return
+        try:
+            with self._lock:
+                self._conn.send(("shmfree", None, acks))
+        except Exception:  # noqa: BLE001 — child gone
+            pass
 
     # -- parent → child ------------------------------------------------
 
@@ -406,7 +561,16 @@ class ProcessReplica(Replica):
         # optimization; over the pipe it would pickle the operands
         # twice — the child re-derives instead
         kwargs.pop("_derived", None)
-        return self._send("submit", endpoint, kwargs)
+        if self._transport is None:
+            return self._send("submit", endpoint, kwargs)
+        self._flush_shm_acks()
+        payload, claimed = self._transport.encode(kwargs)
+        try:
+            return self._send("submit", endpoint, payload)
+        except BaseException:
+            # the header never left: the child will never ack these
+            self._transport.unclaim(claimed)
+            raise
 
     def queue_depth(self) -> int:
         # outstanding submits the parent knows about — no pipe
@@ -422,8 +586,16 @@ class ProcessReplica(Replica):
 
     def boot_info(self) -> dict:
         """The child's applied engine environment, warmup-pack report,
-        and engine counters — proof of what the replica booted with."""
+        engine counters and shm-transport stats — proof of what the
+        replica booted with (and of what its payloads rode on)."""
         return self._rpc("env") or {}
+
+    def transport_stats(self) -> Optional[dict]:
+        """Parent-side shared-memory transport counters (``None`` when
+        the transport is off)."""
+        if self._transport is None:
+            return None
+        return self._transport.stats()
 
     def flush(self) -> None:
         self._rpc("flush")
@@ -463,6 +635,8 @@ class ProcessReplica(Replica):
                 self._conn.close()
             except OSError:
                 pass
+            if self._transport is not None:
+                self._transport.destroy()
 
     def owns_source(self, source: object) -> bool:
         return source is self

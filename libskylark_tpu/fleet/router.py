@@ -38,21 +38,42 @@ replica therefore costs zero client-visible failures: queued work
 drains, new work fails over (``bench.py --fleet`` records it; the
 chaos battery replays it under a fixed seed).
 
+Hedging (``Router(pool, hedge=True)`` / ``SKYLARK_FLEET_HEDGE``):
+a straggling in-flight request — one still unresolved after a
+p99-derived delay — is mirrored to the second healthy ring-preference
+replica and the caller's future settles with whichever attempt
+finishes first; the loser is cancelled (or, under
+``SKYLARK_FLEET_HEDGE_VERIFY``, completed and compared bitwise — the
+determinism guard). Both executions are bit-equal by construction:
+the serve endpoints are pure functions of their operands and key
+material, and the mirror reuses the identical kwargs and ``_derived``
+statics. See docs/fleet "Hedged requests".
+
 Telemetry: ``fleet.routed`` / ``fleet.affinity_hit`` /
-``fleet.failover`` / ``fleet.spilled`` counters (labeled per replica),
-a ``fleet.route`` span parented over the executor's ``serve.submit``
-span (same request id), and a ``fleet`` collector block in
-``telemetry.snapshot()`` aggregating every live router.
+``fleet.failover`` / ``fleet.spilled`` / ``fleet.hedged`` /
+``fleet.hedge_wins`` / ``fleet.hedge_mismatches`` counters (labeled
+per replica), a ``fleet.route`` span parented over the executor's
+``serve.submit`` span (same request id), and a ``fleet`` collector
+block in ``telemetry.snapshot()`` aggregating every live router,
+every live autoscaler, and the process-lifetime hedge/scale totals.
 """
 
 from __future__ import annotations
 
 import collections
+import heapq
+import itertools
+import threading
+import time
+import warnings
 import weakref
 from concurrent.futures import Future
 from typing import Iterable, Optional
 
+import numpy as np
+
 from libskylark_tpu import telemetry as _telemetry
+from libskylark_tpu.base import env as _env
 from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.engine import serve as _serve
 from libskylark_tpu.fleet.pool import ReplicaPool
@@ -72,6 +93,24 @@ _FAILOVER = _metrics.counter(
     "fleet.failover", "Route failovers, by refusing replica")
 _SPILLED = _metrics.counter(
     "fleet.spilled", "Load spills away from a saturated ring owner")
+_HEDGED = _metrics.counter(
+    "fleet.hedged", "Straggler requests mirrored to a second replica, "
+    "by hedge-target replica")
+_HEDGE_WINS = _metrics.counter(
+    "fleet.hedge_wins", "Hedged requests where the mirror finished "
+    "first, by hedge-target replica")
+_HEDGE_MISMATCH = _metrics.counter(
+    "fleet.hedge_mismatches", "Hedge verify-mode comparisons where the "
+    "two executions diverged (must stay 0 — the endpoints are "
+    "deterministic)")
+
+
+# process-lifetime hedge rollup: hedge events survive their router (a
+# benchmarks telemetry snapshot taken after a leg's router is gone
+# must still carry them — collectors report live objects only)
+_LIFETIME = _metrics.LifetimeCounter(
+    "fleet.router_life",
+    kinds=("hedged", "hedge_wins", "hedge_mismatches"))
 
 
 class NoHealthyReplicaError(_serve.ServeOverloadedError):
@@ -79,6 +118,89 @@ class NoHealthyReplicaError(_serve.ServeOverloadedError):
     whole preference order failed over). A ``ServeOverloadedError``
     subclass so single-executor retry handling keeps working against a
     fleet."""
+
+
+class _HedgeEntry:
+    """One hedged request's state (see ``Router`` "Hedged requests").
+    ``client`` is the future the caller holds; ``primary``/``hedge``
+    are the replica attempts racing to settle it."""
+
+    __slots__ = ("endpoint", "kwargs", "statics", "primary",
+                 "primary_name", "client", "tags", "t0", "fired",
+                 "hedge", "hedge_name", "settled", "errors", "results")
+
+    def __init__(self, endpoint, kwargs, statics, primary, primary_name,
+                 tags):
+        self.endpoint = endpoint
+        self.kwargs = kwargs
+        self.statics = statics
+        self.primary = primary
+        self.primary_name = primary_name
+        self.client: Future = Future()
+        self.tags = tags
+        self.t0 = time.monotonic()
+        self.fired = False
+        self.hedge: Optional[Future] = None
+        self.hedge_name: Optional[str] = None
+        self.settled = False
+        self.errors: dict = {}
+        self.results: dict = {}
+
+
+class _Hedger:
+    """The router's straggler watchdog: a single timer thread over a
+    heap of (due-time, entry). An entry whose client settled before
+    its due time costs one heap pop and nothing else; one that is
+    still unresolved fires a mirror submit to the next healthy
+    ring-preference replica."""
+
+    def __init__(self, router: "Router"):
+        self._router = router
+        self._cond = threading.Condition(
+            _locks.make_lock("fleet.hedger"))
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, name="skylark-fleet-hedger", daemon=True)
+        self._thread.start()
+
+    def watch(self, entry: _HedgeEntry, due: float) -> None:
+        with self._cond:
+            heapq.heappush(self._heap, (due, next(self._seq), entry))
+            self._cond.notify()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+        self._thread.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        while True:
+            fire = None
+            with self._cond:
+                if self._stop:
+                    return
+                now = time.monotonic()
+                if not self._heap:
+                    self._cond.wait(timeout=1.0)
+                    continue
+                due, _, entry = self._heap[0]
+                if due > now:
+                    self._cond.wait(timeout=due - now)
+                    continue
+                heapq.heappop(self._heap)
+                fire = entry
+            # the dispatch runs OUTSIDE the heap lock: a mirror submit
+            # can block on a replica queue and must not stall the
+            # watchdog for every other in-flight hedge
+            if fire is not None:
+                try:
+                    self._router._fire_hedge(fire)
+                except Exception as e:  # noqa: BLE001 — watchdog lives
+                    warnings.warn(f"hedge dispatch failed: {e}",
+                                  RuntimeWarning, stacklevel=1)
 
 
 class Router:
@@ -99,13 +221,32 @@ class Router:
     """
 
     def __init__(self, pool: ReplicaPool, *, vnodes: int = 64,
-                 spill_threshold: Optional[int] = None):
+                 spill_threshold: Optional[int] = None,
+                 hedge: Optional[bool] = None,
+                 hedge_delay_ms: Optional[float] = None,
+                 hedge_verify: Optional[bool] = None):
         self._pool = pool
         self._ring = HashRing(pool.names(), vnodes=vnodes)
         self.spill_threshold = int(
             spill_threshold if spill_threshold is not None
             else 4 * pool.max_batch)
         self._lock = _locks.make_lock("fleet.router")
+        # hedged requests (docs/fleet "Hedged requests"): arguments
+        # beat the env defaults; the hedger thread starts lazily on
+        # the first hedged submit
+        self._hedge_on = bool(_env.FLEET_HEDGE.get()
+                              if hedge is None else hedge)
+        self._hedge_fixed_ms = (
+            _env.FLEET_HEDGE_DELAY_MS.get()
+            if hedge_delay_ms is None else float(hedge_delay_ms))
+        self._hedge_verify = bool(_env.FLEET_HEDGE_VERIFY.get()
+                                  if hedge_verify is None
+                                  else hedge_verify)
+        self._hedge_lock = _locks.make_lock("fleet.hedge")
+        self._hedger: Optional[_Hedger] = None
+        self._latency: "collections.deque" = collections.deque(
+            maxlen=4096)
+        self._hedge_delay_cache = (0.0, 0.05)   # (stamp, seconds)
         self._degraded: set = set()
         self._removed: set = set()
         self._counts = collections.Counter()
@@ -174,6 +315,15 @@ class Router:
                 self._degraded.add(name)
             elif new == _serve.SERVING:
                 self._degraded.discard(name)
+                if name not in self._ring:
+                    # a replica the pool grew (autoscale scale-up) or
+                    # revived: join the ring and re-derive sticky
+                    # ownership against the new membership
+                    self._ring.add(name)
+                    self._epoch += 1
+                    self._assign.clear()
+                    self._owned.clear()
+                    self._removed.discard(name)
 
     def _affinity_owner(self, statics: tuple,
                         record: bool = True) -> Optional[str]:
@@ -224,15 +374,24 @@ class Router:
         order = healthy + [n for n in pref if n in degraded]
         spilled = False
         if len(healthy) > 1 and order and order[0] == owner:
-            depth = self._pool.get(owner).queue_depth()
-            if depth >= self.spill_threshold:
-                peers = [(self._pool.get(n).queue_depth(), n)
-                         for n in healthy[1:]]
-                best_depth, best = min(peers)
-                if best_depth < depth:
-                    order.remove(best)
-                    order.insert(0, best)
-                    spilled = True
+            try:
+                depth = self._pool.get(owner).queue_depth()
+            except KeyError:           # removed by a scale-down race
+                depth = None
+            if depth is not None and depth >= self.spill_threshold:
+                peers = []
+                for n in healthy[1:]:
+                    try:
+                        peers.append((self._pool.get(n).queue_depth(),
+                                      n))
+                    except KeyError:
+                        continue
+                if peers:
+                    best_depth, best = min(peers)
+                    if best_depth < depth:
+                        order.remove(best)
+                        order.insert(0, best)
+                        spilled = True
         return tuple(order), owner, spilled
 
     def submit(self, endpoint: str, /, **kwargs) -> Future:
@@ -263,8 +422,15 @@ class Router:
             # refusal, saturation, or a degraded owner)
             owner = self._affinity_owner(statics)
             if owner is not None and owner not in self._degraded:
-                if (self._pool.get(owner).queue_depth()
-                        < self.spill_threshold):
+                try:
+                    # a scale-down can remove the owner from the pool
+                    # between the ring read and here; the slow path's
+                    # candidate walk handles the re-derivation
+                    owner_depth = self._pool.get(owner).queue_depth()
+                except KeyError:
+                    owner_depth = None
+                if (owner_depth is not None
+                        and owner_depth < self.spill_threshold):
                     try:
                         faults.check("fleet.route", tags=tags,
                                      detail=f"{endpoint} -> {owner}")
@@ -284,7 +450,8 @@ class Router:
                             endpoint, kwargs, statics, owner, sp,
                             tags, skip=owner, last_err=e)
                     self._account(owner, owner, False, sp)
-                    return fut
+                    return self._maybe_hedge(endpoint, kwargs, statics,
+                                             owner, fut, tags)
             return self._submit_slow(endpoint, kwargs, statics, owner,
                                      sp, tags)
 
@@ -334,11 +501,216 @@ class Router:
                                               "error": repr(e)})
                 continue
             self._account(name, owner, spilled, sp)
-            return fut
+            return self._maybe_hedge(endpoint, kwargs, statics, name,
+                                     fut, tags)
         raise NoHealthyReplicaError(
             f"no replica accepted {endpoint!r}: tried "
             f"{list(order) or 'none (empty ring)'}"
         ) from last_err
+
+    # -- hedged requests (docs/fleet "Hedged requests") ----------------
+
+    def _maybe_hedge(self, endpoint: str, kwargs: dict, statics: tuple,
+                     name: str, fut: Future, tags) -> Future:
+        """Wrap an accepted dispatch in a straggler watchdog: if the
+        replica's future is still unresolved after a p99-derived
+        delay, mirror the request to the next healthy ring-preference
+        replica and settle the returned future with whichever attempt
+        finishes first. Both executions are bit-equal by construction
+        — the serve endpoints are deterministic functions of the
+        operands and the transform's key material, and the mirror
+        reuses the exact same kwargs (including the predigested
+        ``_derived`` statics), so taking either result is sound.
+        No-op (the replica future passes straight through) when
+        hedging is off."""
+        if not self._hedge_on:
+            return fut
+        if self._hedger is None:
+            with self._hedge_lock:
+                if self._hedger is None:
+                    self._hedger = _Hedger(self)
+        entry = _HedgeEntry(endpoint, kwargs, statics, fut, name, tags)
+        fut.add_done_callback(
+            lambda f: self._attempt_done(entry, f, "primary"))
+        self._hedger.watch(entry,
+                           time.monotonic() + self._hedge_delay_s())
+        return entry.client
+
+    def _hedge_delay_s(self) -> float:
+        """The straggler threshold: ``hedge_delay_ms`` when pinned,
+        else the p99 of recent client-observed request latencies (the
+        same quantity the r10 latency histograms export — seeded from
+        the replicas' :meth:`latency_quantile` until this router has
+        its own samples). Cached for 0.5 s so the submit hot path
+        never sorts the sample window."""
+        if self._hedge_fixed_ms is not None:
+            return max(float(self._hedge_fixed_ms), 0.0) / 1000.0
+        now = time.monotonic()
+        stamp, val = self._hedge_delay_cache
+        if now - stamp < 0.5:
+            return val
+        # snapshot under the hedge lock: done-callback threads append
+        # concurrently, and sorting a mutating deque raises (the same
+        # discipline serve.latency_quantile applies to its histogram)
+        with self._hedge_lock:
+            lat = sorted(self._latency)
+        p99 = _serve._percentile(lat, 0.99)
+        if p99 is None:
+            qs = [q for q in (r.latency_quantile(0.99)
+                              for r in self._pool.replicas())
+                  if q is not None]
+            p99 = max(qs) if qs else 0.05
+        val = min(max(p99, 0.001), 5.0)
+        self._hedge_delay_cache = (now, val)
+        return val
+
+    def _fire_hedge(self, entry: _HedgeEntry) -> None:
+        """Hedger-thread callback at an entry's due time: dispatch the
+        mirror unless the primary already resolved. The mirror is
+        opportunistic — if every peer refuses it, the primary simply
+        keeps its race unopposed."""
+        with self._hedge_lock:
+            if entry.settled or entry.fired or entry.primary.done():
+                return
+            # snapshot under the lock: a settling primary clears the
+            # payload (heap entries outlive their requests — the
+            # watchdog must not pin every fast request's operands
+            # until its due time)
+            kwargs = entry.kwargs
+        if kwargs is None:
+            return
+        with self._lock:
+            degraded = set(self._degraded)
+        hfut = target = None
+        for nm in self._ring.preference(entry.statics):
+            if nm == entry.primary_name or nm in degraded:
+                continue
+            try:
+                # same chaos seam as a route attempt: a fault plan can
+                # deterministically fail (or stall) the mirror
+                faults.check("fleet.route", tags=entry.tags,
+                             detail=f"hedge {entry.endpoint} -> {nm}")
+                hfut = self._pool.get(nm).submit(entry.endpoint,
+                                                 **kwargs)
+                target = nm
+                break
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException:  # noqa: BLE001 — try the next peer
+                continue
+        if hfut is None:
+            return
+        armed = False
+        with self._hedge_lock:
+            if not entry.settled:
+                entry.fired = True
+                entry.hedge = hfut
+                entry.hedge_name = target
+                entry.kwargs = None     # both attempts dispatched
+                armed = True
+        if not armed:
+            hfut.cancel()
+            return
+        with self._lock:
+            self._counts["hedged"] += 1
+        _LIFETIME.inc("hedged")
+        _HEDGED.inc(replica=target)
+        hfut.add_done_callback(
+            lambda f: self._attempt_done(entry, f, "hedge"))
+
+    def _attempt_done(self, entry: _HedgeEntry, fut: Future,
+                      who: str) -> None:
+        """Race arbitration: first successful attempt settles the
+        client future; the loser is cancelled (or, in verify mode,
+        allowed to finish and compared bitwise — the determinism
+        guard). An attempt's failure only fails the client when no
+        other attempt can still win."""
+        if fut.cancelled():
+            return
+        err = fut.exception()
+        # the future IS done here (we run in its done callback), so
+        # result() returns immediately — read it before the lock so
+        # nothing that can touch Future machinery runs under it
+        value = fut.result() if err is None else None
+        settle_exc = settle_val = None
+        to_cancel = None
+        win = False
+        have_val = False
+        with self._hedge_lock:
+            if err is not None:
+                entry.errors[who] = err
+                if entry.settled:
+                    return
+                peer = (entry.hedge if who == "primary"
+                        else entry.primary)
+                peer_live = (peer is not None and not peer.done()
+                             and (who == "hedge" or entry.fired))
+                if peer_live:
+                    return             # the peer may still win
+                entry.settled = True
+                entry.kwargs = None    # nothing left to dispatch
+                settle_exc = err
+            else:
+                if self._hedge_verify:
+                    entry.results[who] = value
+                if entry.settled:
+                    pass               # loser finished (verify below)
+                else:
+                    entry.settled = True
+                    entry.kwargs = None
+                    settle_val, have_val = value, True
+                    win = who == "hedge" and entry.fired
+                    if not self._hedge_verify:
+                        to_cancel = (entry.hedge if who == "primary"
+                                     else entry.primary)
+            both = (self._hedge_verify
+                    and len(entry.results) == 2)
+        if settle_exc is not None:
+            try:
+                entry.client.set_exception(settle_exc)
+            except Exception:  # noqa: BLE001 — already resolved
+                pass
+            return
+        if have_val:
+            with self._hedge_lock:
+                self._latency.append(time.monotonic() - entry.t0)
+            try:
+                entry.client.set_result(settle_val)
+            except Exception:  # noqa: BLE001 — already resolved
+                pass
+            if win:
+                with self._lock:
+                    self._counts["hedge_wins"] += 1
+                _LIFETIME.inc("hedge_wins")
+                _HEDGE_WINS.inc(replica=entry.hedge_name)
+            if to_cancel is not None and not to_cancel.done():
+                to_cancel.cancel()
+        if both:
+            self._verify_hedge(entry)
+
+    def _verify_hedge(self, entry: _HedgeEntry) -> None:
+        """Determinism guard (verify mode): both attempts completed —
+        their results must be bit-equal. A divergence is a correctness
+        bug (a serve endpoint stopped being a pure function of its
+        operands), counted and warned, never silently averaged
+        away."""
+        try:
+            a = np.asarray(entry.results["primary"])
+            b = np.asarray(entry.results["hedge"])
+            equal = a.shape == b.shape and np.array_equal(a, b)
+        except Exception:  # noqa: BLE001 — non-array results
+            equal = entry.results["primary"] == entry.results["hedge"]
+        if not equal:
+            with self._lock:
+                self._counts["hedge_mismatches"] += 1
+            _LIFETIME.inc("hedge_mismatches")
+            _HEDGE_MISMATCH.inc(replica=entry.hedge_name or "?")
+            warnings.warn(
+                f"hedged {entry.endpoint} produced diverging results "
+                f"on {entry.primary_name!r} vs {entry.hedge_name!r} — "
+                "a serve endpoint is no longer deterministic",
+                RuntimeWarning, stacklevel=2)
+        entry.results.clear()          # comparison done: drop payloads
 
     # executor-mirroring conveniences
 
@@ -392,6 +764,9 @@ class Router:
                 if routed else None),
             "failover": c.get("failover", 0),
             "spilled": c.get("spilled", 0),
+            "hedged": c.get("hedged", 0),
+            "hedge_wins": c.get("hedge_wins", 0),
+            "hedge_mismatches": c.get("hedge_mismatches", 0),
             "routable": self.routable(),
             "degraded": degraded,
             "removed": removed,
@@ -399,9 +774,12 @@ class Router:
         }
 
     def close(self) -> None:
-        """Unsubscribe from the health hub (the pool outlives the
-        router; idempotent)."""
+        """Unsubscribe from the health hub and stop the hedger (the
+        pool outlives the router; idempotent)."""
         self._unsub()
+        hedger, self._hedger = self._hedger, None
+        if hedger is not None:
+            hedger.stop()
 
     def __enter__(self) -> "Router":
         return self
@@ -415,15 +793,19 @@ _ROUTERS: "weakref.WeakSet[Router]" = weakref.WeakSet()
 
 def fleet_stats() -> dict:
     """Aggregate routing counters over every live router (the
-    ``fleet`` collector block in ``telemetry.snapshot()``)."""
+    ``fleet`` collector block in ``telemetry.snapshot()``), plus the
+    autoscaler rollup from every live
+    :class:`~libskylark_tpu.fleet.autoscale.Autoscaler`."""
     agg = collections.Counter(routed=0, affinity_hit=0, failover=0,
-                              spilled=0)
+                              spilled=0, hedged=0, hedge_wins=0,
+                              hedge_mismatches=0)
     by_replica = collections.Counter()
     routers = 0
     for router in list(_ROUTERS):
         s = router.stats()
         routers += 1
-        for k in ("routed", "affinity_hit", "failover", "spilled"):
+        for k in ("routed", "affinity_hit", "failover", "spilled",
+                  "hedged", "hedge_wins", "hedge_mismatches"):
             agg[k] += s[k]
         by_replica.update(s["by_replica"])
     out = dict(agg)
@@ -433,6 +815,12 @@ def fleet_stats() -> dict:
         if out["routed"] else None)
     out["by_replica"] = {name: {"routed": n}
                          for name, n in sorted(by_replica.items())}
+    out.update(_LIFETIME.snapshot())
+    # late import: autoscale imports the pool, never this module, so
+    # the collector can reach its live-scaler rollup without a cycle
+    from libskylark_tpu.fleet import autoscale as _autoscale
+
+    out["autoscale"] = _autoscale.autoscale_stats()
     return out
 
 

@@ -1,0 +1,523 @@
+"""Shared-memory operand/result transport for process replicas.
+
+Every ndarray crossing a :class:`~libskylark_tpu.fleet.replica
+.ProcessReplica` pipe used to be pickled twice — serialized by the
+sender, reassembled by the receiver, streamed through a 64 KiB-chunked
+OS pipe in between. For the fleet's actual payloads (dense operands in,
+dense results out) that is pure overhead: the bytes are already in
+exactly the layout the other side wants. This module moves them
+through ``multiprocessing.shared_memory`` instead:
+
+- each replica pair owns **two rings** of fixed-size slots (one
+  segment per direction, ``SKYLARK_FLEET_SHM_SLOTS`` ×
+  ``SKYLARK_FLEET_SHM_SLOT_BYTES`` each);
+- the **pipe stays the control channel**: a message that would have
+  carried an ndarray carries a tiny :class:`ShmRef` header (slot,
+  shape, dtype) instead, and ordering is inherited from the pipe — the
+  slot is fully written before the header is sent;
+- the **receiver is zero-copy**: a decoded :class:`ShmRef` becomes a
+  read-only ``np.ndarray`` view directly over the slot. The slot is
+  released when that view (and every array derived from it) is
+  garbage-collected — a ``weakref.finalize`` enqueues the slot id and
+  the next pipe turnaround carries a ``shmfree`` ack back to the
+  writer. The sender pays one ``np.copyto`` into the slot (strided
+  sources welcome — no ``ascontiguousarray`` staging copy);
+- everything degrades to the **pickle fallback**: values under
+  ``SKYLARK_FLEET_SHM_MIN_BYTES``, arrays larger than one slot, object
+  dtypes, and any send finding the ring exhausted simply travel the
+  pipe as before (``fleet.shm_fallbacks`` counts them). Transport
+  choice can never change a result — the fallback path is the r11 wire
+  format, bit for bit.
+
+**Segment lifecycle (the no-leak contract).** The parent creates both
+segments; the child attaches them at entry; once the parent's boot
+liveness probe confirms the attach, the parent *immediately unlinks*
+the names. POSIX keeps the memory alive for as long as either process
+maps it, so steady-state operation runs with **zero** ``/dev/shm``
+entries — a SIGTERM'd replica, a ``kill -9``'d child, even a
+``kill -9``'d parent cannot leak a segment, because there is no name
+left to leak. The only window where names exist is replica boot, and
+that window is covered three ways: :meth:`ShmTransport.destroy` runs
+from ``ProcessReplica.shutdown`` and the reader-loop's dead-child
+path (both tied to the r9/r11 drain hooks), an ``atexit`` sweep
+destroys any transport still live at interpreter exit, and the
+``multiprocessing`` resource tracker (a separate process) reaps
+registered names if the parent dies mid-boot.
+"""
+
+from __future__ import annotations
+
+import atexit
+import itertools
+import os
+import weakref
+from collections import deque
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from libskylark_tpu.base import env as _env
+from libskylark_tpu.base import locks as _locks
+from libskylark_tpu.telemetry import metrics as _metrics
+
+#: ``/dev/shm`` name prefix for every segment this module creates —
+#: tests (and operators) can assert no entry with this prefix outlives
+#: the fleet.
+SHM_PREFIX = "skylark_shm_"
+
+_SENDS = _metrics.counter(
+    "fleet.shm_sends", "Arrays moved through a shared-memory slot, "
+    "by replica and direction")
+_FALLBACKS = _metrics.counter(
+    "fleet.shm_fallbacks", "Array sends that degraded to the pickle "
+    "pipe, by replica and reason")
+
+
+class ShmRef:
+    """Wire header for one array riding a shared-memory slot. Travels
+    the pipe in the ndarray's place; the receiver rebuilds a zero-copy
+    view from it. Picklable by design (it IS the pickled payload)."""
+
+    __slots__ = ("slot", "shape", "dtype", "nbytes")
+
+    def __init__(self, slot: int, shape: tuple, dtype: str, nbytes: int):
+        self.slot = slot
+        self.shape = shape
+        self.dtype = dtype
+        self.nbytes = nbytes
+
+    def __reduce__(self):
+        return (ShmRef, (self.slot, self.shape, self.dtype, self.nbytes))
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"ShmRef(slot={self.slot}, shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+
+def _untrack(shm) -> None:
+    """Drop a segment from the ``resource_tracker`` after the
+    deliberate unlink (the tracker would otherwise re-unlink — and
+    warn about — a name that is already gone). Called exactly once,
+    by the owner: a spawn child SHARES the parent's tracker process,
+    so the child's attach-time registration (the 3.10
+    register-on-attach behavior) dedupes into the parent's and must
+    not be separately unregistered — two removes of one cache entry
+    make the tracker log spurious KeyErrors."""
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:  # noqa: BLE001 — bookkeeping only, never fatal
+        pass
+
+
+class ShmRing:
+    """One direction of the transport: a slotted view over one shared
+    segment. The *writer* side owns the free list and copies arrays
+    in; the *reader* side builds zero-copy views and reports released
+    slots back (via the transport's ack plumbing, not directly)."""
+
+    def __init__(self, shm, slots: int, slot_bytes: int, *,
+                 writer: bool):
+        self._shm = shm
+        self.slots = int(slots)
+        self.slot_bytes = int(slot_bytes)
+        self._writer = writer
+        self._lock = _locks.make_lock("fleet.shm")
+        # LIFO free list: the hottest slot is the one most recently
+        # released (cache warmth), and order is irrelevant for
+        # correctness — slots are independent
+        self._free: Optional[List[int]] = (
+            list(range(self.slots)) if writer else None)
+        self.sends = 0
+        # per-reason fallback counts: "ring" (exhausted — raise
+        # SKYLARK_FLEET_SHM_SLOTS), "oversize" (raise
+        # SKYLARK_FLEET_SHM_SLOT_BYTES), "dtype" (object/empty — not
+        # tunable). An operator sizing the rings from the metric must
+        # see which knob actually helps.
+        self.fallback_reasons = {"ring": 0, "oversize": 0, "dtype": 0}
+
+    @property
+    def fallbacks(self) -> int:
+        return sum(self.fallback_reasons.values())
+
+    def try_put(self,
+                arr: np.ndarray) -> Tuple[Optional[ShmRef],
+                                          Optional[str]]:
+        """Copy ``arr`` into a free slot. Returns ``(ref, None)`` on a
+        send, ``(None, reason)`` on a pickle fallback (oversize /
+        unexpressible dtype / ring exhausted) — the caller gets its
+        own outcome so per-call accounting never reads shared counters
+        racily. Never blocks."""
+        assert self._writer, "try_put on the reader side"
+        # only simple scalar dtypes ride: their ``.str`` round-trips
+        # through np.dtype() on the receiver. Structured/sub-array/
+        # object dtypes fall back to pickle — a dtype the header can't
+        # express must not become a decode error the pickle path would
+        # not have had
+        if (arr.dtype.hasobject or arr.dtype.names is not None
+                or arr.dtype.subdtype is not None or arr.nbytes == 0):
+            with self._lock:
+                self.fallback_reasons["dtype"] += 1
+            return None, "dtype"
+        if arr.nbytes > self.slot_bytes:
+            with self._lock:
+                self.fallback_reasons["oversize"] += 1
+            return None, "oversize"
+        with self._lock:
+            if not self._free:
+                self.fallback_reasons["ring"] += 1
+                return None, "ring"
+            slot = self._free.pop()
+            self.sends += 1
+        # the copy runs OUTSIDE the lock: the slot is exclusively ours
+        # until the peer acks it back, and np.copyto handles strided
+        # sources (the serve layer's _unpad views) in one pass
+        view = np.ndarray(arr.shape, arr.dtype, buffer=self._shm.buf,
+                          offset=slot * self.slot_bytes)
+        np.copyto(view, arr, casting="no")
+        del view
+        return ShmRef(slot, tuple(arr.shape), arr.dtype.str,
+                      int(arr.nbytes)), None
+
+    def release(self, slots: Iterable[int]) -> None:
+        """Return acked slots to the free list (writer side; called
+        when the peer's ``shmfree`` arrives)."""
+        assert self._writer, "release on the reader side"
+        with self._lock:
+            for s in slots:
+                s = int(s)
+                if 0 <= s < self.slots and s not in self._free:
+                    self._free.append(s)
+
+    def free_slots(self) -> int:
+        with self._lock:
+            return len(self._free) if self._free is not None else 0
+
+    def validate(self, ref: ShmRef) -> None:
+        """Raise on a header a :meth:`view` could not materialize —
+        run over a whole payload BEFORE building any view, so a
+        malformed payload is rejected while zero slots have gained
+        finalizers (the recovery path may then ack every referenced
+        slot without racing a half-created view's own release)."""
+        dt = np.dtype(ref.dtype)       # raises on a non-round-trip str
+        if not 0 <= ref.slot < self.slots:
+            raise ValueError(f"slot {ref.slot} out of range")
+        nbytes = dt.itemsize * int(np.prod(ref.shape, dtype=np.int64))
+        if nbytes != ref.nbytes or nbytes > self.slot_bytes:
+            raise ValueError(
+                f"header geometry inconsistent: shape {ref.shape} x "
+                f"{ref.dtype} = {nbytes} B vs declared {ref.nbytes} B "
+                f"(slot holds {self.slot_bytes})")
+
+    def view(self, ref: ShmRef, on_release) -> np.ndarray:
+        """Zero-copy read-only array over ``ref``'s slot.
+        ``on_release(slot)`` fires when the view (and everything
+        derived from it) is garbage-collected — it must be cheap and
+        lock-free (it runs wherever GC runs), so the transport just
+        appends to a deque and lets the next pipe turnaround carry the
+        ack."""
+        assert not self._writer, "view on the writer side"
+        arr = np.ndarray(ref.shape, np.dtype(ref.dtype),
+                         buffer=self._shm.buf,
+                         offset=ref.slot * self.slot_bytes)
+        arr.flags.writeable = False
+        weakref.finalize(arr, on_release, ref.slot)
+        return arr
+
+
+def _encode(obj, ring: ShmRing, min_bytes: int,
+            _depth: int = 0) -> Tuple[object, List[int], dict]:
+    """Replace large ndarrays in ``obj`` (dict/list/tuple containers,
+    two levels deep — the message shapes the replica protocol actually
+    sends) with :class:`ShmRef` headers. Returns the encoded object,
+    the claimed slots (the caller releases them locally if the pipe
+    send then fails), and THIS call's fallback counts by reason —
+    per-call, so metric deltas never read shared counters racily."""
+    claimed: List[int] = []
+    fallbacks: dict = {}
+
+    def enc(x, depth):
+        if isinstance(x, np.ndarray):
+            if x.nbytes >= min_bytes:
+                ref, reason = ring.try_put(x)
+                if ref is not None:
+                    claimed.append(ref.slot)
+                    return ref
+                fallbacks[reason] = fallbacks.get(reason, 0) + 1
+            return x
+        if depth >= 2:
+            return x
+        if isinstance(x, dict):
+            return {k: enc(v, depth + 1) for k, v in x.items()}
+        if isinstance(x, list):
+            return [enc(v, depth + 1) for v in x]
+        if isinstance(x, tuple):
+            return tuple(enc(v, depth + 1) for v in x)
+        return x
+
+    return enc(obj, _depth), claimed, fallbacks
+
+
+def _decode(obj, ring: ShmRing, on_release, _depth: int = 0):
+    """Inverse of :func:`_encode`: materialize every :class:`ShmRef`
+    as a zero-copy view (see :meth:`ShmRing.view`). Pickled fallback
+    arrays are marked read-only too, so a process replica's payloads
+    have ONE mutability story regardless of which path each array
+    happened to ride (a load-dependent writable/read-only flip would
+    be a client-visible heisenbug)."""
+
+    def dec(x, depth):
+        if isinstance(x, ShmRef):
+            return ring.view(x, on_release)
+        if isinstance(x, np.ndarray):
+            try:
+                x.flags.writeable = False
+            except ValueError:
+                pass                   # non-owning view: leave it
+            return x
+        if depth >= 2:
+            return x
+        if isinstance(x, dict):
+            return {k: dec(v, depth + 1) for k, v in x.items()}
+        if isinstance(x, list):
+            return [dec(v, depth + 1) for v in x]
+        if isinstance(x, tuple):
+            return tuple(dec(v, depth + 1) for v in x)
+        return x
+
+    return dec(obj, _depth)
+
+
+def scan_refs(obj, _depth: int = 0) -> List[ShmRef]:
+    """Every :class:`ShmRef` in a payload — the validation pre-pass
+    and the slot-recovery path when a payload is rejected: claimed
+    slots must go back to the writer or the ring loses capacity
+    forever."""
+    out: List[ShmRef] = []
+
+    def walk(x, depth):
+        if isinstance(x, ShmRef):
+            out.append(x)
+            return
+        if depth >= 2:
+            return
+        if isinstance(x, dict):
+            for v in x.values():
+                walk(v, depth + 1)
+        elif isinstance(x, (list, tuple)):
+            for v in x:
+                walk(v, depth + 1)
+
+    walk(obj, _depth)
+    return out
+
+
+_SEQ = itertools.count()
+_LIVE: "weakref.WeakSet[ShmTransport]" = weakref.WeakSet()
+
+
+class ShmTransport:
+    """Both rings of one replica pair, from one side's point of view.
+
+    Build with :meth:`create` in the parent (makes the segments) and
+    :meth:`attach` in the child (maps them, then *unregisters* them
+    from its resource tracker — see :func:`_untrack`). ``tx`` is the
+    ring this side writes, ``rx`` the ring it reads; the parent's
+    ``tx`` is the child's ``rx`` and vice versa.
+    """
+
+    def __init__(self, label: str, tx: ShmRing, rx: ShmRing,
+                 min_bytes: int, names: Tuple[str, str],
+                 owner: bool):
+        self.label = label
+        self.tx = tx
+        self.rx = rx
+        self.min_bytes = int(min_bytes)
+        self._names = names
+        self._owner = owner
+        self._unlinked = not owner
+        self._pending_free: "deque[int]" = deque()
+        self.recv_views = 0
+        if owner:
+            _LIVE.add(self)
+
+    # -- construction --------------------------------------------------
+
+    @classmethod
+    def create(cls, replica_name: str, *,
+               slots: Optional[int] = None,
+               slot_bytes: Optional[int] = None,
+               min_bytes: Optional[int] = None) -> "ShmTransport":
+        from multiprocessing import shared_memory
+
+        slots = int(slots if slots is not None
+                    else _env.FLEET_SHM_SLOTS.get())
+        slot_bytes = int(slot_bytes if slot_bytes is not None
+                         else _env.FLEET_SHM_SLOT_BYTES.get())
+        min_bytes = int(min_bytes if min_bytes is not None
+                        else _env.FLEET_SHM_MIN_BYTES.get())
+        safe = "".join(c if c.isalnum() else "-"
+                       for c in str(replica_name))[:32]
+        base = f"{SHM_PREFIX}{os.getpid()}_{next(_SEQ)}_{safe}"
+        size = slots * slot_bytes
+        p2c = shared_memory.SharedMemory(name=base + "_p2c",
+                                         create=True, size=size)
+        c2p = shared_memory.SharedMemory(name=base + "_c2p",
+                                         create=True, size=size)
+        return cls(
+            str(replica_name),
+            tx=ShmRing(p2c, slots, slot_bytes, writer=True),
+            rx=ShmRing(c2p, slots, slot_bytes, writer=False),
+            min_bytes=min_bytes,
+            names=(base + "_p2c", base + "_c2p"), owner=True)
+
+    @classmethod
+    def attach(cls, spec: dict) -> "ShmTransport":
+        """Child-side mapping from :meth:`child_spec`'s dict."""
+        from multiprocessing import shared_memory
+
+        # the attach registers with the (shared) resource tracker; the
+        # OWNER unregisters at unlink — see _untrack for why the child
+        # must not
+        p2c = shared_memory.SharedMemory(name=spec["p2c"])
+        c2p = shared_memory.SharedMemory(name=spec["c2p"])
+        slots, slot_bytes = int(spec["slots"]), int(spec["slot_bytes"])
+        return cls(
+            str(spec.get("label", "child")),
+            tx=ShmRing(c2p, slots, slot_bytes, writer=True),
+            rx=ShmRing(p2c, slots, slot_bytes, writer=False),
+            min_bytes=int(spec["min_bytes"]),
+            names=(spec["p2c"], spec["c2p"]), owner=False)
+
+    def child_spec(self) -> dict:
+        """The attach recipe that rides the spawn args."""
+        return {"p2c": self._names[0], "c2p": self._names[1],
+                "slots": self.tx.slots, "slot_bytes": self.tx.slot_bytes,
+                "min_bytes": self.min_bytes, "label": self.label}
+
+    # -- data path -----------------------------------------------------
+
+    def encode(self, obj) -> Tuple[object, List[int]]:
+        out, claimed, fallbacks = _encode(obj, self.tx, self.min_bytes)
+        if claimed:
+            _SENDS.inc(len(claimed), replica=self.label)
+        for reason, n in fallbacks.items():
+            _FALLBACKS.inc(n, replica=self.label, reason=reason)
+        return out, claimed
+
+    def decode(self, obj):
+        # two-phase: validate every header FIRST (no views created),
+        # so a malformed payload fails before any slot has a
+        # finalizer and recover() can safely ack them all
+        for ref in scan_refs(obj):
+            self.rx.validate(ref)
+        return _decode(obj, self.rx, self._pending_free.append)
+
+    def unclaim(self, slots: List[int]) -> None:
+        """Return locally-claimed slots after a failed pipe send (the
+        header never left, so the peer will never ack them)."""
+        self.tx.release(slots)
+
+    def release(self, slots: Iterable[int]) -> None:
+        """Peer ack arrived: the slots we wrote are free again."""
+        self.tx.release(slots)
+
+    def recover(self, payload) -> None:
+        """A payload was rejected (validation failed, so no view owns
+        any of its slots): queue every referenced slot for the ack
+        turnaround — the request is lost (its future gets the error)
+        but the ring capacity must not be (an unacked slot is gone
+        for the replica's lifetime, and the resulting \"ring\"
+        fallbacks would point operators at the wrong knob)."""
+        for ref in scan_refs(payload):
+            self._pending_free.append(ref.slot)
+
+    def drain_acks(self) -> List[int]:
+        """Slots whose received views have been garbage-collected
+        since the last call — the caller ships them to the peer as a
+        ``shmfree`` message. Safe against concurrent appends (GC can
+        fire mid-drain; a missed slot rides the next turnaround)."""
+        out: List[int] = []
+        while True:
+            try:
+                out.append(self._pending_free.popleft())
+            except IndexError:
+                return out
+
+    def stats(self) -> dict:
+        return {"sends": self.tx.sends, "fallbacks": self.tx.fallbacks,
+                "fallback_reasons": dict(self.tx.fallback_reasons),
+                "free_slots": self.tx.free_slots(),
+                "slot_bytes": self.tx.slot_bytes,
+                "slots": self.tx.slots}
+
+    # -- lifecycle -----------------------------------------------------
+
+    def unlink(self) -> None:
+        """Remove both ``/dev/shm`` names (parent side, right after
+        the child's attach is confirmed). Existing mappings — both
+        processes' rings and every outstanding zero-copy view — stay
+        valid; the memory is freed when the last mapping dies.
+        Idempotent."""
+        if self._unlinked:
+            return
+        self._unlinked = True
+        for ring in (self.tx, self.rx):
+            try:
+                # unlink also unregisters from the resource tracker
+                ring._shm.unlink()
+            except FileNotFoundError:
+                # someone else removed the name; drop the now-stale
+                # tracker registration ourselves
+                _untrack(ring._shm)
+            except Exception:  # noqa: BLE001 — cleanup must not raise
+                pass
+
+    def untrack_local(self) -> None:
+        """Drop THIS process's resource-tracker registrations for both
+        segments. Only for an attacher that does NOT share the owner's
+        tracker process (a standalone subprocess — mp-spawn children
+        share the parent's tracker and must not call this): without
+        it, the attacher's tracker would try to unlink the owner's
+        names at its exit and log spurious warnings."""
+        for ring in (self.tx, self.rx):
+            _untrack(ring._shm)
+
+    def destroy(self) -> None:
+        """Unlink (if the boot window never got there) and drop the
+        mappings where no live view pins them. Idempotent; called from
+        replica shutdown, the dead-child reader path, and the atexit
+        sweep."""
+        self.unlink()
+        for ring in (self.tx, self.rx):
+            try:
+                ring._shm.close()
+            except BufferError:
+                # an outstanding zero-copy view still references the
+                # mapping; it dies with the view (or the process)
+                pass
+            except Exception:  # noqa: BLE001 — cleanup must not raise
+                pass
+
+
+def _atexit_sweep() -> None:  # pragma: no cover - interpreter exit
+    for t in list(_LIVE):
+        t.destroy()
+
+
+atexit.register(_atexit_sweep)
+
+
+def shm_entries() -> List[str]:
+    """Live ``/dev/shm`` entries with this module's prefix (leak
+    detection in tests and the fleet smoke)."""
+    try:
+        return sorted(n for n in os.listdir("/dev/shm")
+                      if n.startswith(SHM_PREFIX))
+    except OSError:
+        return []
+
+
+__all__ = ["SHM_PREFIX", "ShmRef", "ShmRing", "ShmTransport",
+           "shm_entries"]

@@ -57,6 +57,12 @@ default ``IOError_``), ``message``, and the firing rule —
             containing that request fail, which is exactly what the
             serve bisection needs to converge on the poison).
 
+A spec may carry ``stall_s`` *instead of* ``error``: a fired stall
+sleeps that many seconds at the site and then lets the hit proceed —
+the straggler injector (a slow replica is a failure mode no exception
+models) the fleet hedging chaos leg replays. Stalls appear in
+``fired()`` with error name ``"stall"``.
+
 Activation: ``with fault_plan(plan): ...`` (tests), or the
 ``SKYLARK_FAULT_PLAN`` environment variable holding the JSON itself or
 a path to it (chaos CI). A context plan shadows the env plan. Every
@@ -73,6 +79,7 @@ import json
 import os
 import random
 import threading
+import time
 from typing import Iterable, Optional
 
 from libskylark_tpu.base import env as _env
@@ -81,7 +88,7 @@ from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.telemetry import metrics as _metrics
 
 _VALID_KEYS = {"site", "error", "message", "on_hit", "every", "prob",
-               "after", "times", "tag"}
+               "after", "times", "tag", "stall_s"}
 
 # Unified-registry adapter (docs/observability): fired injections are
 # chaos-run events — always counted (a fire raises an exception; the
@@ -107,7 +114,7 @@ class FaultSpec:
     """One compiled plan entry; owns its hit counter and RNG stream."""
 
     __slots__ = ("site", "error_name", "error_cls", "message", "on_hit",
-                 "every", "prob", "after", "times", "tag",
+                 "every", "prob", "after", "times", "tag", "stall_s",
                  "hits", "fires", "_rng")
 
     def __init__(self, doc: dict, seed: int, index: int):
@@ -118,9 +125,23 @@ class FaultSpec:
         if "site" not in doc:
             raise errors.InvalidParametersError(
                 f"fault spec missing 'site': {doc!r}")
+        if "stall_s" in doc and "error" in doc:
+            raise errors.InvalidParametersError(
+                "a fault spec is a stall or an error, not both: "
+                f"{doc!r}")
         self.site = str(doc["site"])
-        self.error_name = str(doc.get("error", "IOError_"))
-        self.error_cls = _resolve_error(self.error_name)
+        # a stall spec delays the hit instead of raising: the straggler
+        # injector the fleet hedging leg replays (a slow replica is a
+        # failure mode no error class models)
+        self.stall_s = (float(doc["stall_s"]) if "stall_s" in doc
+                        else None)
+        if self.stall_s is not None and self.stall_s < 0:
+            raise errors.InvalidParametersError(
+                f"fault spec stall_s must be >= 0, got {self.stall_s}")
+        self.error_name = ("stall" if self.stall_s is not None
+                           else str(doc.get("error", "IOError_")))
+        self.error_cls = (None if self.stall_s is not None
+                          else _resolve_error(self.error_name))
         self.message = doc.get("message")
         self.on_hit = int(doc["on_hit"]) if "on_hit" in doc else None
         self.every = int(doc["every"]) if "every" in doc else None
@@ -186,6 +207,7 @@ class FaultPlan:
     def check(self, site: str, tags: frozenset, detail: str) -> None:
         if site not in self._sites:
             return
+        hit_spec = None
         with self._lock:
             for spec in self.specs:
                 if spec.site != site:
@@ -193,14 +215,23 @@ class FaultPlan:
                 if spec.decide(tags):
                     self.fired.append((site, spec.hits, spec.error_name))
                     _FIRED.inc_always(site=site, error=spec.error_name)
-                    err = spec.error_cls(
-                        spec.message
-                        or f"injected fault at {site} (hit {spec.hits})")
-                    if isinstance(err, errors.SkylarkError):
-                        err.append_trace(
-                            f"fault-injected: site={site} hit={spec.hits}"
-                            + (f" detail={detail}" if detail else ""))
-                    raise err
+                    hit_spec, hit_n = spec, spec.hits
+                    break
+        if hit_spec is None:
+            return
+        if hit_spec.stall_s is not None:
+            # stall OUTSIDE the plan lock: a sleeping site must not
+            # serialize every other site's checks behind it
+            time.sleep(hit_spec.stall_s)
+            return
+        err = hit_spec.error_cls(
+            hit_spec.message
+            or f"injected fault at {site} (hit {hit_n})")
+        if isinstance(err, errors.SkylarkError):
+            err.append_trace(
+                f"fault-injected: site={site} hit={hit_n}"
+                + (f" detail={detail}" if detail else ""))
+        raise err
 
     def reset(self) -> None:
         """Zero every counter, RNG stream, and the fired log — the next

@@ -104,6 +104,37 @@ class Metric:
             self._values.clear()
 
 
+class LifetimeCounter:
+    """Process-lifetime event totals that survive their owning object.
+
+    Collectors report *live* objects only (routers, autoscalers live
+    in WeakSets), so a snapshot taken after an episode's object is
+    gone would silently drop its events; subsystems keep one of these
+    at module level and fold :meth:`snapshot` into their collector
+    block. Always on (the counted event dwarfs the bump), never
+    reset."""
+
+    __slots__ = ("_lock", "_values")
+
+    def __init__(self, site: str, kinds: Sequence[str] = ()):
+        self._lock = _locks.make_lock(site)
+        # pre-seeded kinds always appear in the snapshot, zero or not
+        # — consumers (benchmark records) key off their presence
+        self._values: Dict[str, int] = {k: 0 for k in kinds}
+
+    def inc(self, kind: str, n: int = 1) -> None:
+        with self._lock:
+            self._values[kind] = self._values.get(kind, 0) + n
+
+    def get(self, kind: str) -> int:
+        with self._lock:
+            return self._values.get(kind, 0)
+
+    def snapshot(self, prefix: str = "lifetime_") -> Dict[str, int]:
+        with self._lock:
+            return {prefix + k: v for k, v in sorted(self._values.items())}
+
+
 class Counter(Metric):
     """Monotonically increasing count. ``inc()`` is the only mutator."""
 
@@ -330,7 +361,8 @@ def snapshot() -> dict:
 
 
 __all__ = [
-    "Counter", "DEFAULT_BUCKETS", "Gauge", "Histogram", "Metric",
-    "MetricsRegistry", "counter", "enabled", "gauge", "histogram",
-    "register_collector", "registry", "set_enabled", "snapshot",
+    "Counter", "DEFAULT_BUCKETS", "Gauge", "Histogram",
+    "LifetimeCounter", "Metric", "MetricsRegistry", "counter",
+    "enabled", "gauge", "histogram", "register_collector", "registry",
+    "set_enabled", "snapshot",
 ]

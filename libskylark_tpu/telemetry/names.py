@@ -50,6 +50,16 @@ METRICS: Dict[str, str] = {
     "fleet.affinity_hit": "counter",
     "fleet.failover": "counter",
     "fleet.spilled": "counter",
+    "fleet.hedged": "counter",
+    "fleet.hedge_wins": "counter",
+    "fleet.hedge_mismatches": "counter",
+    # fleet shared-memory transport (fleet/shm.py)
+    "fleet.shm_sends": "counter",
+    "fleet.shm_fallbacks": "counter",
+    # fleet autoscaler (fleet/autoscale.py)
+    "fleet.autoscale_up": "counter",
+    "fleet.autoscale_down": "counter",
+    "fleet.replicas": "gauge",
 }
 
 __all__ = ["METRICS"]

@@ -1,0 +1,238 @@
+"""Queue-depth autoscaler (libskylark_tpu/fleet/autoscale.py) and the
+elastic ReplicaPool membership underneath it.
+
+Oracles:
+
+- *scale-up under load*: a sustained queue storm grows the pool (and
+  the subscribed router's ring — push, via the health hub's SERVING
+  publish) without a single client-visible failure or extra compile;
+- *scale-down at idle*: sustained idleness drains a replica away via
+  the r11 preemption path (DRAINING published before the queue
+  empties, final drain hooks fired, futures resolved) back to the
+  floor;
+- *hysteresis*: bounds are hard (never below ``min_replicas``, never
+  above ``max_replicas``) and the cooldown forbids back-to-back
+  events no matter how loud the signal.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from libskylark_tpu import Context, engine, fleet
+from libskylark_tpu import sketch as sk
+
+
+@pytest.fixture()
+def fresh_engine():
+    engine.reset()
+    yield
+    engine.reset()
+
+
+def _workload(n_reqs=32, n=40, s_dim=16, seed=0):
+    rng = np.random.default_rng(seed)
+    ctx = Context(seed=seed)
+    T = sk.CWT(n, s_dim, ctx)
+    ops = [rng.standard_normal((n, 3 + i % 4)).astype(np.float32)
+           for i in range(n_reqs)]
+    refs = [np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+            for A in ops]
+    return T, ops, refs
+
+
+def _wait(pred, timeout=15.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+class TestPoolMembership:
+    def test_add_replica_joins_router_ring(self, fresh_engine):
+        pool = fleet.ReplicaPool(1, max_batch=4, linger_us=1000)
+        router = fleet.Router(pool)
+        try:
+            assert router.routable() == ["r0"]
+            name = pool.add_replica()
+            assert name == "r1"
+            assert sorted(pool.names()) == ["r0", "r1"]
+            # the SERVING publish reached the subscribed router
+            assert _wait(lambda: name in router.routable(), 5.0)
+            # the grown fleet serves
+            T, ops, refs = _workload(4)
+            outs = [router.submit_sketch(T, A).result(timeout=60)
+                    for A in ops]
+            for got, want in zip(outs, refs):
+                assert np.array_equal(np.asarray(got), want)
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_remove_replica_drains_and_fires_hooks(self, fresh_engine):
+        pool = fleet.ReplicaPool(2, max_batch=4, linger_us=1000)
+        router = fleet.Router(pool)
+        hooks = []
+        pool.on_replica_drain("r1", lambda: hooks.append("r1"))
+        try:
+            drained = pool.remove_replica("r1")
+            assert drained
+            assert hooks == ["r1"]
+            assert pool.names() == ["r0"]
+            assert "r1" not in router.routable()
+            with pytest.raises(KeyError):
+                pool.remove_replica("r1")
+            # the survivor still serves
+            T, ops, refs = _workload(2)
+            out = router.submit_sketch(T, ops[0]).result(timeout=60)
+            assert np.array_equal(np.asarray(out), refs[0])
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_duplicate_add_rejected(self, fresh_engine):
+        pool = fleet.ReplicaPool(1, max_batch=4, linger_us=1000)
+        try:
+            with pytest.raises(ValueError):
+                pool.add_replica("r0")
+        finally:
+            pool.shutdown()
+
+    def test_backend_auto_resolves_by_core_count(self, monkeypatch):
+        monkeypatch.setattr("os.cpu_count", lambda: 2)
+        assert fleet.resolve_backend("auto") == "thread"
+        monkeypatch.setattr("os.cpu_count", lambda: 8)
+        assert fleet.resolve_backend("auto") == "process"
+        monkeypatch.setenv("SKYLARK_FLEET_BACKEND", "thread")
+        assert fleet.resolve_backend(None) == "thread"
+
+
+class TestAutoscaler:
+    def test_storm_scales_up_idle_scales_down(self, fresh_engine):
+        from libskylark_tpu.resilience import faults
+
+        T, ops, refs = _workload(24)
+        pool = fleet.ReplicaPool(1, max_batch=8, linger_us=2000)
+        router = fleet.Router(pool)
+        scaler = fleet.Autoscaler(
+            pool, router, min_replicas=1, max_replicas=2, up_depth=2,
+            down_depth=1, up_ticks=1, down_ticks=3, cooldown_s=0.2,
+            interval_s=0.05)
+        try:
+            # warm the class's whole capacity ladder so the storm
+            # (and the grown replica) is provably compile-free
+            for cap in (1, 2, 4, 8):
+                futs = [router.submit_sketch(T, ops[i])
+                        for i in range(cap)]
+                [f.result(timeout=60) for f in futs]
+            misses0 = engine.stats().misses
+            # throttle every flush by 10 ms so the controller's ticks
+            # deterministically observe the storm's queue depth (a
+            # warm 1-core box otherwise drains it between two ticks)
+            plan = {"seed": 1, "faults": [
+                {"site": "serve.flush", "stall_s": 0.01, "every": 1}]}
+            with faults.fault_plan(plan):
+                futs = [router.submit_sketch(T, A)
+                        for A in ops for _ in range(4)]
+                assert _wait(lambda: len(pool.names()) == 2), \
+                    "queue storm never triggered a scale-up"
+                outs = [f.result(timeout=120) for f in futs]
+            for i, got in enumerate(outs):
+                assert np.array_equal(np.asarray(got), refs[i // 4])
+            # zero compiles: the grown replica shares the warm class
+            assert engine.stats().misses == misses0
+            # idle: back down to the floor via the drain path
+            assert _wait(lambda: len(pool.names()) == 1, 20.0), \
+                "idle fleet never scaled down"
+            st = scaler.stats()
+            assert st["scale_ups"] >= 1 and st["scale_downs"] >= 1
+            assert st["replicas"] == 1
+            # post-shrink traffic still lands
+            out = router.submit_sketch(T, ops[0]).result(timeout=60)
+            assert np.array_equal(np.asarray(out), refs[0])
+        finally:
+            scaler.close()
+            router.close()
+            pool.shutdown()
+
+    def test_bounds_and_cooldown(self, fresh_engine):
+        T, ops, _ = _workload(16)
+        pool = fleet.ReplicaPool(1, max_batch=4, linger_us=2000)
+        router = fleet.Router(pool)
+        # cooldown far longer than the test: at most ONE event may
+        # fire no matter how loud and sustained the signal is
+        scaler = fleet.Autoscaler(
+            pool, router, min_replicas=1, max_replicas=2, up_depth=1,
+            down_depth=0, up_ticks=1, down_ticks=1, cooldown_s=60.0,
+            interval_s=0.05)
+        try:
+            futs = [router.submit_sketch(T, A)
+                    for A in ops for _ in range(4)]
+            assert _wait(lambda: scaler.stats()["scale_ups"] == 1)
+            time.sleep(0.5)
+            st = scaler.stats()
+            assert st["scale_ups"] == 1, "cooldown was ignored"
+            assert len(pool.names()) <= 2
+            [f.result(timeout=120) for f in futs]
+        finally:
+            scaler.close()
+            router.close()
+            pool.shutdown()
+
+    def test_never_below_min(self, fresh_engine):
+        pool = fleet.ReplicaPool(2, max_batch=4, linger_us=1000)
+        scaler = fleet.Autoscaler(
+            pool, None, min_replicas=2, max_replicas=3, up_depth=100,
+            down_depth=5, up_ticks=1, down_ticks=1, cooldown_s=0.0,
+            interval_s=0.02)
+        try:
+            time.sleep(0.5)               # many idle ticks
+            assert len(pool.names()) == 2
+            assert scaler.stats()["scale_downs"] == 0
+        finally:
+            scaler.close()
+            pool.shutdown()
+
+    def test_invalid_bounds_rejected(self, fresh_engine):
+        pool = fleet.ReplicaPool(1, max_batch=4)
+        try:
+            with pytest.raises(ValueError):
+                fleet.Autoscaler(pool, min_replicas=3, max_replicas=2,
+                                 start=False)
+        finally:
+            pool.shutdown()
+
+    def test_env_defaults(self, fresh_engine, monkeypatch):
+        monkeypatch.setenv("SKYLARK_FLEET_AUTOSCALE_MIN", "2")
+        monkeypatch.setenv("SKYLARK_FLEET_AUTOSCALE_MAX", "5")
+        monkeypatch.setenv("SKYLARK_FLEET_AUTOSCALE_UP_DEPTH", "17")
+        monkeypatch.setenv("SKYLARK_FLEET_AUTOSCALE_COOLDOWN", "9.5")
+        pool = fleet.ReplicaPool(2, max_batch=4)
+        scaler = fleet.Autoscaler(pool, start=False)
+        try:
+            assert scaler.min_replicas == 2
+            assert scaler.max_replicas == 5
+            assert scaler.up_depth == 17
+            assert scaler.cooldown_s == 9.5
+        finally:
+            scaler.close()
+            pool.shutdown()
+
+    def test_collector_rollup(self, fresh_engine):
+        pool = fleet.ReplicaPool(1, max_batch=4)
+        scaler = fleet.Autoscaler(pool, start=False, min_replicas=1,
+                                  max_replicas=2)
+        try:
+            agg = fleet.fleet_stats()["autoscale"]
+            assert agg["scalers"] >= 1
+            assert "scale_ups" in agg and "scale_downs" in agg
+        finally:
+            scaler.close()
+            pool.shutdown()

@@ -1,0 +1,261 @@
+"""Hedged requests (fleet/router.py) and the stall fault that drives
+their chaos leg (resilience/faults.py ``stall_s``).
+
+Oracles:
+
+- *straggler rescue*: a primary stalled by an injected fault resolves
+  through the mirror in ~hedge-delay time, bit-equal to the oracle,
+  with ``hedged``/``hedge_wins`` counted;
+- *determinism guard*: in verify mode BOTH attempts complete and must
+  compare bit-equal (``hedge_mismatches`` stays 0 — the endpoints are
+  pure functions of their operands);
+- *no false hedges*: a healthy fleet under a delay far above its p99
+  never mirrors anything;
+- *stall faults*: fire deterministically (same seed, same sequence),
+  sleep instead of raising, and reject nonsensical specs.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future
+from concurrent.futures import wait as cf_wait
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from libskylark_tpu import Context, engine, fleet
+from libskylark_tpu import sketch as sk
+from libskylark_tpu.base.errors import InvalidParametersError
+from libskylark_tpu.fleet.replica import _resolve
+from libskylark_tpu.resilience import faults
+
+
+@pytest.fixture()
+def fresh_engine():
+    engine.reset()
+    yield
+    engine.reset()
+
+
+def _workload(n_reqs=8, n=40, s_dim=16, seed=0):
+    rng = np.random.default_rng(seed)
+    ctx = Context(seed=seed)
+    T = sk.CWT(n, s_dim, ctx)
+    ops = [rng.standard_normal((n, 3 + i % 4)).astype(np.float32)
+           for i in range(n_reqs)]
+    refs = [np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+            for A in ops]
+    return T, ops, refs
+
+
+def _warm_all(pool, T, A):
+    """Warm EVERY replica for the class — a hedge target must answer
+    from a warm cache for the race to be about queueing, not
+    compiles (thread replicas share one cache; this is one submit
+    each to also warm each executor's flush path)."""
+    for name in pool.names():
+        pool.get(name).submit("sketch_apply", transform=T, A=A,
+                              dimension=None).result(timeout=60)
+
+
+STALL_PLAN = {"seed": 3, "faults": [
+    {"site": "serve.flush", "stall_s": 0.5, "tag": "hedge-stall"}]}
+
+
+class TestStallFault:
+    def test_stall_sleeps_instead_of_raising(self):
+        plan = {"seed": 1, "faults": [
+            {"site": "serve.flush", "stall_s": 0.15, "times": 1}]}
+        with faults.fault_plan(plan):
+            t0 = time.monotonic()
+            faults.check("serve.flush")        # fires: sleeps, no raise
+            took = time.monotonic() - t0
+            assert took >= 0.14
+            t1 = time.monotonic()
+            faults.check("serve.flush")        # exhausted: no-op
+            assert time.monotonic() - t1 < 0.1
+            assert faults.fired() == [("serve.flush", 1, "stall")]
+
+    def test_stall_replays_deterministically(self):
+        plan = {"seed": 5, "faults": [
+            {"site": "fleet.route", "stall_s": 0.0, "every": 3}]}
+        seqs = []
+        for _ in range(2):
+            with faults.fault_plan(plan):
+                for _ in range(9):
+                    faults.check("fleet.route")
+                seqs.append(faults.fired())
+        assert seqs[0] == seqs[1]
+        assert len(seqs[0]) == 3
+
+    def test_stall_and_error_mutually_exclusive(self):
+        with pytest.raises(InvalidParametersError):
+            faults.FaultPlan({"faults": [
+                {"site": "x", "stall_s": 0.1, "error": "IOError_"}]})
+
+    def test_negative_stall_rejected(self):
+        with pytest.raises(InvalidParametersError):
+            faults.FaultPlan({"faults": [{"site": "x", "stall_s": -1}]})
+
+
+class TestHedging:
+    def test_stalled_primary_rescued_by_mirror(self, fresh_engine):
+        T, ops, refs = _workload()
+        pool = fleet.ReplicaPool(2, max_batch=8, linger_us=1000)
+        router = fleet.Router(pool, hedge=True, hedge_delay_ms=60,
+                              hedge_verify=True)
+        try:
+            _warm_all(pool, T, ops[0])
+            with faults.fault_plan(STALL_PLAN):
+                with faults.tag("hedge-stall"):
+                    t0 = time.monotonic()
+                    fut = router.submit_sketch(T, ops[0])
+                out = fut.result(timeout=60)
+                took = time.monotonic() - t0
+                assert faults.fired() == [("serve.flush", 1, "stall")]
+            assert np.array_equal(np.asarray(out), refs[0])
+            # the mirror answered while the primary slept
+            assert took < 0.45
+            # verify mode lets the loser finish; wait for it, then
+            # check the determinism guard saw two equal results
+            time.sleep(0.8)
+            st = router.stats()
+            assert st["hedged"] == 1
+            assert st["hedge_wins"] == 1
+            assert st["hedge_mismatches"] == 0
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_healthy_fleet_never_hedges(self, fresh_engine):
+        T, ops, refs = _workload()
+        pool = fleet.ReplicaPool(2, max_batch=8, linger_us=1000)
+        router = fleet.Router(pool, hedge=True, hedge_delay_ms=2000)
+        try:
+            _warm_all(pool, T, ops[0])
+            futs = [router.submit_sketch(T, A) for A in ops]
+            outs = [f.result(timeout=60) for f in futs]
+            for got, want in zip(outs, refs):
+                assert np.array_equal(np.asarray(got), want)
+            st = router.stats()
+            assert st["hedged"] == 0
+            assert st["hedge_wins"] == 0
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_hedge_futures_never_orphan(self, fresh_engine):
+        """Both attempts resolve (winner settles the client; the loser
+        is cancelled or completes) — nothing dangles."""
+        T, ops, refs = _workload()
+        pool = fleet.ReplicaPool(2, max_batch=8, linger_us=1000)
+        router = fleet.Router(pool, hedge=True, hedge_delay_ms=40)
+        try:
+            _warm_all(pool, T, ops[0])
+            with faults.fault_plan(STALL_PLAN):
+                with faults.tag("hedge-stall"):
+                    fut = router.submit_sketch(T, ops[0])
+                assert np.array_equal(
+                    np.asarray(fut.result(timeout=60)), refs[0])
+            time.sleep(0.8)               # loser's stall elapses
+            # every executor quiesces: no stuck cohort, no orphan
+            for name in pool.names():
+                assert pool.get(name).queue_depth() == 0
+            st = router.stats()
+            assert st["hedged"] == 1
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_single_replica_hedge_is_noop(self, fresh_engine):
+        """No second preference member: the watchdog finds no target
+        and the primary simply wins late."""
+        T, ops, refs = _workload()
+        pool = fleet.ReplicaPool(1, max_batch=8, linger_us=1000)
+        router = fleet.Router(pool, hedge=True, hedge_delay_ms=20)
+        try:
+            _warm_all(pool, T, ops[0])
+            with faults.fault_plan(STALL_PLAN):
+                with faults.tag("hedge-stall"):
+                    fut = router.submit_sketch(T, ops[0])
+                out = fut.result(timeout=60)
+            assert np.array_equal(np.asarray(out), refs[0])
+            assert router.stats()["hedged"] == 0
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_delay_fixed_and_p99_derived(self, fresh_engine):
+        pool = fleet.ReplicaPool(2, max_batch=4, linger_us=1000)
+        fixed = fleet.Router(pool, hedge=True, hedge_delay_ms=123.0)
+        derived = fleet.Router(pool, hedge=True)
+        try:
+            assert fixed._hedge_delay_s() == pytest.approx(0.123)
+            # p99-derived from the router's own observed latencies
+            # (the r10 histogram quantity)
+            derived._latency.extend([0.010] * 50 + [0.200] * 50)
+            derived._hedge_delay_cache = (0.0, 0.0)   # force refresh
+            d = derived._hedge_delay_s()
+            assert d == pytest.approx(0.200, rel=0.05)
+            # cold router: seeded from replica latency histograms
+            cold = fleet.Router(pool, hedge=True)
+            cold._hedge_delay_cache = (0.0, 0.0)
+            assert cold._hedge_delay_s() > 0.0
+            cold.close()
+        finally:
+            fixed.close()
+            derived.close()
+            pool.shutdown()
+
+    def test_env_flag_enables_hedging(self, fresh_engine, monkeypatch):
+        monkeypatch.setenv("SKYLARK_FLEET_HEDGE", "1")
+        monkeypatch.setenv("SKYLARK_FLEET_HEDGE_DELAY_MS", "77")
+        pool = fleet.ReplicaPool(2, max_batch=4)
+        router = fleet.Router(pool)
+        try:
+            assert router._hedge_on
+            assert router._hedge_delay_s() == pytest.approx(0.077)
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_resolve_tolerates_cancelled_future(self):
+        fut = Future()
+        fut.cancel()
+        _resolve(fut, result=1)           # must not raise
+        _resolve(fut, exception=RuntimeError("x"))
+        fut2 = Future()
+        _resolve(fut2, result=41)
+        _resolve(fut2, result=42)         # second settle ignored
+        assert fut2.result(timeout=1) == 41
+
+    def test_hedged_storm_all_resolve(self, fresh_engine):
+        """A storm where several primaries stall: every client future
+        resolves bit-equal (cf_wait guards against orphans)."""
+        T, ops, refs = _workload(8)
+        pool = fleet.ReplicaPool(2, max_batch=8, linger_us=1000)
+        router = fleet.Router(pool, hedge=True, hedge_delay_ms=50)
+        plan = {"seed": 9, "faults": [
+            {"site": "serve.flush", "stall_s": 0.4, "tag": "h",
+             "times": 2}]}
+        try:
+            _warm_all(pool, T, ops[0])
+            with faults.fault_plan(plan):
+                futs = []
+                for i, A in enumerate(ops):
+                    if i % 3 == 0:
+                        with faults.tag("h"):
+                            futs.append(router.submit_sketch(T, A))
+                    else:
+                        futs.append(router.submit_sketch(T, A))
+                done, pending = cf_wait(futs, timeout=120)
+                assert not pending, "orphaned client futures"
+            for f, want in zip(futs, refs):
+                assert np.array_equal(np.asarray(f.result()), want)
+            assert router.stats()["hedge_mismatches"] == 0
+        finally:
+            router.close()
+            pool.shutdown()

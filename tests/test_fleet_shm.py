@@ -1,0 +1,380 @@
+"""Shared-memory replica transport (libskylark_tpu/fleet/shm.py).
+
+Oracles:
+
+- *codec exactness*: whatever rides a ring slot decodes bit-equal,
+  zero-copy (the decoded view maps the segment, not a copy), and the
+  pickle fallback (small/oversize/exhausted-ring payloads) carries
+  the identical object — transport choice can never change a result;
+- *slot lifecycle*: a decoded view's garbage collection releases its
+  slot back to the writer (the ack turnaround), exhaustion degrades
+  to the pipe instead of blocking, and the fallback counters tell
+  the truth;
+- *segment lifecycle* (the no-leak contract): ``/dev/shm`` names
+  exist only during replica boot — the owner unlinks as soon as the
+  peer's attach is proven — so a clean drain, a mid-flight SIGTERM,
+  and a ``kill -9``'d child all end with zero leaked entries. The
+  module-scoped autouse fixture enforces it after every test.
+
+The slow tier runs the whole path through a real jax-hosting
+``ProcessReplica``: SHM results bit-equal the pickle-transport and
+in-process oracles, and SIGTERM / ``kill -9`` mid-flight leak
+nothing.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from libskylark_tpu.fleet.shm import (SHM_PREFIX, ShmRef, ShmTransport,
+                                      shm_entries)
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir("/dev/shm"),
+    reason="POSIX shared memory filesystem not available")
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_segments():
+    """Every test must end with zero live skylark segments — the
+    satellite acceptance criterion, enforced at the finest grain."""
+    yield
+    gc.collect()
+    assert shm_entries() == [], (
+        f"leaked /dev/shm entries: {shm_entries()}")
+
+
+def _transport(**kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("slot_bytes", 1 << 16)
+    kw.setdefault("min_bytes", 64)
+    return ShmTransport.create("t", **kw)
+
+
+def _pair(**kw):
+    t = _transport(**kw)
+    peer = ShmTransport.attach(t.child_spec())
+    return t, peer
+
+
+class TestRingCodec:
+    def test_roundtrip_bit_equal_and_zero_copy(self):
+        t, peer = _pair()
+        try:
+            A = np.arange(6000, dtype=np.float32).reshape(60, 100)
+            enc, claimed = t.encode({"A": A, "x": 7})
+            assert isinstance(enc["A"], ShmRef)
+            assert enc["x"] == 7 and len(claimed) == 1
+            dec = peer.decode(enc)
+            assert np.array_equal(dec["A"], A)
+            assert str(dec["A"].dtype) == "float32"
+            # zero-copy: the view's buffer is the mapped segment, and
+            # it is read-only (a receiver must not scribble on a slot
+            # the writer still owns)
+            assert not dec["A"].flags.owndata
+            assert not dec["A"].flags.writeable
+        finally:
+            t.destroy()
+            peer.destroy()
+
+    def test_small_arrays_stay_inline(self):
+        t, peer = _pair(min_bytes=1 << 20)
+        try:
+            A = np.ones((8, 8), np.float32)
+            enc, claimed = t.encode({"A": A})
+            assert isinstance(enc["A"], np.ndarray)
+            assert claimed == []
+            assert np.array_equal(peer.decode(enc)["A"], A)
+        finally:
+            t.destroy()
+            peer.destroy()
+
+    def test_oversize_object_structured_fall_back_by_reason(self):
+        t, peer = _pair(slot_bytes=1 << 10, min_bytes=8)
+        try:
+            big = np.zeros(4096, np.float64)       # > slot_bytes
+            obj = np.array([object()] * 8, dtype=object)
+            rec = np.zeros(64, dtype=[("a", "<f4"), ("b", "<i4")])
+            enc, claimed = t.encode({"big": big, "obj": obj,
+                                     "rec": rec})
+            assert isinstance(enc["big"], np.ndarray)
+            assert isinstance(enc["obj"], np.ndarray)
+            # structured dtypes must NOT ride: their str() headers do
+            # not round-trip through np.dtype on the receiver — the
+            # pickle path serves them instead (transport choice never
+            # changes a result)
+            assert isinstance(enc["rec"], np.ndarray)
+            assert claimed == []
+            assert t.tx.fallback_reasons["oversize"] == 1
+            assert t.tx.fallback_reasons["dtype"] == 2
+            dec = peer.decode(enc)
+            assert np.array_equal(dec["rec"], rec)
+        finally:
+            t.destroy()
+            peer.destroy()
+
+    def test_torn_header_rejected_and_slots_recovered(self):
+        t, peer = _pair()
+        try:
+            A = np.arange(1024, dtype=np.float32)
+            enc, claimed = t.encode({"A": A})
+            assert len(claimed) == 1
+            # corrupt the header: decode must fail BEFORE any view
+            # exists, and recover() must return the slot
+            enc["A"].dtype = "not-a-dtype"
+            with pytest.raises(Exception):
+                peer.decode(enc)
+            peer.recover(enc)
+            t.release(peer.drain_acks())
+            assert t.tx.free_slots() == t.tx.slots
+        finally:
+            t.destroy()
+            peer.destroy()
+
+    def test_decoded_arrays_uniformly_read_only(self):
+        """SHM views AND pickle-fallback arrays decode read-only — a
+        load-dependent writable/read-only flip would be a
+        client-visible heisenbug."""
+        t, peer = _pair()
+        try:
+            big = np.arange(1024, dtype=np.float32)   # rides the ring
+            small = np.arange(4, dtype=np.float32)    # stays inline
+            enc, _ = t.encode({"big": big, "small": small})
+            dec = peer.decode(enc)
+            assert not dec["big"].flags.writeable
+            assert not dec["small"].flags.writeable
+        finally:
+            t.destroy()
+            peer.destroy()
+
+    def test_exhaustion_degrades_then_ack_recovers(self):
+        t, peer = _pair(slots=2)
+        try:
+            arrs = [np.full(128, i, np.float32) for i in range(4)]
+            enc, claimed = t.encode({"a": arrs})
+            kinds = [type(v).__name__ for v in enc["a"]]
+            assert kinds.count("ShmRef") == 2      # ring capacity
+            assert kinds.count("ndarray") == 2     # degraded, not lost
+            assert t.tx.fallbacks == 2
+            dec = peer.decode(enc)
+            for got, want in zip(dec["a"], arrs):
+                assert np.array_equal(got, want)
+            # releasing the views frees the slots for the next send
+            del dec
+            gc.collect()
+            t.release(peer.drain_acks())
+            assert t.tx.free_slots() == 2
+            enc2, claimed2 = t.encode({"b": arrs[0]})
+            assert isinstance(enc2["b"], ShmRef)
+        finally:
+            t.destroy()
+            peer.destroy()
+
+    def test_noncontiguous_source(self):
+        t, peer = _pair()
+        try:
+            base = np.arange(400, dtype=np.float32).reshape(20, 20)
+            view = base[::2, 1::3]                 # strided, non-C
+            enc, _ = t.encode({"v": view})
+            assert isinstance(enc["v"], ShmRef)
+            assert np.array_equal(peer.decode(enc)["v"], view)
+        finally:
+            t.destroy()
+            peer.destroy()
+
+    def test_shm_vs_inline_identical(self):
+        """Transport-choice bit-equality at the codec level: the same
+        payload through the ring and through the inline (pickle-path)
+        representation decodes identically."""
+        t, peer = _pair()
+        try:
+            rng = np.random.default_rng(0)
+            A = rng.standard_normal((50, 70)).astype(np.float32)
+            via_ring, _ = t.encode({"A": A})
+            assert isinstance(via_ring["A"], ShmRef)
+            inline = {"A": A}                      # what pickle carries
+            dec_ring = peer.decode(via_ring)
+            dec_inline = peer.decode(inline)
+            assert np.array_equal(dec_ring["A"], dec_inline["A"])
+            assert dec_ring["A"].tobytes() == dec_inline["A"].tobytes()
+        finally:
+            t.destroy()
+            peer.destroy()
+
+
+class TestSegmentLifecycle:
+    def test_unlink_removes_names_views_stay_valid(self):
+        t, peer = _pair()
+        A = np.arange(256, dtype=np.float32)
+        enc, _ = t.encode({"A": A})
+        dec = peer.decode(enc)
+        assert len(shm_entries()) == 2
+        t.unlink()
+        assert shm_entries() == []
+        # POSIX semantics: the mapping outlives the name
+        assert np.array_equal(dec["A"], A)
+        del dec
+        t.destroy()
+        peer.destroy()
+
+    def test_destroy_idempotent(self):
+        t = _transport()
+        t.destroy()
+        t.destroy()
+        assert shm_entries() == []
+
+    def _attacher(self, spec):
+        """A child process that attaches the segments and sleeps —
+        the boot-window peer for the kill tests (no jax import: the
+        lifecycle is transport-level, not executor-level)."""
+        code = (
+            "import sys, time, json\n"
+            "from libskylark_tpu.fleet.shm import ShmTransport\n"
+            "t = ShmTransport.attach(json.loads(sys.argv[1]))\n"
+            "t.untrack_local()    # standalone process, own tracker\n"
+            "print('attached', flush=True)\n"
+            "time.sleep(60)\n")
+        import json
+
+        env = dict(os.environ)
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code, json.dumps(spec)],
+            stdout=subprocess.PIPE, env=env, text=True)
+        assert proc.stdout.readline().strip() == "attached"
+        return proc
+
+    @pytest.mark.parametrize("sig", [signal.SIGTERM, signal.SIGKILL])
+    def test_killed_attached_child_leaks_nothing(self, sig):
+        """The steady-state story: parent unlinks after attach, so a
+        SIGTERM'd or ``kill -9``'d peer cannot leak a name."""
+        t = _transport()
+        proc = self._attacher(t.child_spec())
+        try:
+            t.unlink()                    # the boot handshake's end
+            assert shm_entries() == []
+            proc.send_signal(sig)
+            proc.wait(timeout=30)
+            assert shm_entries() == []
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            t.destroy()
+
+    def test_child_dead_before_unlink_parent_destroy_cleans(self):
+        """The boot-window story: the peer dies before the unlink
+        handshake — the owner's destroy (shutdown path / dead-child
+        reader path / atexit sweep) removes the names."""
+        t = _transport()
+        proc = self._attacher(t.child_spec())
+        try:
+            proc.kill()
+            proc.wait(timeout=30)
+            assert len(shm_entries()) == 2    # still in boot window
+            t.destroy()
+            assert shm_entries() == []
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+
+@pytest.mark.slow
+class TestProcessReplicaShm:
+    """End to end through a real spawned jax-hosting replica."""
+
+    def _reqs(self, n=6, cols=3000):
+        from libskylark_tpu import Context
+        from libskylark_tpu import sketch as sk
+
+        ctx = Context(seed=0)
+        T = sk.CWT(40, 16, ctx)
+        rng = np.random.default_rng(0)
+        ops = [rng.standard_normal((40, cols - i)).astype(np.float32)
+               for i in range(n)]
+        return T, ops
+
+    def test_shm_bit_equal_to_pickle_and_oracle(self):
+        import jax.numpy as jnp
+
+        from libskylark_tpu import fleet
+        from libskylark_tpu import sketch as sk
+
+        T, ops = self._reqs()
+        refs = [np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+                for A in ops]
+        r_shm = fleet.ProcessReplica("shm0", max_batch=4,
+                                     linger_us=1000, shm=True)
+        try:
+            outs = [r_shm.submit("sketch_apply", transform=T, A=A,
+                                 dimension=None).result(timeout=120)
+                    for A in ops]
+            for got, want in zip(outs, refs):
+                assert np.array_equal(np.asarray(got), want)
+            # the operands are ~470 KB: they must actually have ridden
+            # the ring, both directions
+            assert r_shm.transport_stats()["sends"] >= len(ops)
+            assert (r_shm.boot_info()["shm"] or {}).get("sends", 0) > 0
+        finally:
+            r_shm.shutdown()
+        assert shm_entries() == []
+        r_pkl = fleet.ProcessReplica("pkl0", max_batch=4,
+                                     linger_us=1000, shm=False)
+        try:
+            outs_pkl = [r_pkl.submit("sketch_apply", transform=T, A=A,
+                                     dimension=None).result(timeout=120)
+                        for A in ops]
+            for got, want in zip(outs_pkl, refs):
+                assert np.array_equal(np.asarray(got), want)
+        finally:
+            r_pkl.shutdown()
+
+    def test_sigterm_mid_flight_no_leak(self):
+        from libskylark_tpu import fleet
+
+        T, ops = self._reqs(n=4)
+        r = fleet.ProcessReplica("shmterm", max_batch=8,
+                                 linger_us=200_000, shm=True)
+        try:
+            futs = [r.submit("sketch_apply", transform=T, A=A,
+                             dimension=None) for A in ops]
+            r.preempt()                  # real SIGTERM, queue nonempty
+            deadline = time.monotonic() + 60
+            while r.state() != "STOPPED" and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert r.state() == "STOPPED"
+            # the drain resolves in-flight futures; none may orphan
+            for f in futs:
+                try:
+                    f.result(timeout=60)
+                except Exception:  # noqa: BLE001 — refused is fine
+                    pass
+        finally:
+            r.shutdown()
+        assert shm_entries() == []
+
+    def test_kill_9_child_fails_futures_no_leak(self):
+        from libskylark_tpu import fleet
+        from libskylark_tpu.engine.serve import ServeOverloadedError
+
+        T, ops = self._reqs(n=2)
+        r = fleet.ProcessReplica("shmkill", max_batch=8,
+                                 linger_us=500_000, shm=True)
+        try:
+            futs = [r.submit("sketch_apply", transform=T, A=A,
+                             dimension=None) for A in ops]
+            os.kill(r._proc.pid, signal.SIGKILL)
+            for f in futs:
+                with pytest.raises(ServeOverloadedError):
+                    f.result(timeout=60)
+        finally:
+            r.shutdown()
+        assert shm_entries() == []
