@@ -37,7 +37,14 @@ asserts the resilience subsystem's contract end to end:
   mirror's result (``hedge_wins``), let the stalled loser complete
   (verify mode), and prove the determinism guard: both executions
   bit-equal, zero mismatches, zero orphans, and the identical fired
-  sequence across two same-seed runs.
+  sequence across two same-seed runs;
+- **survivable sessions** (the session leg, docs/sessions): a CWT
+  session streamed through a 2-replica router with the owner
+  preempted mid-stream AND a seeded ``session.append`` fault — the
+  drain handoff resumes on the peer, the same-seq retry absorbs the
+  fault (idempotent replay), finalize is bit-equal to the one-shot
+  sketch, zero client-visible failures, and two same-seed runs replay
+  the identical fired sequence.
 
 Usage: ``python benchmarks/chaos_battery.py --gate`` (script/ci wires
 ``JAX_PLATFORMS=cpu`` and the canned ``SKYLARK_FAULT_PLAN``). Prints
@@ -348,6 +355,110 @@ def _hedge_leg(T, ops, refs, violations):
     }
 
 
+def _session_run(A, ref, plan_doc):
+    """One fixed-seed stateful-session episode (docs/sessions): a CWT
+    session streamed through a 2-replica router, the owner preempted
+    mid-stream (drain handoff), an injected ``session.append`` fault
+    absorbed by a same-seq retry (idempotent replay), finalize
+    compared bit-equal to the one-shot sketch."""
+    import shutil
+    import tempfile
+
+    from libskylark_tpu import fleet
+    from libskylark_tpu.resilience import faults
+
+    prev_dir = os.environ.get("SKYLARK_SESSION_DIR")
+    scratch = tempfile.mkdtemp(prefix="skylark_chaos_sessions_")
+    os.environ["SKYLARK_SESSION_DIR"] = scratch
+    pool = fleet.ReplicaPool(2, max_batch=4)
+    router = fleet.Router(pool)
+    client_failures = 0
+    retries = 0
+    try:
+        with faults.fault_plan(plan_doc) as plan:
+            sid = router.open_sketch_session(
+                "cwt", n=64, s_dim=16, d=8, seed=21, owner="r0")
+            for i in range(4):
+                if i == 2:
+                    # SIGTERM-semantics preemption of the session
+                    # owner mid-stream: checkpoint + peer resume
+                    pool.preempt_replica(router.session_owner(sid))
+                for attempt in range(3):
+                    try:
+                        router.session_append(
+                            sid, A[i * 16:(i + 1) * 16],
+                            seq=i + 1).result(timeout=30.0)
+                        break
+                    except Exception:  # noqa: BLE001 — retry same seq
+                        retries += 1
+                else:
+                    client_failures += 1
+            out = router.session_finalize(sid).result(timeout=30.0)
+            fired = list(plan.fired)
+        stats = router.stats()
+        return {
+            "bits_equal": bool(np.array_equal(out["SX"], ref)),
+            "fired": fired,
+            "retries": retries,
+            "client_visible_failures": client_failures,
+            "session_handoffs": stats["session_handoffs"],
+        }
+    finally:
+        router.close()
+        pool.shutdown()
+        if prev_dir is None:
+            os.environ.pop("SKYLARK_SESSION_DIR", None)
+        else:
+            os.environ["SKYLARK_SESSION_DIR"] = prev_dir
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+def _session_leg(violations):
+    """Sessions under chaos, twice with the same seed: the injected
+    fault sequence and the finalize bits must replay identically, with
+    zero client-visible failures and at least one real handoff."""
+    import jax.numpy as jnp
+
+    from libskylark_tpu import Context
+    from libskylark_tpu import sketch as sk
+
+    A = np.random.default_rng(21).standard_normal(
+        (64, 8)).astype(np.float32)
+    ref = np.asarray(sk.CWT(64, 16, Context(seed=21)).apply(
+        jnp.asarray(A), sk.COLUMNWISE))
+    plan_doc = {"seed": 7, "faults": [
+        {"site": "session.append", "error": "IOError_", "on_hit": 3}]}
+    rec1 = _session_run(A, ref, plan_doc)
+    rec2 = _session_run(A, ref, plan_doc)
+    for run, rec in (("run1", rec1), ("run2", rec2)):
+        if not rec["bits_equal"]:
+            violations.append(
+                f"session leg {run}: finalize not bit-equal to the "
+                "one-shot sketch through drain + injected fault")
+        if rec["client_visible_failures"]:
+            violations.append(
+                f"session leg {run}: "
+                f"{rec['client_visible_failures']} client-visible "
+                "failure(s)")
+        if rec["session_handoffs"] < 1:
+            violations.append(
+                f"session leg {run}: owner preemption produced no "
+                "session handoff")
+    if not rec1["fired"]:
+        violations.append("session leg: plan injected nothing — inert")
+    if rec1["fired"] != rec2["fired"]:
+        violations.append(
+            f"session leg: fired sequences differ across same-seed "
+            f"runs: {rec1['fired']} vs {rec2['fired']}")
+    return {
+        "fired": [list(f) for f in rec1["fired"]],
+        "retries": rec1["retries"],
+        "session_handoffs": rec1["session_handoffs"],
+        "client_visible_failures": rec1["client_visible_failures"],
+        "deterministic": rec1["fired"] == rec2["fired"],
+    }
+
+
 def main() -> int:
     from libskylark_tpu import engine
     from libskylark_tpu.base import errors  # noqa: F401 — class names
@@ -423,6 +534,9 @@ def main() -> int:
     # -- hedge leg: injected stall -> mirrored request ------------------
     hedge_rec = _hedge_leg(T, ops, refs, violations)
 
+    # -- session leg: drain handoff + injected append fault -------------
+    session_rec = _session_leg(violations)
+
     # -- lock-order witness (instrumented-lock mode) --------------------
     # With SKYLARK_LOCK_WITNESS=1 (the CI chaos gate sets it) every
     # lock the storm touched — executor state/stats/pub, engine cache,
@@ -470,6 +584,7 @@ def main() -> int:
         "deterministic": fired1 == fired2,
         "fleet": fleet_rec,
         "hedge": hedge_rec,
+        "sessions": session_rec,
         "lock_witness": witness_rec,
         "violations": violations,
     }

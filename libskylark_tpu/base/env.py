@@ -354,6 +354,36 @@ FLEET_HEDGE_VERIFY = declare(
         "cancelling it) and compare both results bitwise, counting "
         "``fleet.hedge_mismatches`` on divergence (chaos battery).")
 
+# -- stateful serve sessions (libskylark_tpu/sessions) ----------------------
+
+SESSION_DIR = declare(
+    "SKYLARK_SESSION_DIR", default=None, parser=parse_path_or_off,
+    kind="path", propagate=True,
+    doc="Durability root of the stateful serve sessions "
+        "(``libskylark_tpu.sessions``): per-session append journals, "
+        "checkpoints and meta files live here, and a peer replica "
+        "resumes a drained/crashed session from it. Unset: a "
+        "process-stable directory under the system temp dir (single-"
+        "host handoff still works; set it to shared storage for "
+        "cross-host resume). Propagated so process replicas journal "
+        "to the same root as their parent.")
+
+SESSION_TTL = declare(
+    "SKYLARK_SESSION_TTL", default=600.0, parser=parse_float,
+    kind="float",
+    doc="Default idle TTL in seconds for stateful serve sessions: a "
+        "session untouched this long is evicted (journal and "
+        "checkpoint removed; later appends/finalize raise "
+        "``SessionEvictedError``). Per-session ``ttl_s`` overrides.")
+
+SESSION_FSYNC_EVERY = declare(
+    "SKYLARK_SESSION_FSYNC_EVERY", default=8, parser=parse_positive_int,
+    kind="int",
+    doc="Journal fsync batching: every Nth append also fsyncs the "
+        "session journal. Appends always flush to the OS page cache "
+        "(process-crash durable); the fsync cadence bounds what a "
+        "whole-machine crash can lose. 1 = fsync every append.")
+
 FAULT_PLAN = declare(
     "SKYLARK_FAULT_PLAN", default=None, kind="json",
     doc="Deterministic fault-injection plan (inline JSON or a path); "

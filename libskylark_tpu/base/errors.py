@@ -101,6 +101,16 @@ class NotImplementedYetError(SkylarkError):
     code = 112
 
 
+class SessionEvictedError(SkylarkError):
+    """A stateful serve session is gone: TTL-evicted, finalized, or
+    never opened (no registry entry and no journal/checkpoint on disk
+    to resume from). Terminal for the session id — the client must
+    open a new session and re-stream; retrying the append cannot
+    succeed (:mod:`libskylark_tpu.sessions`, docs/sessions)."""
+
+    code = 113
+
+
 _CODE_TABLE = {
     cls.code: cls
     for cls in [
@@ -117,6 +127,7 @@ _CODE_TABLE = {
         MLError,
         IOError_,
         NotImplementedYetError,
+        SessionEvictedError,
     ]
 }
 

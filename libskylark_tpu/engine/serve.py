@@ -48,6 +48,24 @@ submitted / completed / failed / rejected, queued gauge, coalesced
 (requests that shared a flush), flushes, batch-capacity and cohort-size
 histograms, padding-waste ratio, and p50/p99/mean request latency.
 
+Stateful sessions (:mod:`libskylark_tpu.sessions`, docs/sessions): the
+executor also hosts *bucket-lived* sketch sessions —
+:meth:`~MicrobatchExecutor.open_sketch_session` /
+:meth:`~MicrobatchExecutor.session_append` /
+:meth:`~MicrobatchExecutor.session_finalize` — a registry keyed
+alongside the bucket statics (session id → maintained sketch state +
+append journal sequence number). Every accepted append is journaled
+under ``SKYLARK_SESSION_DIR`` *before* its future resolves; a drain
+checkpoints live session state (the r9 drain hook discipline), so a
+peer executor resumes a drained — or ``kill -9``'d — replica's
+sessions from checkpoint + journal tail, bit-equal, with idempotent
+sequence numbers making duplicate replay a no-op. Under DEGRADED
+health, session appends (the best-effort streaming class) shed
+*before* interactive one-shot traffic; expired deadlines and TTL
+evictions resolve append futures to :class:`ServeOverloadedError` /
+:class:`~libskylark_tpu.base.errors.SessionEvictedError` instead of
+hanging.
+
 Resilience (r9, :mod:`libskylark_tpu.resilience`): a failed flush no
 longer fans its exception to the whole cohort — the executor retries
 **bisection-style**, splitting the cohort in half and re-executing each
@@ -498,6 +516,10 @@ class MicrobatchExecutor:
         # without serializing on the executor lock
         self._pub_lock = _locks.make_lock("serve.pub")
         self._published_state = SERVING
+        # stateful sketch sessions (docs/sessions): the registry is
+        # built lazily on the first session verb — one-shot serving
+        # never pays the directory setup
+        self._session_registry = None
 
         import queue as _queue
 
@@ -597,6 +619,121 @@ class MicrobatchExecutor:
                            **kw) -> Future:
         return self.submit("krr_predict", kernel=kernel, X_new=X_new,
                            X_train=X_train, coef=coef, **kw)
+
+    # ------------------------------------------------------------------
+    # stateful sketch sessions (docs/sessions)
+    # ------------------------------------------------------------------
+
+    @property
+    def sessions(self):
+        """This executor's :class:`~libskylark_tpu.sessions.registry
+        .SessionRegistry` (built on first use; every executor in a
+        host shares the ``SKYLARK_SESSION_DIR`` root, which is what
+        makes drain handoff and crash replay possible)."""
+        if self._session_registry is None:
+            from libskylark_tpu.sessions import SessionRegistry
+
+            with self._lock:
+                if self._session_registry is None:
+                    self._session_registry = SessionRegistry(
+                        name=self.name)
+        return self._session_registry
+
+    def open_sketch_session(self, kind: str, *, n: int, s_dim: int,
+                            d: int, seed: int = 0,
+                            dtype: str = "float32", targets: int = 0,
+                            k: int = 0, lam: float = 1e-3,
+                            sigma: float = 1.0,
+                            ttl_s: Optional[float] = None,
+                            session_id: Optional[str] = None) -> str:
+        """Open a stateful sketch session and return its id. ``kind``
+        is one of :data:`libskylark_tpu.sessions.KINDS` (``cwt`` /
+        ``jlt`` / ``srht`` row-batch appenders, ``isvd`` incremental
+        randomized SVD, ``krr`` online KRR); the remaining arguments
+        are the :class:`~libskylark_tpu.sessions.SessionSpec` fields.
+        Refused (like any intake) on a draining/stopped executor."""
+        from libskylark_tpu.sessions import SessionSpec
+
+        with self._lock:
+            self._refuse_if_unavailable_locked()
+        spec = SessionSpec(kind=kind, n=int(n), s_dim=int(s_dim),
+                           d=int(d), seed=int(seed), dtype=str(dtype),
+                           targets=int(targets), k=int(k),
+                           lam=float(lam), sigma=float(sigma),
+                           ttl_s=ttl_s)
+        return self.sessions.open(spec, session_id=session_id)
+
+    def session_append(self, session_id: str, X, Y=None,
+                       seq: Optional[int] = None,
+                       deadline=None) -> Future:
+        """Fold one row batch into a session; the returned future
+        resolves to ``(seq, rows)`` only after the append is journaled
+        (durable) AND folded. Duplicate sequence numbers resolve to
+        the current position as a no-op (crash-retry idempotency).
+        Shedding (all resolved on the future, never raised here):
+        DRAINING refuses; DEGRADED sheds session appends *before*
+        interactive traffic (streaming is the best-effort class — the
+        client owns the journal replay story, an interactive caller
+        does not); an expired ``deadline`` resolves to
+        :class:`ServeOverloadedError` without journaling; an evicted
+        or unknown session resolves to :class:`~libskylark_tpu.base
+        .errors.SessionEvictedError`."""
+        fut: Future = Future()
+        try:
+            with self._lock:
+                self._refuse_if_unavailable_locked()
+            if self._is_degraded():
+                with self._stats_lock:
+                    self._counts["session_shed"] += 1
+                raise ServeOverloadedError(
+                    "executor DEGRADED: session appends shed before "
+                    "interactive traffic")
+            dl = Deadline.coerce(deadline)
+            if dl is not None and dl.expired:
+                with self._stats_lock:
+                    self._counts["expired"] += 1
+                raise ServeOverloadedError(
+                    "session append deadline expired before execution")
+            out = self.sessions.append(
+                session_id, X, Y=Y, seq=seq,
+                tags=faults.current_tags())
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:  # noqa: BLE001 — resolve, don't leak
+            fut.set_exception(e)
+            return fut
+        fut.set_result(out)
+        return fut
+
+    def session_finalize(self, session_id: str) -> Future:
+        """Terminal result of a session (the maintained sketch / the
+        iSVD factors / the KRR coefficients); the session's artifacts
+        are removed and its id tombstoned. Resolves to
+        :class:`~libskylark_tpu.base.errors.SessionEvictedError` for
+        an evicted/unknown id — never hangs."""
+        fut: Future = Future()
+        try:
+            with self._lock:
+                if self._stop:
+                    raise RuntimeError(
+                        "MicrobatchExecutor is shut down")
+            out = self.sessions.finalize(session_id)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+            return fut
+        fut.set_result(out)
+        return fut
+
+    def _checkpoint_sessions(self) -> None:
+        """Drain-path hook: checkpoint every live session synchronously
+        (journal fsync + accumulator snapshot) so a peer resumes from
+        state instead of a full journal replay. No-op when this
+        executor never opened a session."""
+        reg = self._session_registry
+        if reg is not None:
+            reg.checkpoint_all()
 
     # -- per-endpoint packing -----------------------------------------
 
@@ -1617,6 +1754,17 @@ class MicrobatchExecutor:
                     break
                 self._idle_cv.wait(
                     timeout=0.1 if rem == float("inf") else min(rem, 0.1))
+        # live session state is checkpointed HERE — the r9 drain hook
+        # discipline (docs/sessions "Graceful handoff"): journal
+        # fsync'd + accumulator snapshot durable before the executor
+        # stops, so a peer resumes the stream from state. Runs even on
+        # a drain timeout (the journal already holds every accepted
+        # append; the checkpoint just bounds the peer's replay).
+        try:
+            self._checkpoint_sessions()
+        except Exception as e:  # noqa: BLE001 — the drain must finish
+            warnings.warn(f"session checkpoint during drain failed: "
+                          f"{e}", RuntimeWarning, stacklevel=2)
         # on timeout a cohort is wedged in execution — joining the
         # threads would block past the deadline the caller (a SIGTERM
         # grace window) budgeted, starving the checkpoint hooks that
@@ -1647,6 +1795,7 @@ class MicrobatchExecutor:
             "failed": c.get("failed", 0),
             "rejected": c.get("rejected", 0),
             "shed": c.get("shed", 0),
+            "session_shed": c.get("session_shed", 0),
             "expired": c.get("expired", 0),
             "poisoned": c.get("poisoned", 0),
             "flush_failures": c.get("flush_failures", 0),
@@ -1676,6 +1825,12 @@ class MicrobatchExecutor:
                 "mean": (sum(lat) / len(lat)) if lat else None,
                 "n": len(lat),
             },
+            # the stateful-session block (None until the first session
+            # verb; the cross-registry rollup is the "sessions"
+            # telemetry collector)
+            "sessions": (self._session_registry.stats()
+                         if self._session_registry is not None
+                         else None),
         }
 
     def shutdown(self, wait: bool = True) -> None:
@@ -1691,6 +1846,14 @@ class MicrobatchExecutor:
             self._flusher.join()
             for t in self._workers:
                 t.join()
+        # sync the session journals WITHOUT deleting artifacts — a
+        # peer (or a restarted process) resumes them from disk
+        reg = self._session_registry
+        if reg is not None:
+            try:
+                reg.close()
+            except Exception:  # noqa: BLE001 — shutdown must finish
+                pass
 
     def __enter__(self) -> "MicrobatchExecutor":
         return self
@@ -1723,8 +1886,9 @@ def serve_stats() -> dict:
     renderer use (``docs/observability``)."""
     agg: dict = {"executors": 0}
     _SUM_KEYS = ("submitted", "completed", "failed", "rejected", "shed",
-                 "expired", "poisoned", "flush_failures",
-                 "isolation_retries", "queued", "coalesced", "flushes")
+                 "session_shed", "expired", "poisoned",
+                 "flush_failures", "isolation_retries", "queued",
+                 "coalesced", "flushes")
     _MAX_KEYS = ("queued_peak", "isolation_depth_peak")
     sums = collections.Counter({k: 0 for k in _SUM_KEYS})
     maxes = {k: 0 for k in _MAX_KEYS}

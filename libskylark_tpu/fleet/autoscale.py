@@ -225,7 +225,16 @@ class Autoscaler:
         now = time.monotonic()
         if now - self._last_event < self.cooldown_s:
             return
-        if (self._up_run >= self.up_ticks
+        if n < self.min_replicas:
+            # below the floor: a crashed member was reaped (the pool's
+            # unexpected-exit handler) or an external removal shrank
+            # the pool. Replace it NOW via the pack boot — no depth
+            # run-up required; the floor is a capacity promise, not a
+            # load signal. Cooldown still applies (the stamp in
+            # _scale_up), so a persistently failing boot retries at
+            # cooldown cadence, not every tick.
+            self._scale_up(mean, shed)
+        elif (self._up_run >= self.up_ticks
                 and n < self.max_replicas):
             self._scale_up(mean, shed)
         elif (self._down_run >= self.down_ticks

@@ -23,6 +23,18 @@ process replicas get a real SIGTERM. Either way the replica's drain
 hooks fire, its in-flight futures resolve, and the health hub
 announces DRAINING → STOPPED so a subscribed router sheds its traffic
 to peers mid-drain.
+
+Crash reap: a ``ProcessReplica`` child that exits *unexpectedly* (a
+``kill -9``, an OOM, the chaos ``crash`` fault) publishes STOPPED from
+the parent-side reader thread — the pool subscribes to the hub and
+**reaps** the dead member: it leaves the membership immediately (no
+drain hooks — there was no grace window), its name lands in
+:meth:`crashed_names`, and an attached autoscaler's next tick sees the
+pool below its floor and replaces the member via the r13 pack boot.
+Without the reap the dead replica stayed a member forever: routers
+dropped it from the ring (STOPPED) but the pool's count never shrank,
+so the autoscaler never replaced it — the crash-then-shrink hole the
+session replay chaos leg exercises (docs/sessions).
 """
 
 from __future__ import annotations
@@ -132,6 +144,10 @@ class ReplicaPool:
         self._drained: set = set()
         self._replicas: Dict[str, Replica] = {}
         self._booting: set = set()
+        # names under a pool-initiated preemption/drain: their STOPPED
+        # events are expected and must not be misread as crashes
+        self._removing: set = set()
+        self._crashed: List[str] = []
         self._shutdown = False
         self._next_idx = n
         self._dispatchq = None
@@ -174,6 +190,9 @@ class ReplicaPool:
         # (hook order: drain_serving first) so the per-replica final
         # checkpoints see quiesced replicas
         self._unhook = _preemption.on_preemption(self._run_all_drain_hooks)
+        # crash reap (module doc): react to STOPPED events the pool
+        # did not initiate
+        self._health_unsub = _health.subscribe(self._on_health_event)
 
     def _per_replica(self, seat, name: str):
         return seat(name) if callable(seat) else seat
@@ -209,6 +228,50 @@ class ReplicaPool:
             if r.owns_source(source):
                 return name
         return None
+
+    # -- crash reap (module doc) ---------------------------------------
+
+    def _on_health_event(self, source: object, old: str,
+                         new: str) -> None:
+        if new != _serve.STOPPED:
+            return
+        with self._lock:
+            if self._shutdown:
+                return
+        name = self.resolve_source(source)
+        if name is None:
+            return
+        dead = None
+        with self._lock:
+            if (name in self._removing or name in self._drained
+                    or self._shutdown):
+                return                 # pool-initiated: not a crash
+            replica = self._replicas.get(name)
+            if not isinstance(replica, ProcessReplica):
+                return                 # threads have no crash mode
+            if not replica.unexpected_exit:
+                return                 # drain-flow STOPPED, not a crash
+            # unexpected child exit: reap the membership NOW so the
+            # autoscaler's next tick replaces the dead member (the
+            # pack boot) instead of counting a corpse as capacity
+            dead = self._replicas.pop(name)
+            self._drain_hooks.pop(name, None)
+            self._crashed.append(name)
+        if dead is not None:
+            warnings.warn(
+                f"replica {name!r} exited unexpectedly — reaped from "
+                "the pool (an attached autoscaler will replace it)",
+                RuntimeWarning, stacklevel=2)
+            try:
+                dead.shutdown()        # reap pipe/threads; idempotent
+            except Exception:  # noqa: BLE001 — the corpse is gone
+                pass
+
+    def crashed_names(self) -> List[str]:
+        """Names of replicas reaped after an unexpected exit (crash
+        forensics; the session chaos leg asserts on this)."""
+        with self._lock:
+            return list(self._crashed)
 
     # -- elastic membership (the autoscaler's seam) --------------------
 
@@ -345,27 +408,41 @@ class ReplicaPool:
         thread replicas drain in place. Returns whether quiescence was
         reached inside ``timeout``."""
         replica = self._replicas[name]
-        if isinstance(replica, ProcessReplica):
-            replica.preempt()
-            # the child's handler drains asynchronously; wait for its
-            # STOPPED announcement by polling the cached state
-            import time as _time
+        # expected STOPPED ahead: the crash reap must not misread a
+        # pool-initiated preemption as an unexpected exit
+        with self._lock:
+            self._removing.add(name)
+        try:
+            if isinstance(replica, ProcessReplica):
+                replica.preempt()
+                # the child's handler drains asynchronously; wait for
+                # its STOPPED announcement by polling the cached state
+                import time as _time
 
-            deadline = _time.monotonic() + (timeout or 30.0)
-            while (replica.state() != "STOPPED"
-                   and _time.monotonic() < deadline):
-                _time.sleep(0.05)
-            drained = replica.state() == "STOPPED"
-        else:
-            drained = replica.drain(timeout=timeout)
-        self._run_drain_hooks(name)
+                deadline = _time.monotonic() + (timeout or 30.0)
+                while (replica.state() != "STOPPED"
+                       and _time.monotonic() < deadline):
+                    _time.sleep(0.05)
+                drained = replica.state() == "STOPPED"
+            else:
+                drained = replica.drain(timeout=timeout)
+            self._run_drain_hooks(name)
+        finally:
+            with self._lock:
+                self._removing.discard(name)
         return drained
 
     def drain_replica(self, name: str,
                       timeout: Optional[float] = 30.0) -> bool:
         """Drain one replica without the preemption framing (no drain
         hooks) — administrative removal, e.g. before a resize."""
-        return self._replicas[name].drain(timeout=timeout)
+        with self._lock:
+            self._removing.add(name)
+        try:
+            return self._replicas[name].drain(timeout=timeout)
+        finally:
+            with self._lock:
+                self._removing.discard(name)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -380,6 +457,7 @@ class ReplicaPool:
         with self._lock:
             self._shutdown = True
         self._unhook()
+        self._health_unsub()
         for r in self.replicas():
             try:
                 r.shutdown()

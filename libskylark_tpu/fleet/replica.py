@@ -115,6 +115,14 @@ class Replica:
     def submit(self, endpoint: str, /, **kwargs) -> Future:
         raise NotImplementedError
 
+    def session(self, op: str, /, **kwargs) -> Future:
+        """Stateful-session verb (docs/sessions): ``op`` is ``open`` /
+        ``append`` / ``finalize`` with the corresponding
+        ``MicrobatchExecutor`` session method's kwargs. Returns a
+        future (``open`` resolves to the session id, ``append`` to
+        ``(seq, rows)``, ``finalize`` to the result dict)."""
+        raise NotImplementedError
+
     def queue_depth(self) -> int:
         raise NotImplementedError
 
@@ -164,6 +172,22 @@ class ThreadReplica(Replica):
 
     def submit(self, endpoint: str, /, **kwargs) -> Future:
         return self.executor.submit(endpoint, **kwargs)
+
+    def session(self, op: str, /, **kwargs) -> Future:
+        if op == "append":
+            return self.executor.session_append(**kwargs)
+        if op == "finalize":
+            return self.executor.session_finalize(**kwargs)
+        fut: Future = Future()
+        try:
+            if op != "open":
+                raise ValueError(f"unknown session op {op!r}")
+            fut.set_result(self.executor.open_sketch_session(**kwargs))
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:  # noqa: BLE001 — resolve, don't leak
+            fut.set_exception(e)
+        return fut
 
     def queue_depth(self) -> int:
         return self.executor.queue_depth()
@@ -355,6 +379,23 @@ def _worker_main(conn, name: str, executor_kwargs: dict,
                         raise
                 fut = ex.submit(endpoint, **kwargs)
                 fut.add_done_callback(functools.partial(reply, rid))
+            elif kind == "session":
+                # stateful-session verbs (docs/sessions). Append
+                # operands arrive over the pickle pipe (not the shm
+                # rings — the batch is about to be journaled to disk
+                # anyway, so a zero-copy view buys nothing); results
+                # go back through the standard reply path
+                op, kwargs = msg[2], msg[3]
+                if op == "open":
+                    send(("rpc", rid, ex.open_sketch_session(**kwargs)))
+                elif op == "append":
+                    fut = ex.session_append(**kwargs)
+                    fut.add_done_callback(functools.partial(reply, rid))
+                elif op == "finalize":
+                    fut = ex.session_finalize(**kwargs)
+                    fut.add_done_callback(functools.partial(reply, rid))
+                else:
+                    raise ValueError(f"unknown session op {op!r}")
             elif kind == "stats":
                 send(("rpc", rid, ex.stats()))
             elif kind == "env":
@@ -440,6 +481,11 @@ class ProcessReplica(Replica):
         self._futures: "dict[int, Future]" = {}
         self._state = "SERVING"
         self._closed = False
+        # set by the reader tail when the child died WITHOUT ever
+        # announcing STOPPED through the drain flow — the pool's crash
+        # reap keys off this, not off is_alive() (which can still read
+        # True in the microseconds between pipe EOF and process reap)
+        self.unexpected_exit = False
         self._reader = threading.Thread(
             target=self._reader_loop,
             name=f"skylark-replica-{self.name}-reader", daemon=True)
@@ -512,7 +558,14 @@ class ProcessReplica(Replica):
                     f"replica process {self.name!r} exited with "
                     f"requests in flight"))
         if self._state not in ("STOPPED",):
+            # the child never announced STOPPED itself: a graceful
+            # drain forwards DRAINING -> STOPPED over the pipe BEFORE
+            # the EOF, so landing here with a live state means the
+            # process died out from under us (kill -9, OOM, the chaos
+            # ``crash`` fault) — unless the parent itself tore the
+            # pipe down (shutdown of a wedged child)
             old, self._state = self._state, "STOPPED"
+            self.unexpected_exit = not self._closed
             _health.publish(self, old, "STOPPED")
         if self._transport is not None:
             self._transport.destroy()
@@ -571,6 +624,11 @@ class ProcessReplica(Replica):
             # the header never left: the child will never ack these
             self._transport.unclaim(claimed)
             raise
+
+    def session(self, op: str, /, **kwargs) -> Future:
+        # session operands ride the pickle pipe (see _worker_main's
+        # "session" branch); the child re-validates against its spec
+        return self._send("session", op, kwargs)
 
     def queue_depth(self) -> int:
         # outstanding submits the parent knows about — no pipe

@@ -65,6 +65,7 @@ import heapq
 import itertools
 import threading
 import time
+import uuid
 import warnings
 import weakref
 from concurrent.futures import Future
@@ -103,6 +104,9 @@ _HEDGE_MISMATCH = _metrics.counter(
     "fleet.hedge_mismatches", "Hedge verify-mode comparisons where the "
     "two executions diverged (must stay 0 — the endpoints are "
     "deterministic)")
+_SESSION_HANDOFFS = _metrics.counter(
+    "fleet.session_handoffs", "Stateful sessions re-resolved to a new "
+    "owner replica (drain handoff or crash replay), by new owner")
 
 
 # process-lifetime hedge rollup: hedge events survive their router (a
@@ -265,6 +269,18 @@ class Router:
         self._epoch = 0
         self._assign: dict = {}        # statics -> (epoch, owner name)
         self._owned = collections.Counter()
+        # stateful-session affinity (docs/sessions): sid -> (epoch,
+        # owner). The epoch is the session-affinity epoch — it bumps
+        # with every ring membership change, so an assignment made
+        # before a drain/crash is stale by construction and the next
+        # touch re-resolves (a handoff) to a surviving owner, which
+        # resumes the session from SKYLARK_SESSION_DIR
+        self._sessions: dict = {}      # sid -> (epoch, owner name)
+        # where this router's current epoch sits on the hub's global
+        # transition timeline (resilience.health.transition_seq) —
+        # anchors epoch-stamped views (session assignments, ring
+        # membership) against hub history in forensics/tests
+        self._epoch_hub_seq = _health.transition_seq()
         # seed the view from the replicas' CURRENT states: a router
         # built after a replica started draining must not route to it
         for name in pool.names():
@@ -307,6 +323,7 @@ class Router:
                     # membership changed: every sticky assignment is
                     # re-derived against the surviving ring
                     self._epoch += 1
+                    self._epoch_hub_seq = _health.transition_seq()
                     self._assign.clear()
                     self._owned.clear()
                 self._removed.add(name)
@@ -321,6 +338,7 @@ class Router:
                     # ownership against the new membership
                     self._ring.add(name)
                     self._epoch += 1
+                    self._epoch_hub_seq = _health.transition_seq()
                     self._assign.clear()
                     self._owned.clear()
                     self._removed.discard(name)
@@ -732,6 +750,155 @@ class Router:
         return self.submit("krr_predict", kernel=kernel, X_new=X_new,
                            X_train=X_train, coef=coef, **kw)
 
+    # -- stateful sessions (docs/sessions) -----------------------------
+
+    def open_sketch_session(self, kind: str, *,
+                            session_id: Optional[str] = None,
+                            owner: Optional[str] = None,
+                            timeout: float = 60.0, **spec_kwargs) -> str:
+        """Open a session on one replica and pin the session-affinity
+        assignment to it. The owner is the first healthy replica in
+        the ring preference order of ``("session", sid)`` — the same
+        deterministic construction bucket affinity uses — unless
+        ``owner`` pins one explicitly (tests, chaos legs). Returns the
+        session id."""
+        sid = str(session_id) if session_id else uuid.uuid4().hex[:16]
+        tags = faults.current_tags()
+        order = ((owner,) if owner
+                 else self._session_candidates(sid))
+        last_err: Optional[BaseException] = None
+        for name in order:
+            # same failover walk as every other fleet dispatch: a
+            # candidate that refuses the open (drain race, dead pipe,
+            # an injected ``fleet.route`` fault) moves it to the next
+            # — the registry open is side-effect-free on refusal. An
+            # explicit ``owner`` pin does NOT fail over: a pin means
+            # exactly that replica (tests, chaos legs).
+            try:
+                faults.check("fleet.route", tags=tags,
+                             detail=f"session:open {sid} -> {name}")
+                fut = self._pool.get(name).session(
+                    "open", kind=kind, session_id=sid, **spec_kwargs)
+                sid = fut.result(timeout=timeout)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — failover
+                last_err = e
+                if owner:
+                    raise
+                with self._lock:
+                    self._counts["failover"] += 1
+                _FAILOVER.inc(replica=name)
+                continue
+            with self._lock:
+                self._sessions[sid] = (self._epoch, name)
+            return sid
+        raise NoHealthyReplicaError(
+            f"no replica accepted the session open for {sid!r}: "
+            f"tried {list(order)}") from last_err
+
+    def _session_candidates(self, sid: str) -> tuple:
+        """Healthy-first preference order for a session id (DEGRADED
+        demoted to the tail, like :meth:`_candidates`)."""
+        pref = list(self._ring.preference(("session", sid)))
+        if not pref:
+            raise NoHealthyReplicaError(
+                f"no replica available for session {sid!r} "
+                "(empty ring)")
+        with self._lock:
+            degraded = set(self._degraded)
+        return tuple([n for n in pref if n not in degraded]
+                     + [n for n in pref if n in degraded])
+
+    def _session_owner(self, sid: str) -> str:
+        """Resolve a session's owner under the session-affinity epoch:
+        a cached assignment from the current epoch (owner still on the
+        ring) is authoritative; anything else re-resolves against the
+        surviving membership — a **handoff** when the owner actually
+        changed (the new owner resumes the session from
+        ``SKYLARK_SESSION_DIR`` on its first touch)."""
+        with self._lock:
+            entry = self._sessions.get(sid)
+            if (entry is not None and entry[0] == self._epoch
+                    and entry[1] in self._ring):
+                return entry[1]
+        new = self._session_candidates(sid)[0]
+        self._note_session_owner(sid, new)
+        return new
+
+    def _note_session_owner(self, sid: str, new: str) -> None:
+        with self._lock:
+            prev = self._sessions.get(sid)
+            handoff = prev is not None and prev[1] != new
+            self._sessions[sid] = (self._epoch, new)
+            if handoff:
+                self._counts["session_handoffs"] += 1
+        if handoff:
+            _SESSION_HANDOFFS.inc(replica=new)
+
+    def _session_call(self, sid: str, op: str, kwargs: dict) -> Future:
+        """Dispatch one session verb to the resolved owner, failing
+        over down the candidate order when a replica *refuses* the
+        call (dead pipe, drain race) — each attempt under the
+        ``fleet.route`` chaos seam. A future that the owner accepted
+        but later resolves exceptionally is NOT retried here: the
+        idempotent sequence numbers make the client's retry safe, and
+        the retry re-resolves ownership (by then the dead owner's
+        STOPPED event has bumped the epoch)."""
+        tags = faults.current_tags()
+        owner = self._session_owner(sid)
+        order = [owner] + [n for n in self._session_candidates(sid)
+                           if n != owner]
+        last_err: Optional[BaseException] = None
+        for name in order:
+            try:
+                faults.check("fleet.route", tags=tags,
+                             detail=f"session:{op} {sid} -> {name}")
+                fut = self._pool.get(name).session(op, **kwargs)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — failover
+                last_err = e
+                with self._lock:
+                    self._counts["failover"] += 1
+                _FAILOVER.inc(replica=name)
+                continue
+            self._note_session_owner(sid, name)
+            return fut
+        raise NoHealthyReplicaError(
+            f"no replica accepted session {op!r} for {sid!r}: tried "
+            f"{order}") from last_err
+
+    def session_append(self, session_id: str, X, Y=None,
+                       seq: Optional[int] = None, **kw) -> Future:
+        """Route one append to the session's owner (module doc);
+        resolves to ``(seq, rows)``. Supply explicit ``seq`` numbers
+        when you intend to retry across a crash — duplicates are
+        no-ops on the resuming owner."""
+        return self._session_call(
+            session_id, "append",
+            dict(session_id=session_id, X=X, Y=Y, seq=seq, **kw))
+
+    def session_finalize(self, session_id: str, **kw) -> Future:
+        """Route the finalize to the owner and drop the assignment."""
+        fut = self._session_call(session_id, "finalize",
+                                 dict(session_id=session_id, **kw))
+
+        def _forget(_f):
+            with self._lock:
+                self._sessions.pop(session_id, None)
+
+        fut.add_done_callback(_forget)
+        return fut
+
+    def session_owner(self, session_id: str) -> Optional[str]:
+        """The replica the next session verb would land on (resolving,
+        but without dispatching anything)."""
+        try:
+            return self._session_owner(session_id)
+        except NoHealthyReplicaError:
+            return None
+
     # -- introspection -------------------------------------------------
 
     def owner_of(self, endpoint: str, **kwargs) -> Optional[str]:
@@ -767,6 +934,10 @@ class Router:
             "hedged": c.get("hedged", 0),
             "hedge_wins": c.get("hedge_wins", 0),
             "hedge_mismatches": c.get("hedge_mismatches", 0),
+            "session_handoffs": c.get("session_handoffs", 0),
+            "sessions_assigned": len(self._sessions),
+            "session_epoch": self._epoch,
+            "session_epoch_hub_seq": self._epoch_hub_seq,
             "routable": self.routable(),
             "degraded": degraded,
             "removed": removed,
@@ -798,14 +969,15 @@ def fleet_stats() -> dict:
     :class:`~libskylark_tpu.fleet.autoscale.Autoscaler`."""
     agg = collections.Counter(routed=0, affinity_hit=0, failover=0,
                               spilled=0, hedged=0, hedge_wins=0,
-                              hedge_mismatches=0)
+                              hedge_mismatches=0, session_handoffs=0)
     by_replica = collections.Counter()
     routers = 0
     for router in list(_ROUTERS):
         s = router.stats()
         routers += 1
         for k in ("routed", "affinity_hit", "failover", "spilled",
-                  "hedged", "hedge_wins", "hedge_mismatches"):
+                  "hedged", "hedge_wins", "hedge_mismatches",
+                  "session_handoffs"):
             agg[k] += s[k]
         by_replica.update(s["by_replica"])
     out = dict(agg)

@@ -279,6 +279,32 @@ def iter_libsvm_batches(
             yield X, Yout
 
 
+def iter_array_batches(
+    X, batch_rows: int, Y=None,
+) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
+    """Yield ``(X_batch, Y_batch)`` row slices off in-memory arrays —
+    the canonical row-batch stream the stateful serve sessions
+    (:mod:`libskylark_tpu.sessions`) and their bit-equality gates
+    consume. Slicing is a view (no copy) and preserves bytes exactly,
+    so a session fed these batches finalizes bit-equal to the one-shot
+    sketch of ``X`` for the order-independent transforms (CWT — the
+    :mod:`io.streaming` invariant promoted into the serve layer).
+    ``Y=None`` yields ``(X_batch, None)``."""
+    X = np.asarray(X)
+    if Y is not None:
+        Y = np.asarray(Y)
+        if Y.shape[0] != X.shape[0]:
+            raise errors.InvalidParametersError(
+                f"iter_array_batches: X has {X.shape[0]} rows but Y "
+                f"has {Y.shape[0]}")
+    if batch_rows <= 0:
+        raise errors.InvalidParametersError(f"bad batch_rows {batch_rows}")
+    for lo in range(0, X.shape[0], batch_rows):
+        hi = min(lo + batch_rows, X.shape[0])
+        _BATCHES.inc(source="array")
+        yield X[lo:hi], (Y[lo:hi] if Y is not None else None)
+
+
 def iter_hdf5_batches(
     path, batch_rows: int, dtype=np.float32,
     retry: Optional[RetryPolicy] = None,

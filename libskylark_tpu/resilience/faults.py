@@ -26,7 +26,15 @@ Sites planted today:
 ``io.webhdfs.read``   the WebHDFS chunk-read loop (per chunk)
 ``io.chunked.read``   the HDF5 batch-slice reads
 ``io.chunked.batch``  the libsvm batch parser, once per yielded batch
-``checkpoint.save``   :meth:`TrainCheckpointer.save` / ``save_sync``
+``checkpoint.save``   :meth:`TrainCheckpointer.save` / ``save_sync``,
+                      and the session-state :func:`utility.checkpoint
+                      .save_sync` snapshots
+``session.append``    the stateful-session append path, once per
+                      accepted batch, BEFORE the journal write
+                      (:mod:`libskylark_tpu.sessions.registry`) — a
+                      fired fault (or ``crash``) kills the append
+                      pre-durability, so the client's retry lands
+                      exactly once
 ====================  ====================================================
 
 A plan is a JSON document (or the equivalent dict)::
@@ -63,6 +71,18 @@ the straggler injector (a slow replica is a failure mode no exception
 models) the fleet hedging chaos leg replays. Stalls appear in
 ``fired()`` with error name ``"stall"``.
 
+A spec may instead carry ``"crash": true``: a fired crash hard-kills
+the process with ``os._exit(137)`` — no exception, no cleanup, no
+atexit, the same observable as a ``kill -9``. Mutually exclusive with
+``error`` and ``stall_s``. This is how the chaos battery kills a
+replica mid-session *deterministically* (the spec rides the victim
+child's ``SKYLARK_FAULT_PLAN`` via the pool's ``replica_env`` seat)
+instead of shelling out to ``kill``; meaningful only for process
+targets — fired in the serving parent it takes the whole host down,
+which is what a crash does. Crashes appear in ``fired()`` with error
+name ``"crash"`` (visible only to a survivor sharing the plan — the
+firing process is gone).
+
 Activation: ``with fault_plan(plan): ...`` (tests), or the
 ``SKYLARK_FAULT_PLAN`` environment variable holding the JSON itself or
 a path to it (chaos CI). A context plan shadows the env plan. Every
@@ -88,7 +108,7 @@ from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.telemetry import metrics as _metrics
 
 _VALID_KEYS = {"site", "error", "message", "on_hit", "every", "prob",
-               "after", "times", "tag", "stall_s"}
+               "after", "times", "tag", "stall_s", "crash"}
 
 # Unified-registry adapter (docs/observability): fired injections are
 # chaos-run events — always counted (a fire raises an exception; the
@@ -115,7 +135,7 @@ class FaultSpec:
 
     __slots__ = ("site", "error_name", "error_cls", "message", "on_hit",
                  "every", "prob", "after", "times", "tag", "stall_s",
-                 "hits", "fires", "_rng")
+                 "crash", "hits", "fires", "_rng")
 
     def __init__(self, doc: dict, seed: int, index: int):
         unknown = set(doc) - _VALID_KEYS
@@ -125,10 +145,11 @@ class FaultSpec:
         if "site" not in doc:
             raise errors.InvalidParametersError(
                 f"fault spec missing 'site': {doc!r}")
-        if "stall_s" in doc and "error" in doc:
+        modes = [k for k in ("error", "stall_s", "crash") if k in doc]
+        if len(modes) > 1:
             raise errors.InvalidParametersError(
-                "a fault spec is a stall or an error, not both: "
-                f"{doc!r}")
+                "a fault spec is an error, a stall, OR a crash — "
+                f"{modes} together make no sense: {doc!r}")
         self.site = str(doc["site"])
         # a stall spec delays the hit instead of raising: the straggler
         # injector the fleet hedging leg replays (a slow replica is a
@@ -138,9 +159,16 @@ class FaultSpec:
         if self.stall_s is not None and self.stall_s < 0:
             raise errors.InvalidParametersError(
                 f"fault spec stall_s must be >= 0, got {self.stall_s}")
-        self.error_name = ("stall" if self.stall_s is not None
-                           else str(doc.get("error", "IOError_")))
-        self.error_cls = (None if self.stall_s is not None
+        # a crash spec hard-kills the process at the site (module doc):
+        # the deterministic kill -9 for process-replica chaos targets
+        self.crash = bool(doc.get("crash", False))
+        if self.stall_s is not None:
+            self.error_name = "stall"
+        elif self.crash:
+            self.error_name = "crash"
+        else:
+            self.error_name = str(doc.get("error", "IOError_"))
+        self.error_cls = (None if self.stall_s is not None or self.crash
                           else _resolve_error(self.error_name))
         self.message = doc.get("message")
         self.on_hit = int(doc["on_hit"]) if "on_hit" in doc else None
@@ -219,6 +247,13 @@ class FaultPlan:
                     break
         if hit_spec is None:
             return
+        if hit_spec.crash:
+            # the deterministic kill -9: no exception, no cleanup, no
+            # atexit — exactly what a preempted-without-grace replica
+            # looks like from the outside. 137 = 128 + SIGKILL, the
+            # code a supervisor would report for the real thing.
+            os._exit(137)
+            return  # pragma: no cover — only a test-stubbed _exit returns
         if hit_spec.stall_s is not None:
             # stall OUTSIDE the plan lock: a sleeping site must not
             # serialize every other site's checks behind it

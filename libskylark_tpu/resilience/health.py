@@ -34,6 +34,7 @@ from libskylark_tpu.telemetry import metrics as _metrics
 
 _LOCK = _locks.make_lock("resilience.health")
 _SUBSCRIBERS: "list[Callable[[object, str, str], None]]" = []
+_SEQ = 0        # monotonic transition sequence (see transition_seq)
 
 # always-on (the transition itself — a drain, a DEGRADED flip — dwarfs
 # the counter bump), so benchmarks records carry the state history
@@ -63,13 +64,28 @@ def subscribe(fn: Callable[[object, str, str], None]
     return unsubscribe
 
 
+def transition_seq() -> int:
+    """Monotonic count of transitions published in this process — the
+    hub-level **session-affinity epoch** anchor: any view derived from
+    hub events (a router's ring membership, its session assignments)
+    stamped with this value is provably stale once the value moves.
+    The fleet router stamps each membership-epoch bump with this value
+    (``Router.stats()["session_epoch_hub_seq"]``), so tests and
+    forensics can order cross-object views against the hub's
+    timeline."""
+    with _LOCK:
+        return _SEQ
+
+
 def publish(source: object, old: str, new: str) -> None:
     """Fan one transition out to every subscriber (the serve layer's
     hook; see :meth:`MicrobatchExecutor._maybe_publish_state`).
     Subscriber failures are contained — publishing happens on drain
     and teardown paths that must complete regardless."""
+    global _SEQ
     _TRANSITIONS.inc_always(old=old, new=new)
     with _LOCK:
+        _SEQ += 1
         subs = list(_SUBSCRIBERS)
     for fn in subs:
         try:
@@ -80,4 +96,4 @@ def publish(source: object, old: str, new: str) -> None:
                 f"{old}->{new}: {e}", RuntimeWarning, stacklevel=2)
 
 
-__all__ = ["publish", "subscribe"]
+__all__ = ["publish", "subscribe", "transition_seq"]

@@ -8,7 +8,11 @@ teardown instead of a mid-flight loss:
 1. every live :class:`~libskylark_tpu.engine.serve.MicrobatchExecutor`
    is **drained** — intake stops (new submits are load-shed with
    :class:`~libskylark_tpu.engine.serve.ServeOverloadedError`), every
-   queued cohort flushes, every in-flight future resolves;
+   queued cohort flushes, every in-flight future resolves — and the
+   drain itself **checkpoints every live stateful session** (journal
+   fsync + accumulator snapshot under ``SKYLARK_SESSION_DIR``), so a
+   peer replica resumes the streams a preempted replica was holding
+   open (docs/sessions, "Graceful handoff");
 2. every **registered checkpoint hook** runs a final *synchronous*
    :meth:`~libskylark_tpu.utility.checkpoint.TrainCheckpointer
    .save_sync` — durable on disk before the teardown completes

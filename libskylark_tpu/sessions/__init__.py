@@ -1,0 +1,37 @@
+"""Survivable stateful serve sessions (docs/sessions).
+
+The serve layer's endpoints are stateless one-shots; this subsystem
+adds bucket-lived *sessions* that hold a maintained sketch open across
+requests — row-batch appenders for CountSketch/JLT/SRHT, incremental
+randomized SVD, and online KRR — with the resilience wiring that keeps
+a session alive when its replica is drained (checkpoint + peer resume)
+or killed outright (journal replay, idempotent sequence numbers).
+
+Layering:
+
+- :mod:`~libskylark_tpu.sessions.state` — the per-kind maintained
+  sketch and its fold/finalize math (linearity is the whole trick);
+- :mod:`~libskylark_tpu.sessions.journal` — the append-only durability
+  log under ``SKYLARK_SESSION_DIR``;
+- :mod:`~libskylark_tpu.sessions.registry` — open/append/finalize,
+  TTL eviction, checkpointing, resume-with-replay;
+- the serve layer (:class:`~libskylark_tpu.engine.serve
+  .MicrobatchExecutor` session endpoints) and the fleet router
+  (session affinity + handoff) wire it into traffic.
+"""
+
+from libskylark_tpu.sessions.journal import SessionJournal
+from libskylark_tpu.sessions.registry import (SessionRegistry,
+                                              default_session_dir,
+                                              sessions_stats)
+from libskylark_tpu.sessions.state import KINDS, SessionSpec, SessionState
+
+__all__ = [
+    "KINDS",
+    "SessionJournal",
+    "SessionRegistry",
+    "SessionSpec",
+    "SessionState",
+    "default_session_dir",
+    "sessions_stats",
+]

@@ -1,0 +1,146 @@
+"""Append-only session journal: every accepted append, durable before
+its future resolves.
+
+One file per session under the registry directory. Record layout::
+
+    [u32 payload length][u32 crc32 of payload][payload bytes]
+
+after an 8-byte file magic (``SKYJRNL1``). The payload is a pickled
+``(seq, {"X": ndarray, "Y": ndarray | None})`` tuple — exact bytes, so
+replaying a record re-folds exactly the batch the client sent.
+
+Durability discipline (docs/sessions, "Journal format"):
+
+- every append **flushes** to the OS page cache before returning — a
+  ``kill -9``'d replica loses nothing already accepted (the OS holds
+  the bytes; only a whole-machine crash can drop them);
+- every ``SKYLARK_SESSION_FSYNC_EVERY``-th append (default 8) also
+  **fsyncs**, bounding what a machine crash can lose; drain/checkpoint
+  paths call :meth:`sync` to force the bound to zero.
+
+Torn tails are expected, not fatal: a crash mid-write leaves a partial
+final record. :func:`scan` validates length + CRC record by record and
+stops at the first damage; :meth:`SessionJournal.open_for_append`
+truncates the file back to the intact prefix, so a resumed session
+replays exactly the accepted appends and the retried tail append lands
+cleanly after them (idempotent sequence numbers make the overlap a
+no-op either way).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import zlib
+from typing import Iterator, Optional, Tuple
+
+from libskylark_tpu.base import env as _env
+from libskylark_tpu.base import errors
+
+MAGIC = b"SKYJRNL1"
+_HDR = struct.Struct("<II")
+
+
+def scan(path: str) -> Tuple[list, int]:
+    """``([(seq, batch_dict), ...], good_offset)`` — every intact
+    record in order, plus the byte offset of the intact prefix (the
+    truncation point for recovery). A missing file scans as empty; a
+    bad magic raises (that is not a torn tail, it is not a journal)."""
+    if not os.path.exists(path):
+        return [], len(MAGIC)
+    records: list = []
+    with open(path, "rb") as fh:
+        magic = fh.read(len(MAGIC))
+        if magic != MAGIC:
+            raise errors.IOError_(
+                f"{path} is not a session journal (bad magic)")
+        good = fh.tell()
+        while True:
+            hdr = fh.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                break
+            length, crc = _HDR.unpack(hdr)
+            payload = fh.read(length)
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                break                      # torn tail: stop at damage
+            try:
+                seq, batch = pickle.loads(payload)
+            except Exception:              # noqa: BLE001 — torn pickle
+                break
+            records.append((int(seq), batch))
+            good = fh.tell()
+    return records, good
+
+
+class SessionJournal:
+    """Writer half: append-only with batched fsync (module doc)."""
+
+    def __init__(self, path: str, fsync_every: Optional[int] = None):
+        self.path = path
+        self._fsync_every = max(int(
+            fsync_every if fsync_every is not None
+            else _env.SESSION_FSYNC_EVERY.get()), 1)
+        self._since_sync = 0
+        self._fh = None
+
+    @classmethod
+    def create(cls, path: str,
+               fsync_every: Optional[int] = None) -> "SessionJournal":
+        j = cls(path, fsync_every)
+        fh = open(path, "xb")
+        fh.write(MAGIC)
+        fh.flush()
+        os.fsync(fh.fileno())
+        j._fh = fh
+        return j
+
+    @classmethod
+    def open_for_append(cls, path: str,
+                        fsync_every: Optional[int] = None,
+                        ) -> Tuple["SessionJournal", list]:
+        """Recovery open: scan the intact prefix, truncate any torn
+        tail, position for append. Returns ``(journal, records)``."""
+        records, good = scan(path)
+        j = cls(path, fsync_every)
+        if not os.path.exists(path):
+            return cls.create(path, fsync_every), records
+        fh = open(path, "r+b")
+        fh.truncate(good)
+        fh.seek(good)
+        j._fh = fh
+        return j, records
+
+    def append(self, seq: int, batch: dict) -> None:
+        """Make one append durable (see the module durability
+        discipline). The caller folds only after this returns."""
+        payload = pickle.dumps((int(seq), batch), protocol=4)
+        self._fh.write(_HDR.pack(len(payload), zlib.crc32(payload)))
+        self._fh.write(payload)
+        self._fh.flush()
+        self._since_sync += 1
+        if self._since_sync >= self._fsync_every:
+            os.fsync(self._fh.fileno())
+            self._since_sync = 0
+
+    def sync(self) -> None:
+        """Force the fsync bound to zero (drain/checkpoint paths)."""
+        if self._fh is not None and not self._fh.closed:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._since_sync = 0
+
+    def close(self) -> None:
+        if self._fh is not None and not self._fh.closed:
+            self.sync()
+            self._fh.close()
+
+
+def replay(path: str) -> Iterator[Tuple[int, dict]]:
+    """Read-only iteration over the intact records (peers inspecting a
+    journal they do not own)."""
+    records, _ = scan(path)
+    return iter(records)
+
+
+__all__ = ["SessionJournal", "replay", "scan"]

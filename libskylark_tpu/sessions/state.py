@@ -1,0 +1,319 @@
+"""Per-kind session state: the maintained sketch a session folds
+row batches into.
+
+A stateful serve session exploits the one mathematical fact the whole
+subsystem stands on: sketching transforms are **linear maps**
+(PAPER.md, "sketching transforms"), so the sketch of a row stream is
+the sum of per-batch partial sketches — the same mergeability
+FlashSketch exploits across sparse shards applies across time. That
+makes the session state *small* (the s×d maintained sketch, never the
+data), which is what makes it cheap to checkpoint on drain, journal
+per append, and replay after a crash (:mod:`libskylark_tpu.sessions.
+registry`).
+
+Kinds and their maintained state:
+
+===========  =============================================================
+``cwt``      CountSketch appender: positional bucket/sign streams scatter
+             each batch into the carried (s, d) accumulator — **bit-equal**
+             to the one-shot ``CWT.apply`` on the concatenated rows (the
+             :mod:`io.streaming` layout-independence invariant promoted
+             into the serve layer; updates land in row order, exactly the
+             one-shot scatter's order).
+``jlt``      Dense JLT appender: the virtual operator's column panel for
+             the batch's row positions (``DenseTransform.s_panel`` — the
+             same positional stream the one-shot apply materializes)
+             times the batch, accumulated in batch order. Bit-equal to a
+             replayed/uninterrupted session at the same batch boundaries;
+             allclose to the one-shot apply (XLA's single matmul
+             re-associates the f32 row sum).
+``srht``     SRHT appender (WHT-based FJLT): operator columns in closed
+             form — ``S[k, j] = D[j] · (−1)^popcount(idx_k & j) / sqrt(s)``
+             (Sylvester Hadamard entries at the transform's own sampled
+             rows and Rademacher diagonal) — same guarantee tier as
+             ``jlt``. Requires ``n`` a power of two.
+``isvd``     Incremental randomized SVD: maintains the ``jlt`` row sketch;
+             ``finalize`` returns the top-k singular values and right
+             singular vectors of the maintained (s, d) sketch — the
+             streaming one-pass randomized SVD of the row stream.
+``krr``      Online KRR via random features: per batch, the GaussianRFT
+             feature map Z of the rows updates the carried normal
+             equations ``G += ZᵀZ``, ``b += ZᵀY``; ``finalize`` solves
+             ``(G + λI) w = b``. Row-wise feature maps are positional-
+             independent, so folding is exact per batch.
+===========  =============================================================
+
+Replay invariant (all kinds): ``fold`` is a deterministic eager
+function of ``(state bytes, batch bytes)``, and checkpoints store the
+accumulator bytes exactly — so a session resumed from checkpoint +
+journal tail finalizes **bit-equal** to the uninterrupted session.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import numpy as np
+
+from libskylark_tpu.base import errors
+
+KINDS = ("cwt", "jlt", "srht", "isvd", "krr")
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionSpec:
+    """The (pickleable, JSON-able) identity of one session: everything
+    a peer replica needs to rebuild the transform streams and resume.
+    ``n`` is the declared row extent (the positional streams' length —
+    appends past it refuse); ``s_dim`` the sketch/feature dimension;
+    ``d`` the row width; ``seed`` the transform Context seed."""
+
+    kind: str
+    n: int
+    s_dim: int
+    d: int
+    seed: int = 0
+    dtype: str = "float32"
+    targets: int = 0          # Y columns carried (0: X only)
+    k: int = 0                # isvd: ranks returned at finalize
+    lam: float = 1e-3         # krr: ridge
+    sigma: float = 1.0        # krr: RFT bandwidth
+    ttl_s: Optional[float] = None
+
+    def validate(self) -> "SessionSpec":
+        if self.kind not in KINDS:
+            raise errors.InvalidParametersError(
+                f"unknown session kind {self.kind!r}; expected one of "
+                f"{KINDS}")
+        if self.n < 1 or self.s_dim < 1 or self.d < 1:
+            raise errors.InvalidParametersError(
+                f"session dims must be positive, got n={self.n} "
+                f"s_dim={self.s_dim} d={self.d}")
+        if self.kind == "srht" and self.n & (self.n - 1):
+            raise errors.InvalidParametersError(
+                f"srht sessions need n a power of two (WHT length), "
+                f"got {self.n}")
+        if self.kind == "krr" and self.targets < 1:
+            raise errors.InvalidParametersError(
+                "krr sessions carry targets: open with targets >= 1")
+        if self.kind == "isvd" and not 0 <= self.k <= min(self.s_dim,
+                                                         self.d):
+            raise errors.InvalidParametersError(
+                f"isvd k must be in [0, min(s_dim, d)], got {self.k}")
+        return self
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SessionSpec":
+        return cls(**{f.name: d[f.name]
+                      for f in dataclasses.fields(cls)
+                      if f.name in d}).validate()
+
+
+def _popcount_parity(a: np.ndarray) -> np.ndarray:
+    """Elementwise popcount parity of a uint64 array. ``np.bitwise_count``
+    when this numpy has it (>= 2.0); otherwise the xor-fold parity
+    trick (six shifts — parity is all the Hadamard sign needs)."""
+    if hasattr(np, "bitwise_count"):
+        return np.bitwise_count(a) & np.uint64(1)
+    for shift in (32, 16, 8, 4, 2, 1):
+        a = a ^ (a >> np.uint64(shift))
+    return a & np.uint64(1)
+
+
+def _srht_panel(idx: np.ndarray, d_diag: np.ndarray, lo: int, hi: int,
+                s_dim: int, dtype) -> np.ndarray:
+    """Columns [lo, hi) of the WHT-FJLT operator in closed form:
+    the Sylvester Hadamard entry at (sampled row, position) times the
+    Rademacher diagonal, scaled to ``1/sqrt(s)`` (the FJLT's
+    ``sqrt(n/s)`` times the WHT's ``1/sqrt(n)``)."""
+    cols = np.arange(lo, hi, dtype=np.uint64)
+    par = _popcount_parity(idx[:, None].astype(np.uint64)
+                           & cols[None, :])
+    signs = (1.0 - 2.0 * par).astype(dtype)
+    return (signs * d_diag[lo:hi]) / np.asarray(
+        math.sqrt(s_dim), dtype)
+
+
+class SessionState:
+    """One live session's maintained sketch + positional cursor.
+
+    ``rows`` is the stream position (how many rows are folded in),
+    ``seq`` the last applied append sequence number (the idempotency
+    cursor the journal replays against). The accumulators are jnp
+    arrays; :meth:`arrays`/:meth:`load` move them to/from host bytes
+    for checkpointing without rounding."""
+
+    def __init__(self, spec: SessionSpec):
+        import jax.numpy as jnp
+
+        from libskylark_tpu.base.context import Context
+
+        self.spec = spec.validate()
+        self.rows = 0
+        self.seq = 0
+        dt = np.dtype(spec.dtype)
+        ctx = Context(seed=int(spec.seed))
+        self._h = self._v = None
+        self._jlt = None
+        self._srht = None
+        self._rft = None
+        if spec.kind == "cwt":
+            from libskylark_tpu.sketch.hash import CWT
+
+            t = CWT(spec.n, spec.s_dim, ctx)
+            self._h = np.asarray(t.bucket_indices())
+            self._v = np.asarray(t.values(jnp.dtype(dt)))
+        elif spec.kind in ("jlt", "isvd"):
+            from libskylark_tpu.sketch.dense import JLT
+
+            self._jlt = JLT(spec.n, spec.s_dim, ctx)
+        elif spec.kind == "srht":
+            from libskylark_tpu.sketch.fjlt import FJLT
+
+            t = FJLT(spec.n, spec.s_dim, ctx, fut="wht")
+            self._srht = (np.asarray(t.sample_indices()),
+                          np.asarray(t.diagonal(jnp.dtype(dt))))
+        else:  # krr
+            from libskylark_tpu.sketch.rft import GaussianRFT
+
+            self._rft = GaussianRFT(spec.d, spec.s_dim, ctx,
+                                    sigma=float(spec.sigma))
+        # eager accumulator init: a zero-append session checkpoints and
+        # resumes like any other
+        if spec.kind == "krr":
+            self.acc = {
+                "G": jnp.zeros((spec.s_dim, spec.s_dim), dt),
+                "b": jnp.zeros((spec.s_dim, spec.targets), dt),
+            }
+        else:
+            self.acc = {"SX": jnp.zeros((spec.s_dim, spec.d), dt)}
+            if spec.targets:
+                self.acc["SY"] = jnp.zeros((spec.s_dim, spec.targets),
+                                           dt)
+
+    # -- batch intake ---------------------------------------------------
+
+    def coerce_batch(self, X, Y=None):
+        """Validate + canonicalize one append batch against the spec
+        (host arrays, spec dtype, row bound). Runs BEFORE the journal
+        write so a record that cannot fold is never made durable."""
+        s = self.spec
+        X = np.asarray(X, dtype=np.dtype(s.dtype))
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[1] != s.d:
+            raise errors.InvalidParametersError(
+                f"append batch must be (m, {s.d}), got {X.shape}")
+        if self.rows + X.shape[0] > s.n:
+            raise errors.InvalidParametersError(
+                f"append past the declared stream extent: "
+                f"{self.rows} + {X.shape[0]} > n={s.n}")
+        if s.targets:
+            if Y is None:
+                raise errors.InvalidParametersError(
+                    f"session carries {s.targets} target column(s); "
+                    "append needs Y")
+            Y = np.asarray(Y, dtype=np.dtype(s.dtype))
+            if Y.ndim == 1:
+                Y = Y[:, None]
+            if Y.shape != (X.shape[0], s.targets):
+                raise errors.InvalidParametersError(
+                    f"Y batch must be ({X.shape[0]}, {s.targets}), "
+                    f"got {Y.shape}")
+        else:
+            Y = None
+        return X, Y
+
+    def fold(self, X: np.ndarray, Y: Optional[np.ndarray]) -> None:
+        """Fold one coerced batch into the maintained sketch at the
+        current row position. Deterministic eager ops on the carried
+        accumulator — the replay invariant (module doc)."""
+        import jax.numpy as jnp
+
+        s = self.spec
+        lo, hi = self.rows, self.rows + X.shape[0]
+        Xj = jnp.asarray(X)
+        if s.kind == "cwt":
+            # scatter into the CARRIED accumulator in row order — the
+            # exact accumulation order of the one-shot CWT scatter
+            # (io/streaming.py proves the bit-equality)
+            h = jnp.asarray(self._h[lo:hi])
+            v = jnp.asarray(self._v[lo:hi])
+            self.acc["SX"] = self.acc["SX"].at[h].add(v[:, None] * Xj)
+            if Y is not None:
+                self.acc["SY"] = self.acc["SY"].at[h].add(
+                    v[:, None] * jnp.asarray(Y))
+        elif s.kind in ("jlt", "isvd"):
+            panel = self._jlt.s_panel(lo, hi, Xj.dtype)
+            self.acc["SX"] = self.acc["SX"] + panel @ Xj
+            if Y is not None:
+                self.acc["SY"] = self.acc["SY"] + panel @ jnp.asarray(Y)
+        elif s.kind == "srht":
+            idx, diag = self._srht
+            panel = jnp.asarray(_srht_panel(
+                idx, diag, lo, hi, s.s_dim, np.dtype(s.dtype)))
+            self.acc["SX"] = self.acc["SX"] + panel @ Xj
+            if Y is not None:
+                self.acc["SY"] = self.acc["SY"] + panel @ jnp.asarray(Y)
+        else:  # krr
+            from libskylark_tpu.sketch import ROWWISE
+
+            Z = self._rft.apply(Xj, ROWWISE)
+            self.acc["G"] = self.acc["G"] + Z.T @ Z
+            self.acc["b"] = self.acc["b"] + Z.T @ jnp.asarray(Y)
+        self.rows = hi
+
+    # -- checkpoint round trip ------------------------------------------
+
+    def arrays(self) -> dict:
+        """Host snapshot of the accumulators (exact bytes)."""
+        return {k: np.asarray(v) for k, v in self.acc.items()}
+
+    def load(self, arrays: dict, rows: int, seq: int) -> None:
+        import jax.numpy as jnp
+
+        for k in self.acc:
+            if k not in arrays:
+                raise errors.InvalidParametersError(
+                    f"checkpoint missing accumulator {k!r}")
+            if tuple(arrays[k].shape) != tuple(self.acc[k].shape):
+                raise errors.InvalidParametersError(
+                    f"checkpoint accumulator {k!r} has shape "
+                    f"{arrays[k].shape}, expected {self.acc[k].shape}")
+            self.acc[k] = jnp.asarray(arrays[k])
+        self.rows = int(rows)
+        self.seq = int(seq)
+
+    # -- finalize -------------------------------------------------------
+
+    def finalize(self) -> dict:
+        """The session's terminal result as host arrays: the maintained
+        sketch(es) for the appenders, the factorization/solution for
+        the composite kinds."""
+        import jax.numpy as jnp
+
+        s = self.spec
+        if s.kind == "krr":
+            lam = jnp.asarray(s.lam, self.acc["G"].dtype)
+            eye = jnp.eye(s.s_dim, dtype=self.acc["G"].dtype)
+            w = jnp.linalg.solve(self.acc["G"] + lam * eye,
+                                 self.acc["b"])
+            return {"coef": np.asarray(w), "rows": self.rows}
+        if s.kind == "isvd":
+            _, sv, Vt = jnp.linalg.svd(self.acc["SX"],
+                                       full_matrices=False)
+            k = s.k or min(s.s_dim, s.d)
+            return {"singular_values": np.asarray(sv[:k]),
+                    "Vt": np.asarray(Vt[:k]), "rows": self.rows}
+        out = {"SX": np.asarray(self.acc["SX"]), "rows": self.rows}
+        if "SY" in self.acc:
+            out["SY"] = np.asarray(self.acc["SY"])
+        return out
+
+
+__all__ = ["KINDS", "SessionSpec", "SessionState"]

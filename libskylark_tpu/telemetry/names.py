@@ -45,7 +45,17 @@ METRICS: Dict[str, str] = {
     "resilience.faults_fired": "counter",
     "resilience.retries": "counter",
     "resilience.health_transitions": "counter",
+    # stateful serve sessions (sessions/registry.py)
+    "sessions.opened": "counter",
+    "sessions.appends": "counter",
+    "sessions.finalized": "counter",
+    "sessions.evicted": "counter",
+    "sessions.resumed": "counter",
+    "sessions.replayed_records": "counter",
+    "sessions.checkpoints": "counter",
+    "sessions.live": "gauge",
     # fleet (fleet/router.py)
+    "fleet.session_handoffs": "counter",
     "fleet.routed": "counter",
     "fleet.affinity_hit": "counter",
     "fleet.failover": "counter",

@@ -16,11 +16,14 @@ __all__ = [
     "as_checkpointer",
     "device_state",
     "get_timer",
+    "load_sync",
+    "save_sync",
     "set_enabled",
     "timers_enabled",
 ]
 
-_CHECKPOINT_NAMES = ("TrainCheckpointer", "as_checkpointer", "device_state")
+_CHECKPOINT_NAMES = ("TrainCheckpointer", "as_checkpointer",
+                     "device_state", "save_sync", "load_sync")
 
 
 def __getattr__(name):

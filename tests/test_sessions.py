@@ -1,0 +1,546 @@
+"""Stateful serve sessions (libskylark_tpu/sessions/, docs/sessions).
+
+Oracles:
+
+- *one-shot equality*: a CWT session's finalize is BIT-equal to the
+  one-shot ``CWT.apply`` on the concatenated rows (the io/streaming
+  layout-independence invariant promoted into the serve layer); the
+  dense appenders (JLT/SRHT) are bit-equal to a replayed/uninterrupted
+  session and allclose to their one-shot transforms.
+- *survivability*: drain handoff (checkpoint + peer resume) and crash
+  replay (journal tail, torn-tail truncation, idempotent duplicate
+  sequence numbers) both finalize bit-equal to the uninterrupted
+  stream.
+- *degradation edges*: TTL expiry mid-append, finalize-after-evict,
+  deadline expiry and DEGRADED shed all resolve with the documented
+  error classes — never a hang.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from libskylark_tpu import Context, engine, fleet
+from libskylark_tpu import sessions
+from libskylark_tpu import sketch as sk
+from libskylark_tpu.base import errors as sk_errors
+from libskylark_tpu.engine.serve import ServeOverloadedError
+from libskylark_tpu.io.chunked import iter_array_batches
+from libskylark_tpu.resilience import faults
+from libskylark_tpu.sessions.journal import SessionJournal, scan
+
+
+@pytest.fixture()
+def sdir(tmp_path, monkeypatch):
+    d = str(tmp_path / "sessions")
+    monkeypatch.setenv("SKYLARK_SESSION_DIR", d)
+    return d
+
+
+def _rows(n=64, d=8, seed=0, dtype=np.float32):
+    return np.random.default_rng(seed).standard_normal(
+        (n, d)).astype(dtype)
+
+
+def _stream(reg, sid, A, batch=16, seq0=0):
+    seq = seq0
+    for Xb, _ in iter_array_batches(A, batch):
+        seq += 1
+        reg.append(sid, Xb, seq=seq)
+    return seq
+
+
+class TestOneShotEquality:
+    def test_cwt_session_bit_equal_to_one_shot(self, sdir):
+        A = _rows()
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="cwt", n=64, s_dim=16, d=8, seed=3))
+        _stream(reg, sid, A)
+        out = reg.finalize(sid)
+        ref = np.asarray(sk.CWT(64, 16, Context(seed=3)).apply(
+            jnp.asarray(A), sk.COLUMNWISE))
+        assert np.array_equal(out["SX"], ref)
+
+    def test_cwt_with_targets_matches_streaming_invariant(self, sdir):
+        A = _rows()
+        Y = _rows(64, 2, seed=7)
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="cwt", n=64, s_dim=16, d=8, seed=3, targets=2))
+        seq = 0
+        for Xb, Yb in iter_array_batches(A, 16, Y):
+            seq += 1
+            reg.append(sid, Xb, Y=Yb, seq=seq)
+        out = reg.finalize(sid)
+        T = sk.CWT(64, 16, Context(seed=3))
+        assert np.array_equal(
+            out["SY"], np.asarray(T.apply(jnp.asarray(Y),
+                                          sk.COLUMNWISE)))
+
+    @pytest.mark.parametrize("kind,cls", [("jlt", sk.JLT)])
+    def test_dense_session_allclose_to_one_shot(self, sdir, kind, cls):
+        A = _rows()
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind=kind, n=64, s_dim=16, d=8, seed=3))
+        _stream(reg, sid, A)
+        out = reg.finalize(sid)
+        ref = np.asarray(cls(64, 16, Context(seed=3)).apply(
+            jnp.asarray(A), sk.COLUMNWISE))
+        np.testing.assert_allclose(out["SX"], ref, atol=1e-4)
+
+    def test_srht_session_allclose_to_fjlt_wht(self, sdir):
+        A = _rows()
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="srht", n=64, s_dim=16, d=8, seed=3))
+        _stream(reg, sid, A)
+        out = reg.finalize(sid)
+        ref = np.asarray(sk.FJLT(64, 16, Context(seed=3),
+                                 fut="wht").apply(
+            jnp.asarray(A), sk.COLUMNWISE))
+        np.testing.assert_allclose(out["SX"], ref, atol=1e-4)
+
+    def test_popcount_parity_fallback_matches(self, monkeypatch):
+        """The numpy<2 xor-fold path must agree with bitwise_count —
+        srht operator bits may not depend on the numpy version."""
+        from libskylark_tpu.sessions.state import _popcount_parity
+
+        a = np.random.default_rng(0).integers(
+            0, 2**63, size=256, dtype=np.uint64)
+        ref = _popcount_parity(a)
+        monkeypatch.delattr(np, "bitwise_count", raising=False)
+        assert np.array_equal(_popcount_parity(a.copy()), ref)
+
+    def test_srht_requires_pow2_n(self, sdir):
+        with pytest.raises(sk_errors.InvalidParametersError):
+            sessions.SessionSpec(kind="srht", n=60, s_dim=16,
+                                 d=8).validate()
+
+    def test_isvd_finalize_matches_sketch_svd(self, sdir):
+        A = _rows()
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="isvd", n=64, s_dim=16, d=8, seed=3, k=4))
+        _stream(reg, sid, A)
+        out = reg.finalize(sid)
+        SX = np.asarray(sk.JLT(64, 16, Context(seed=3)).apply(
+            jnp.asarray(A), sk.COLUMNWISE))
+        sv = np.asarray(jnp.linalg.svd(jnp.asarray(SX),
+                                       compute_uv=False))
+        np.testing.assert_allclose(out["singular_values"], sv[:4],
+                                   rtol=1e-3)
+        assert out["Vt"].shape == (4, 8)
+
+    def test_krr_session_solves_ridge_normal_equations(self, sdir):
+        A = _rows(48, 6, seed=2)
+        Y = _rows(48, 1, seed=5)
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="krr", n=48, s_dim=12, d=6, seed=4, targets=1,
+            lam=0.1))
+        seq = 0
+        for Xb, Yb in iter_array_batches(A, 16, Y):
+            seq += 1
+            reg.append(sid, Xb, Y=Yb, seq=seq)
+        out = reg.finalize(sid)
+        Z = np.asarray(sk.GaussianRFT(6, 12, Context(seed=4)).apply(
+            jnp.asarray(A), sk.ROWWISE))
+        ref = np.linalg.solve(Z.T @ Z + 0.1 * np.eye(12), Z.T @ Y)
+        np.testing.assert_allclose(out["coef"], ref, atol=1e-3)
+
+
+class TestLifecycleEdges:
+    def test_duplicate_seq_is_idempotent_noop(self, sdir):
+        A = _rows()
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="cwt", n=64, s_dim=16, d=8, seed=3))
+        reg.append(sid, A[:16], seq=1)
+        before = reg.rows(sid)
+        # duplicate replays (a crash-retry) change nothing
+        assert reg.append(sid, A[:16], seq=1) == before
+        assert reg.append(sid, A[:16], seq=1) == before
+        reg.append(sid, A[16:32], seq=2)
+        _stream(reg, sid, A[32:], batch=16, seq0=2)
+        out = reg.finalize(sid)
+        ref = np.asarray(sk.CWT(64, 16, Context(seed=3)).apply(
+            jnp.asarray(A), sk.COLUMNWISE))
+        assert np.array_equal(out["SX"], ref)
+        assert reg.stats()["duplicates"] == 2
+
+    def test_sequence_gap_refuses(self, sdir):
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="cwt", n=64, s_dim=16, d=8))
+        with pytest.raises(sk_errors.InvalidParametersError,
+                           match="gap"):
+            reg.append(sid, _rows()[:16], seq=3)
+
+    def test_ttl_expiry_mid_append_evicts(self, sdir, monkeypatch):
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="cwt", n=64, s_dim=16, d=8, ttl_s=30.0))
+        A = _rows()
+        reg.append(sid, A[:16], seq=1)
+        # advance the clock past the TTL without sleeping
+        import libskylark_tpu.sessions.registry as reg_mod
+
+        real = reg_mod.time.monotonic
+        monkeypatch.setattr(reg_mod.time, "monotonic",
+                            lambda: real() + 31.0)
+        with pytest.raises(sk_errors.SessionEvictedError,
+                           match="TTL"):
+            reg.append(sid, A[16:32], seq=2)
+        # terminal: artifacts are gone, the id is tombstoned
+        assert not os.path.exists(
+            os.path.join(sdir, f"{sid}.journal"))
+        with pytest.raises(sk_errors.SessionEvictedError):
+            reg.finalize(sid)
+        assert reg.stats()["evicted"] == 1
+
+    def test_finalize_after_evict_raises_not_hangs(self, sdir):
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="cwt", n=64, s_dim=16, d=8))
+        reg.evict(sid, "operator")
+        with pytest.raises(sk_errors.SessionEvictedError):
+            reg.finalize(sid)
+        # and so does a peer registry over the same (now empty) dir
+        peer = sessions.SessionRegistry(directory=sdir)
+        with pytest.raises(sk_errors.SessionEvictedError):
+            peer.finalize(sid)
+
+    def test_unknown_session_raises_evicted(self, sdir):
+        reg = sessions.SessionRegistry(directory=sdir)
+        with pytest.raises(sk_errors.SessionEvictedError):
+            reg.append("nosuch", _rows()[:4])
+
+    def test_open_rejects_collisions(self, sdir):
+        reg = sessions.SessionRegistry(directory=sdir)
+        spec = sessions.SessionSpec(kind="cwt", n=64, s_dim=16, d=8)
+        reg.open(spec, session_id="dup")
+        with pytest.raises(sk_errors.InvalidParametersError):
+            reg.open(spec, session_id="dup")
+
+    def test_append_past_declared_extent_refuses(self, sdir):
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="cwt", n=16, s_dim=8, d=8))
+        reg.append(sid, _rows(16))
+        with pytest.raises(sk_errors.InvalidParametersError,
+                           match="extent"):
+            reg.append(sid, _rows(16))
+
+
+class TestJournalAndReplay:
+    def test_crash_replay_from_journal_bit_equal(self, sdir):
+        A = _rows()
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="cwt", n=64, s_dim=16, d=8, seed=3),
+            session_id="crashy")
+        reg.append(sid, A[:16], seq=1)
+        reg.append(sid, A[16:32], seq=2)
+        # a kill -9 writes no checkpoint and closes nothing: simulate
+        # by just abandoning the registry (the journal was flushed per
+        # append). The peer resumes by replaying the journal, and the
+        # client's crash-retry of seq 2 is a duplicate no-op.
+        peer = sessions.SessionRegistry(directory=sdir)
+        assert peer.append(sid, A[16:32], seq=2) == (2, 32)
+        peer.append(sid, A[32:48], seq=3)
+        peer.append(sid, A[48:], seq=4)
+        out = peer.finalize(sid)
+        ref = np.asarray(sk.CWT(64, 16, Context(seed=3)).apply(
+            jnp.asarray(A), sk.COLUMNWISE))
+        assert np.array_equal(out["SX"], ref)
+        assert peer.stats()["resumed"] == 1
+        assert peer.stats()["replayed_records"] == 2
+
+    def test_torn_tail_truncated_and_recovered(self, sdir):
+        A = _rows()
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="cwt", n=64, s_dim=16, d=8, seed=3),
+            session_id="torn")
+        reg.append(sid, A[:16], seq=1)
+        reg.append(sid, A[16:32], seq=2)
+        jpath = os.path.join(sdir, f"{sid}.journal")
+        # tear the tail: half a record of garbage, as a crash mid-write
+        # would leave
+        with open(jpath, "ab") as fh:
+            fh.write(b"\x40\x00\x00\x00\x99\x99torn-partial-record")
+        records, good = scan(jpath)
+        assert [s for s, _ in records] == [1, 2]
+        peer = sessions.SessionRegistry(directory=sdir)
+        peer.append(sid, A[32:48], seq=3)
+        peer.append(sid, A[48:], seq=4)
+        out = peer.finalize(sid)
+        ref = np.asarray(sk.CWT(64, 16, Context(seed=3)).apply(
+            jnp.asarray(A), sk.COLUMNWISE))
+        assert np.array_equal(out["SX"], ref)
+
+    def test_journal_rejects_foreign_file(self, tmp_path):
+        p = str(tmp_path / "not_a_journal")
+        with open(p, "wb") as fh:
+            fh.write(b"definitely not the magic")
+        with pytest.raises(sk_errors.IOError_, match="magic"):
+            scan(p)
+
+    def test_fsync_batching_counts(self, tmp_path):
+        j = SessionJournal.create(str(tmp_path / "j"), fsync_every=3)
+        for i in range(1, 5):
+            j.append(i, {"X": np.zeros((1, 1), np.float32)})
+        j.close()
+        records, _ = scan(str(tmp_path / "j"))
+        assert [s for s, _ in records] == [1, 2, 3, 4]
+
+    def test_checkpoint_generations_cannot_mix(self, tmp_path):
+        """The npz is the one unit of atomicity: metadata rides inside
+        it, so a stale (previous-generation) forensics sidecar can
+        never pair with new arrays — the double-fold hazard a
+        two-file commit scheme had."""
+        from libskylark_tpu.utility import checkpoint as ckpt
+
+        p = str(tmp_path / "ck")
+        ckpt.save_sync(p, {"a": np.ones(3, np.float32)}, {"seq": 1})
+        ckpt.save_sync(p, {"a": np.full(3, 2.0, np.float32)},
+                       {"seq": 3})
+        # poison the sidecar back to generation 1: load must not care
+        with open(p + ".json", "w") as fh:
+            fh.write('{"seq": 1}')
+        arrays, meta = ckpt.load_sync(p)
+        assert meta["seq"] == 3
+        assert np.array_equal(arrays["a"], np.full(3, 2.0, np.float32))
+        with pytest.raises(ValueError, match="reserved"):
+            ckpt.save_sync(p, {"__meta__": np.ones(1)}, {})
+
+    def test_checkpoint_bounds_replay(self, sdir):
+        A = _rows()
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="jlt", n=64, s_dim=16, d=8, seed=3),
+            session_id="ckpt")
+        reg.append(sid, A[:16], seq=1)
+        reg.append(sid, A[16:32], seq=2)
+        reg.checkpoint(sid)
+        reg.append(sid, A[32:48], seq=3)  # journal-only tail
+        # uninterrupted control
+        ctrl = sessions.SessionRegistry(
+            directory=str(sdir) + "_ctrl")
+        csid = ctrl.open(sessions.SessionSpec(
+            kind="jlt", n=64, s_dim=16, d=8, seed=3))
+        for i in range(4):
+            ctrl.append(csid, A[i * 16:(i + 1) * 16], seq=i + 1)
+        peer = sessions.SessionRegistry(directory=sdir)
+        peer.append(sid, A[48:], seq=4)
+        out = peer.finalize(sid)
+        # resumed from checkpoint (not a full journal replay): only
+        # the post-checkpoint record re-folded
+        assert peer.stats()["replayed_records"] == 1
+        ref = ctrl.finalize(csid)
+        assert np.array_equal(out["SX"], ref["SX"])
+
+
+class TestExecutorIntegration:
+    def test_drain_checkpoints_and_peer_resumes(self, sdir):
+        A = _rows()
+        ex = engine.MicrobatchExecutor(name="sess-a")
+        sid = ex.open_sketch_session("cwt", n=64, s_dim=16, d=8,
+                                     seed=3)
+        assert ex.session_append(sid, A[:16], seq=1).result() == (1, 16)
+        assert ex.session_append(sid, A[16:32],
+                                 seq=2).result() == (2, 32)
+        assert ex.drain(timeout=10.0)
+        # drained executors refuse session intake like any other
+        with pytest.raises(ServeOverloadedError):
+            raise ex.session_append(sid, A[32:48], seq=3).exception()
+        peer = engine.MicrobatchExecutor(name="sess-b")
+        assert peer.session_append(sid, A[32:48],
+                                   seq=3).result() == (3, 48)
+        peer.session_append(sid, A[48:], seq=4).result()
+        out = peer.session_finalize(sid).result()
+        ref = np.asarray(sk.CWT(64, 16, Context(seed=3)).apply(
+            jnp.asarray(A), sk.COLUMNWISE))
+        assert np.array_equal(out["SX"], ref)
+        assert peer.stats()["sessions"]["resumed"] == 1
+        peer.shutdown()
+
+    def test_expired_deadline_resolves_overloaded(self, sdir):
+        ex = engine.MicrobatchExecutor(name="sess-dl")
+        sid = ex.open_sketch_session("cwt", n=64, s_dim=16, d=8)
+        fut = ex.session_append(sid, _rows()[:16], deadline=-1.0)
+        with pytest.raises(ServeOverloadedError, match="deadline"):
+            fut.result(timeout=1.0)
+        # the expired append was never journaled
+        assert ex.sessions.rows(sid) == (0, 0)
+        ex.shutdown()
+
+    def test_degraded_sheds_sessions_before_interactive(self, sdir):
+        ex = engine.MicrobatchExecutor(name="sess-deg",
+                                       failure_window=4)
+        sid = ex.open_sketch_session("cwt", n=64, s_dim=16, d=8)
+        with ex._stats_lock:
+            for _ in range(4):
+                ex._health.append(1.0)
+        assert ex.state == engine.DEGRADED
+        fut = ex.session_append(sid, _rows()[:16], seq=1)
+        with pytest.raises(ServeOverloadedError, match="DEGRADED"):
+            fut.result(timeout=1.0)
+        assert ex.stats()["session_shed"] == 1
+        # interactive one-shots still admit below the shed bound
+        T = sk.CWT(64, 16, Context(seed=0))
+        r = ex.submit_sketch(T, _rows().astype(np.float32))
+        ex.flush()
+        assert r.result(timeout=30.0).shape == (16, 8)
+        ex.shutdown()
+
+    def test_session_faults_are_injectable(self, sdir):
+        ex = engine.MicrobatchExecutor(name="sess-fault")
+        sid = ex.open_sketch_session("cwt", n=64, s_dim=16, d=8,
+                                     seed=3)
+        A = _rows()
+        plan = {"seed": 7, "faults": [
+            {"site": "session.append", "error": "IOError_",
+             "on_hit": 2}]}
+        with faults.fault_plan(plan) as p:
+            assert ex.session_append(sid, A[:16],
+                                     seq=1).result() == (1, 16)
+            fut = ex.session_append(sid, A[16:32], seq=2)
+            with pytest.raises(sk_errors.IOError_):
+                fut.result(timeout=1.0)
+            # the fault fired BEFORE the journal write: the retry of
+            # the same seq lands exactly once
+            assert ex.session_append(sid, A[16:32],
+                                     seq=2).result() == (2, 32)
+            assert p.fired == [("session.append", 2, "IOError_")]
+        ex.shutdown()
+
+
+class TestFleetSessions:
+    def test_owner_preempt_hands_off_bit_equal(self, sdir):
+        A = _rows()
+        pool = fleet.ReplicaPool(2, max_batch=4)
+        router = fleet.Router(pool)
+        try:
+            sid = router.open_sketch_session(
+                "cwt", n=64, s_dim=16, d=8, seed=11)
+            owner = router.session_owner(sid)
+            assert router.session_append(sid, A[:16],
+                                         seq=1).result() == (1, 16)
+            pool.preempt_replica(owner)
+            for i in range(1, 4):
+                router.session_append(
+                    sid, A[i * 16:(i + 1) * 16],
+                    seq=i + 1).result(timeout=10.0)
+            new_owner = router.session_owner(sid)
+            assert new_owner != owner
+            out = router.session_finalize(sid).result(timeout=10.0)
+            ref = np.asarray(sk.CWT(64, 16, Context(seed=11)).apply(
+                jnp.asarray(A), sk.COLUMNWISE))
+            assert np.array_equal(out["SX"], ref)
+            assert router.stats()["session_handoffs"] >= 1
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_owner_pin_and_assignment_introspection(self, sdir):
+        pool = fleet.ReplicaPool(2, max_batch=4)
+        router = fleet.Router(pool)
+        try:
+            sid = router.open_sketch_session(
+                "cwt", n=16, s_dim=8, d=4, owner="r1")
+            assert router.session_owner(sid) == "r1"
+            assert router.stats()["sessions_assigned"] == 1
+        finally:
+            router.close()
+            pool.shutdown()
+
+
+class TestCrashFaultSpec:
+    def test_crash_mutually_exclusive_with_error_and_stall(self):
+        with pytest.raises(sk_errors.InvalidParametersError):
+            faults.FaultPlan({"faults": [
+                {"site": "session.append", "crash": True,
+                 "error": "IOError_"}]})
+        with pytest.raises(sk_errors.InvalidParametersError):
+            faults.FaultPlan({"faults": [
+                {"site": "session.append", "crash": True,
+                 "stall_s": 1.0}]})
+
+    def test_crash_fires_os_exit(self, monkeypatch):
+        killed = []
+        monkeypatch.setattr(faults.os, "_exit",
+                            lambda code: killed.append(code))
+        plan = {"seed": 1, "faults": [
+            {"site": "session.append", "crash": True, "on_hit": 2}]}
+        with faults.fault_plan(plan) as p:
+            faults.check("session.append")
+            faults.check("session.append")
+        assert killed == [137]
+        assert p.fired == [("session.append", 2, "crash")]
+
+    def test_crash_spec_json_round_trip(self):
+        plan = faults.FaultPlan.parse(json.dumps(
+            {"faults": [{"site": "serve.flush", "crash": True}]}))
+        assert plan.specs[0].crash
+        assert plan.specs[0].error_name == "crash"
+
+
+@pytest.mark.slow
+class TestProcessReplicaSessions:
+    def test_crash_fault_kills_child_and_peer_replays(
+            self, sdir, tmp_path):
+        """The full crash tier over real processes: a crash-fault
+        kills the owner child mid-session (deterministically, no
+        kill -9 shell-out), the pool reaps the dead member, and the
+        client's retry replays onto the peer from the journal —
+        finalize bit-equal to the uninterrupted stream."""
+        A = _rows()
+        crash_plan = json.dumps({"seed": 7, "faults": [
+            {"site": "session.append", "crash": True, "on_hit": 3}]})
+
+        def victim_env(name):
+            return ({"SKYLARK_FAULT_PLAN": crash_plan}
+                    if name == "r0" else None)
+
+        pool = fleet.ReplicaPool(2, backend="process", max_batch=4,
+                                 replica_env=victim_env)
+        router = fleet.Router(pool)
+        try:
+            sid = router.open_sketch_session(
+                "cwt", n=64, s_dim=16, d=8, seed=13, owner="r0")
+            ok = 0
+            seq = 0
+            while ok < 4:
+                fut = router.session_append(
+                    sid, A[ok * 16:(ok + 1) * 16], seq=ok + 1)
+                try:
+                    seq, _rows_now = fut.result(timeout=60.0)
+                    ok += 1
+                except Exception:
+                    # the crash: retry the same seq — idempotent on
+                    # the resuming peer
+                    import time as _t
+
+                    _t.sleep(0.2)
+            assert seq == 4
+            out = router.session_finalize(sid).result(timeout=60.0)
+            ref = np.asarray(sk.CWT(64, 16, Context(seed=13)).apply(
+                jnp.asarray(A), sk.COLUMNWISE))
+            assert np.array_equal(out["SX"], ref)
+            # the pool reaped the crashed member (satellite: the
+            # crash-then-shrink hole)
+            assert pool.crashed_names() == ["r0"]
+            assert "r0" not in pool.names()
+            assert router.stats()["session_handoffs"] >= 1
+        finally:
+            router.close()
+            pool.shutdown()
