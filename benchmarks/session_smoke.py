@@ -7,8 +7,9 @@ two resilience tiers the chaos battery's in-process leg cannot:
   process replica of a 2-replica fleet; mid-stream the owner gets a
   real SIGTERM (``ReplicaPool.preempt_replica`` — the child's r9
   preemption handler drains its executor, which checkpoints the live
-  session), the router's session-affinity epoch re-resolves to the
-  peer, the peer resumes from the checkpoint, and the stream
+  session), the owner leaves the router's ring so the next verb
+  re-resolves ownership to the peer (fencing the drained owner's
+  lease), the peer resumes from the checkpoint, and the stream
   continues. Asserts: the peer resumed from a *checkpoint* (not a
   full journal replay), at least one counted handoff, zero
   client-visible failures, finalize **bit-equal** to the one-shot

@@ -387,7 +387,23 @@ def _worker_main(conn, name: str, executor_kwargs: dict,
                 # go back through the standard reply path
                 op, kwargs = msg[2], msg[3]
                 if op == "open":
-                    send(("rpc", rid, ex.open_sketch_session(**kwargs)))
+                    # NOT inline: an open builds the O(n) positional
+                    # streams and runs three fsyncs — serviced on the
+                    # loop thread it would stall every queued submit
+                    # and stats/depth probe on this replica for the
+                    # duration. ``send`` is lock-protected (replies
+                    # already cross threads), so a one-shot thread
+                    # keeps the loop responsive.
+                    def _open_reply(rid=rid, kwargs=kwargs):
+                        try:
+                            send(("rpc", rid,
+                                  ex.open_sketch_session(**kwargs)))
+                        except Exception as e:  # noqa: BLE001
+                            _send_exception(send, rid, e)
+
+                    threading.Thread(target=_open_reply,
+                                     name=f"{name}-session-open",
+                                     daemon=True).start()
                 elif op == "append":
                     fut = ex.session_append(**kwargs)
                     fut.add_done_callback(functools.partial(reply, rid))

@@ -69,12 +69,14 @@ import uuid
 import warnings
 import weakref
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutTimeout
 from typing import Iterable, Optional
 
 import numpy as np
 
 from libskylark_tpu import telemetry as _telemetry
 from libskylark_tpu.base import env as _env
+from libskylark_tpu.base import errors as _errors
 from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.engine import serve as _serve
 from libskylark_tpu.fleet.pool import ReplicaPool
@@ -270,11 +272,15 @@ class Router:
         self._assign: dict = {}        # statics -> (epoch, owner name)
         self._owned = collections.Counter()
         # stateful-session affinity (docs/sessions): sid -> (epoch,
-        # owner). The epoch is the session-affinity epoch — it bumps
-        # with every ring membership change, so an assignment made
-        # before a drain/crash is stale by construction and the next
-        # touch re-resolves (a handoff) to a surviving owner, which
-        # resumes the session from SKYLARK_SESSION_DIR
+        # owner). Unlike bucket affinity, a session assignment is NOT
+        # re-derived on every membership change: the recorded owner
+        # holds the session's live state and journal lease, so it
+        # stays authoritative for as long as it remains on the ring
+        # (ring GROWTH must not move a live session). Only when the
+        # owner actually leaves (drain/crash) does the next touch
+        # re-resolve (a handoff) to a surviving owner, which resumes
+        # the session from SKYLARK_SESSION_DIR; the epoch stamp
+        # anchors assignments against hub history for forensics
         self._sessions: dict = {}      # sid -> (epoch, owner name)
         # where this router's current epoch sits on the hub's global
         # transition timeline (resilience.health.transition_seq) —
@@ -769,20 +775,50 @@ class Router:
         last_err: Optional[BaseException] = None
         for name in order:
             # same failover walk as every other fleet dispatch: a
-            # candidate that refuses the open (drain race, dead pipe,
-            # an injected ``fleet.route`` fault) moves it to the next
-            # — the registry open is side-effect-free on refusal. An
-            # explicit ``owner`` pin does NOT fail over: a pin means
-            # exactly that replica (tests, chaos legs).
+            # candidate that REFUSES the open (drain race, dead pipe,
+            # an injected ``fleet.route`` fault, a future resolved
+            # with a refusal) moves it to the next — the registry
+            # open is side-effect-free on refusal. An explicit
+            # ``owner`` pin does NOT fail over: a pin means exactly
+            # that replica (tests, chaos legs).
             try:
                 faults.check("fleet.route", tags=tags,
                              detail=f"session:open {sid} -> {name}")
                 fut = self._pool.get(name).session(
                     "open", kind=kind, session_id=sid, **spec_kwargs)
-                sid = fut.result(timeout=timeout)
             except (KeyboardInterrupt, SystemExit):
                 raise
             except BaseException as e:  # noqa: BLE001 — failover
+                last_err = e
+                if owner:
+                    raise
+                with self._lock:
+                    self._counts["failover"] += 1
+                _FAILOVER.inc(replica=name)
+                continue
+            try:
+                sid = fut.result(timeout=timeout)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except (_FutTimeout, TimeoutError):
+                # a result TIMEOUT is not a refusal: the open may
+                # have succeeded (or still land) on this replica, so
+                # moving on would orphan a live session whose on-disk
+                # state then makes every peer refuse the id. Pin the
+                # assignment where the open was dispatched and
+                # surface the timeout to the caller instead.
+                with self._lock:
+                    self._sessions[sid] = (self._epoch, name)
+                raise _errors.CommunicationError(
+                    f"session open {sid!r} on replica {name!r} did "
+                    f"not resolve within {timeout}s; the open may "
+                    f"still have landed there — the id stays pinned "
+                    f"to {name!r}: retry a session verb against it, "
+                    "or evict the id") from None
+            except BaseException as e:  # noqa: BLE001 — failover
+                # resolved refusal (drain race, shed): the registry
+                # open is side-effect-free on refusal, so the next
+                # candidate is safe to try
                 last_err = e
                 if owner:
                     raise
@@ -811,16 +847,22 @@ class Router:
                      + [n for n in pref if n in degraded])
 
     def _session_owner(self, sid: str) -> str:
-        """Resolve a session's owner under the session-affinity epoch:
-        a cached assignment from the current epoch (owner still on the
-        ring) is authoritative; anything else re-resolves against the
-        surviving membership — a **handoff** when the owner actually
-        changed (the new owner resumes the session from
-        ``SKYLARK_SESSION_DIR`` on its first touch)."""
+        """Resolve a session's owner: a recorded assignment stays
+        authoritative for as long as that replica is on the ring — it
+        holds the session's live state and journal lease, so a ring
+        membership change that did NOT remove it (an autoscale
+        scale-up, a peer draining) must not move the session. Only
+        when the owner actually left the ring does the id re-resolve
+        against the surviving membership — a **handoff**: the new
+        owner resumes the session from ``SKYLARK_SESSION_DIR`` on its
+        first touch, fencing the old one at the storage layer."""
         with self._lock:
             entry = self._sessions.get(sid)
-            if (entry is not None and entry[0] == self._epoch
-                    and entry[1] in self._ring):
+            if entry is not None and entry[1] in self._ring:
+                if entry[0] != self._epoch:
+                    # the membership changed around the owner; refresh
+                    # the stamp, keep the assignment
+                    self._sessions[sid] = (self._epoch, entry[1])
                 return entry[1]
         new = self._session_candidates(sid)[0]
         self._note_session_owner(sid, new)
@@ -864,6 +906,22 @@ class Router:
                 _FAILOVER.inc(replica=name)
                 continue
             self._note_session_owner(sid, name)
+
+            def _scrub(f, _sid=sid):
+                # a session that ended any way other than a routed
+                # finalize (TTL eviction, fencing) must not leak its
+                # affinity entry forever — the registry tombstone
+                # carries the terminal error from here on
+                try:
+                    evicted = isinstance(f.exception(),
+                                         _errors.SessionEvictedError)
+                except BaseException:  # noqa: BLE001 — CancelledError
+                    evicted = False
+                if evicted:
+                    with self._lock:
+                        self._sessions.pop(_sid, None)
+
+            fut.add_done_callback(_scrub)
             return fut
         raise NoHealthyReplicaError(
             f"no replica accepted session {op!r} for {sid!r}: tried "

@@ -5,15 +5,21 @@ One file per session under the registry directory. Record layout::
 
     [u32 payload length][u32 crc32 of payload][payload bytes]
 
-after an 8-byte file magic (``SKYJRNL1``). The payload is a pickled
-``(seq, {"X": ndarray, "Y": ndarray | None})`` tuple — exact bytes, so
-replaying a record re-folds exactly the batch the client sent.
+after an 8-byte file magic (``SKYJRNL2``). The payload is a small
+JSON header (``{"seq", "keys"}``) followed by one raw ``.npy`` body
+per batch key — **nothing in a record is executable**. The journal
+lives under a shared, sometimes world-visible directory, so a planted
+file must never be able to run code in the serving process; ``.npy``
+bodies are read with ``allow_pickle=False`` and reproduce the exact
+bytes of the batch the client sent, so replaying a record re-folds
+exactly that batch.
 
 Durability discipline (docs/sessions, "Journal format"):
 
-- every append **flushes** to the OS page cache before returning — a
-  ``kill -9``'d replica loses nothing already accepted (the OS holds
-  the bytes; only a whole-machine crash can drop them);
+- every append is one unbuffered ``write(2)`` straight to the OS page
+  cache before returning — a ``kill -9``'d replica loses nothing
+  already accepted (the OS holds the bytes; only a whole-machine crash
+  can drop them);
 - every ``SKYLARK_SESSION_FSYNC_EVERY``-th append (default 8) also
   **fsyncs**, bounding what a machine crash can lose; drain/checkpoint
   paths call :meth:`sync` to force the bound to zero.
@@ -25,21 +31,56 @@ truncates the file back to the intact prefix, so a resumed session
 replays exactly the accepted appends and the retried tail append lands
 cleanly after them (idempotent sequence numbers make the overlap a
 no-op either way).
+
+A torn record must never be left MID-file either: a failed or short
+append write (ENOSPC, a transient I/O error) rolls the file back to
+the pre-write offset before the error surfaces, so the intact prefix
+always covers every acknowledged record. If even the rollback fails,
+the journal is **poisoned** — further appends refuse with the original
+cause — because appending past damage would make ``scan`` silently
+drop every later (acknowledged!) record at replay time.
 """
 
 from __future__ import annotations
 
+import io
+import json
 import os
-import pickle
 import struct
 import zlib
 from typing import Iterator, Optional, Tuple
 
+import numpy as np
+
 from libskylark_tpu.base import env as _env
 from libskylark_tpu.base import errors
 
-MAGIC = b"SKYJRNL1"
+MAGIC = b"SKYJRNL2"
 _HDR = struct.Struct("<II")
+_PHDR = struct.Struct("<I")
+
+
+def _encode_record(seq: int, batch: dict) -> bytes:
+    """JSON header + raw ``.npy`` array bodies (module doc: the
+    payload carries data only, never executable state)."""
+    keys = sorted(batch)
+    head = json.dumps({"seq": int(seq), "keys": keys}).encode("utf-8")
+    buf = io.BytesIO()
+    buf.write(_PHDR.pack(len(head)))
+    buf.write(head)
+    for k in keys:
+        np.lib.format.write_array(buf, np.asarray(batch[k]),
+                                  allow_pickle=False)
+    return buf.getvalue()
+
+
+def _decode_record(payload: bytes) -> Tuple[int, dict]:
+    buf = io.BytesIO(payload)
+    (hlen,) = _PHDR.unpack(buf.read(_PHDR.size))
+    head = json.loads(buf.read(hlen).decode("utf-8"))
+    batch = {str(k): np.lib.format.read_array(buf, allow_pickle=False)
+             for k in head["keys"]}
+    return int(head["seq"]), batch
 
 
 def scan(path: str) -> Tuple[list, int]:
@@ -53,6 +94,12 @@ def scan(path: str) -> Tuple[list, int]:
     with open(path, "rb") as fh:
         magic = fh.read(len(MAGIC))
         if magic != MAGIC:
+            if magic == b"SKYJRNL1":
+                raise errors.IOError_(
+                    f"{path} is a version-1 session journal (pickle "
+                    "payloads) — this build reads only "
+                    f"{MAGIC.decode()}; v1 never shipped, delete the "
+                    "artifacts and re-open the session")
             raise errors.IOError_(
                 f"{path} is not a session journal (bad magic)")
         good = fh.tell()
@@ -65,16 +112,20 @@ def scan(path: str) -> Tuple[list, int]:
             if len(payload) < length or zlib.crc32(payload) != crc:
                 break                      # torn tail: stop at damage
             try:
-                seq, batch = pickle.loads(payload)
-            except Exception:              # noqa: BLE001 — torn pickle
+                seq, batch = _decode_record(payload)
+            except Exception:              # noqa: BLE001 — torn payload
                 break
-            records.append((int(seq), batch))
+            records.append((seq, batch))
             good = fh.tell()
     return records, good
 
 
 class SessionJournal:
-    """Writer half: append-only with batched fsync (module doc)."""
+    """Writer half: append-only with batched fsync (module doc).
+    The file is opened unbuffered, so every record is a single
+    ``write(2)`` and nothing ever sits in a userspace buffer that an
+    :meth:`abandon` (the fenced-owner path) could accidentally flush
+    into a file this process no longer owns."""
 
     def __init__(self, path: str, fsync_every: Optional[int] = None):
         self.path = path
@@ -83,14 +134,14 @@ class SessionJournal:
             else _env.SESSION_FSYNC_EVERY.get()), 1)
         self._since_sync = 0
         self._fh = None
+        self._failed: Optional[str] = None
 
     @classmethod
     def create(cls, path: str,
                fsync_every: Optional[int] = None) -> "SessionJournal":
         j = cls(path, fsync_every)
-        fh = open(path, "xb")
+        fh = open(path, "xb", buffering=0)
         fh.write(MAGIC)
-        fh.flush()
         os.fsync(fh.fileno())
         j._fh = fh
         return j
@@ -105,7 +156,7 @@ class SessionJournal:
         j = cls(path, fsync_every)
         if not os.path.exists(path):
             return cls.create(path, fsync_every), records
-        fh = open(path, "r+b")
+        fh = open(path, "r+b", buffering=0)
         fh.truncate(good)
         fh.seek(good)
         j._fh = fh
@@ -114,25 +165,73 @@ class SessionJournal:
     def append(self, seq: int, batch: dict) -> None:
         """Make one append durable (see the module durability
         discipline). The caller folds only after this returns."""
-        payload = pickle.dumps((int(seq), batch), protocol=4)
-        self._fh.write(_HDR.pack(len(payload), zlib.crc32(payload)))
-        self._fh.write(payload)
-        self._fh.flush()
+        if self._failed is not None:
+            raise errors.IOError_(
+                f"session journal {self.path} refused the append: a "
+                f"previous write failed unrecoverably "
+                f"({self._failed}); the intact prefix still covers "
+                "every acknowledged record — resume elsewhere")
+        payload = _encode_record(seq, batch)
+        rec = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+        start = self._fh.tell()
+        short = False
+        try:
+            n = self._fh.write(rec)
+            short = n is not None and n != len(rec)
+        except OSError as e:
+            self._rollback(start, e)
+            raise
+        if short:
+            e = errors.IOError_(
+                f"short write appending to {self.path} "
+                "(disk full?)")
+            self._rollback(start, e)
+            raise e
         self._since_sync += 1
         if self._since_sync >= self._fsync_every:
-            os.fsync(self._fh.fileno())
+            try:
+                os.fsync(self._fh.fileno())
+            except OSError as e:
+                # post-failure fsync semantics are undefined (the
+                # kernel may drop the dirty pages and clear the
+                # error): the machine-crash durability bound cannot
+                # be promised any more, so poison the journal
+                self._failed = f"fsync failed: {e}"
+                raise
             self._since_sync = 0
+
+    def _rollback(self, offset: int, cause: BaseException) -> None:
+        """Truncate a torn record back off the tail so the file ends
+        at the intact prefix; poison the journal if that fails too."""
+        try:
+            self._fh.truncate(offset)
+            self._fh.seek(offset)
+        except OSError:
+            self._failed = f"rollback after failed write failed: {cause}"
 
     def sync(self) -> None:
         """Force the fsync bound to zero (drain/checkpoint paths)."""
         if self._fh is not None and not self._fh.closed:
-            self._fh.flush()
-            os.fsync(self._fh.fileno())
+            try:
+                os.fsync(self._fh.fileno())
+            except OSError as e:
+                self._failed = f"fsync failed: {e}"
+                raise
             self._since_sync = 0
 
     def close(self) -> None:
         if self._fh is not None and not self._fh.closed:
-            self.sync()
+            try:
+                self.sync()
+            finally:
+                self._fh.close()
+
+    def abandon(self) -> None:
+        """Close WITHOUT syncing — the fenced-owner path: another
+        replica owns this file now and this process must not touch
+        another byte of it (appends are unbuffered, so nothing is
+        lost)."""
+        if self._fh is not None and not self._fh.closed:
             self._fh.close()
 
 

@@ -53,6 +53,7 @@ METRICS: Dict[str, str] = {
     "sessions.resumed": "counter",
     "sessions.replayed_records": "counter",
     "sessions.checkpoints": "counter",
+    "sessions.fenced": "counter",
     "sessions.live": "gauge",
     # fleet (fleet/router.py)
     "fleet.session_handoffs": "counter",

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import stat
 
 import numpy as np
 import pytest
@@ -462,6 +463,355 @@ class TestFleetSessions:
         finally:
             router.close()
             pool.shutdown()
+
+    def test_scale_up_does_not_move_live_sessions(self, sdir):
+        """Ring GROWTH (autoscale scale-up) bumps the affinity epoch,
+        but a live session must stay with the replica that holds its
+        state and journal lease — re-resolving it would start a
+        second writer on the same journal while the first is live."""
+        A = _rows()
+        pool = fleet.ReplicaPool(1, max_batch=4)
+        router = fleet.Router(pool)
+        try:
+            sids = [router.open_sketch_session(
+                "cwt", n=64, s_dim=16, d=8, seed=i,
+                session_id=f"grow{i}") for i in range(8)]
+            for sid in sids:
+                assert router.session_owner(sid) == "r0"
+                router.session_append(sid, A[:16], seq=1).result()
+            epoch_before = router.stats()["session_epoch"]
+            pool.add_replica()             # the scale-up
+            assert router.stats()["session_epoch"] > epoch_before
+            # with two members at least one sid would prefer the new
+            # replica under re-resolution — none may move
+            for sid in sids:
+                assert router.session_owner(sid) == "r0"
+                assert router.session_append(
+                    sid, A[16:32], seq=2).result() == (2, 32)
+            assert router.stats()["session_handoffs"] == 0
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_open_timeout_is_not_a_refusal(self, sdir, monkeypatch):
+        """A slow open must surface the timeout with the assignment
+        pinned where it was dispatched — failing over would orphan
+        the (possibly live) session and every peer would refuse the
+        id anyway over the shared dir."""
+        from concurrent.futures import Future as _F
+
+        pool = fleet.ReplicaPool(2, max_batch=4)
+        router = fleet.Router(pool)
+        dispatched = []
+        try:
+            for name in pool.names():
+                rep = pool.get(name)
+
+                def never(op, _name=name, **kw):
+                    dispatched.append((_name, op))
+                    return _F()            # never resolves
+
+                monkeypatch.setattr(rep, "session", never)
+            with pytest.raises(sk_errors.CommunicationError,
+                               match="pinned"):
+                router.open_sketch_session(
+                    "cwt", n=16, s_dim=8, d=4, session_id="slow",
+                    timeout=0.1)
+            assert len(dispatched) == 1    # no failover walk
+            assert router.stats()["failover"] == 0
+            assert router.session_owner("slow") == dispatched[0][0]
+        finally:
+            router.close()
+            pool.shutdown()
+
+
+class TestOwnershipFencing:
+    """The lease generation in ``<sid>.lease``: exactly one registry
+    holds a session live; a peer resume fences the stale owner, whose
+    next touch drops its entry WITHOUT touching the artifacts the new
+    owner depends on."""
+
+    def test_stale_owner_is_fenced_after_peer_resume(self, sdir):
+        A = _rows()
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="cwt", n=64, s_dim=16, d=8, seed=3),
+            session_id="fence")
+        reg.append(sid, A[:16], seq=1)
+        # a peer resumes the session (the stale-assignment scenario:
+        # reg never drained, still holds it live with an open journal)
+        peer = sessions.SessionRegistry(directory=sdir)
+        assert peer.append(sid, A[16:32], seq=2) == (2, 32)
+        # the stale owner's next touch observes the lease bump: no
+        # write lands, no artifact is touched, the verb resolves
+        with pytest.raises(sk_errors.SessionEvictedError,
+                           match="fenced"):
+            reg.append(sid, A[16:32], seq=2)
+        assert reg.stats()["fenced"] == 1
+        # the new owner's artifacts are intact and the stream goes on
+        assert os.path.exists(os.path.join(sdir, f"{sid}.journal"))
+        peer.append(sid, A[32:48], seq=3)
+        peer.append(sid, A[48:], seq=4)
+        out = peer.finalize(sid)
+        ref = np.asarray(sk.CWT(64, 16, Context(seed=3)).apply(
+            jnp.asarray(A), sk.COLUMNWISE))
+        assert np.array_equal(out["SX"], ref)
+        # the peer's finalize removed the artifacts, so the stale
+        # owner's later touch finds nothing to resume — still a clean
+        # error, never a hang or a resurrection
+        with pytest.raises(sk_errors.SessionEvictedError):
+            reg.finalize(sid)
+
+    def test_fenced_owner_can_adopt_the_session_back(self, sdir):
+        """Fencing is per-touch, not terminal for the registry: when
+        the ring later hands the session back (the interim owner
+        drained away), the previously-fenced registry resumes it from
+        disk instead of refusing on a stale tombstone."""
+        A = _rows()
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="cwt", n=64, s_dim=16, d=8, seed=3),
+            session_id="back")
+        reg.append(sid, A[:16], seq=1)
+        peer = sessions.SessionRegistry(directory=sdir)
+        peer.append(sid, A[16:32], seq=2)
+        with pytest.raises(sk_errors.SessionEvictedError,
+                           match="fenced"):
+            reg.append(sid, A[16:32], seq=2)   # observes the fence
+        peer.append(sid, A[32:48], seq=3)
+        peer.close()                           # the ring hands back
+        assert reg.append(sid, A[48:], seq=4) == (4, 64)
+        out = reg.finalize(sid)
+        ref = np.asarray(sk.CWT(64, 16, Context(seed=3)).apply(
+            jnp.asarray(A), sk.COLUMNWISE))
+        assert np.array_equal(out["SX"], ref)
+        assert reg.stats()["resumed"] == 1
+
+    def test_stale_owner_ttl_cannot_delete_new_owners_artifacts(
+            self, sdir, monkeypatch):
+        """The review's data-loss scenario: the stale owner's TTL
+        sweep must not ``_remove_artifacts`` the session the new
+        owner is actively using."""
+        A = _rows()
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="cwt", n=64, s_dim=16, d=8, seed=3, ttl_s=30.0),
+            session_id="ttlrace")
+        reg.append(sid, A[:16], seq=1)
+        peer = sessions.SessionRegistry(directory=sdir)
+        peer.append(sid, A[16:32], seq=2)
+        # the stale owner's clock runs past the TTL and it sweeps
+        import libskylark_tpu.sessions.registry as reg_mod
+
+        real = reg_mod.time.monotonic
+        monkeypatch.setattr(reg_mod.time, "monotonic",
+                            lambda: real() + 31.0)
+        assert reg.sweep() == 1            # dropped (fenced), not
+        monkeypatch.undo()                 # evicted with deletion
+        assert reg.stats()["fenced"] == 1
+        assert reg.stats()["evicted"] == 0
+        for suffix in ("journal", "meta.json", "lease"):
+            assert os.path.exists(
+                os.path.join(sdir, f"{sid}.{suffix}"))
+        # the new owner never noticed
+        peer.append(sid, A[32:48], seq=3)
+        peer.append(sid, A[48:], seq=4)
+        out = peer.finalize(sid)
+        ref = np.asarray(sk.CWT(64, 16, Context(seed=3)).apply(
+            jnp.asarray(A), sk.COLUMNWISE))
+        assert np.array_equal(out["SX"], ref)
+
+    def test_stale_owner_checkpoint_is_skipped(self, sdir):
+        """A fenced owner's drain hook must not overwrite the new
+        owner's checkpoint with stale accumulators."""
+        A = _rows()
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="jlt", n=64, s_dim=16, d=8, seed=3),
+            session_id="ckfence")
+        reg.append(sid, A[:16], seq=1)
+        peer = sessions.SessionRegistry(directory=sdir)
+        peer.append(sid, A[16:32], seq=2)
+        peer.checkpoint(sid)
+        reg.checkpoint_all()               # fenced: contained no-op
+        assert reg.stats()["checkpoints"] == 0
+        from libskylark_tpu.utility import checkpoint as ckpt
+
+        _arrays, meta = ckpt.load_sync(
+            os.path.join(sdir, f"{sid}.ckpt"))
+        assert meta["seq"] == 2            # still the peer's
+
+    def test_finalize_removes_lease(self, sdir):
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="cwt", n=16, s_dim=8, d=8))
+        reg.append(sid, _rows(16))
+        reg.finalize(sid)
+        assert not os.path.exists(os.path.join(sdir, f"{sid}.lease"))
+
+    def test_concurrent_first_touch_resumes_once(self, sdir):
+        """Racing resolvers on an on-disk id block on the session's
+        own lock (not the registry lock) and the resume runs once."""
+        import threading
+
+        A = _rows()
+        reg = sessions.SessionRegistry(directory=sdir)
+        sid = reg.open(sessions.SessionSpec(
+            kind="cwt", n=64, s_dim=16, d=8, seed=3),
+            session_id="race")
+        reg.append(sid, A[:16], seq=1)
+        reg.close()
+        peer = sessions.SessionRegistry(directory=sdir)
+        barrier = threading.Barrier(8)
+        results, errs = [], []
+
+        def touch():
+            barrier.wait()
+            try:
+                results.append(peer.rows(sid))
+            except BaseException as e:  # noqa: BLE001 — assert below
+                errs.append(e)
+
+        threads = [threading.Thread(target=touch) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert results == [(1, 16)] * 8
+        assert peer.stats()["resumed"] == 1
+
+
+class TestDirAndJournalHardening:
+    def test_default_dir_created_private(self, tmp_path, monkeypatch):
+        import libskylark_tpu.sessions.registry as reg_mod
+
+        monkeypatch.delenv("SKYLARK_SESSION_DIR", raising=False)
+        monkeypatch.setattr(reg_mod.tempfile, "gettempdir",
+                            lambda: str(tmp_path))
+        reg = sessions.SessionRegistry()
+        st = os.stat(reg.directory)
+        assert stat.S_IMODE(st.st_mode) == 0o700
+        assert st.st_uid == os.getuid()
+
+    def test_default_dir_refuses_symlink(self, tmp_path, monkeypatch):
+        import libskylark_tpu.sessions.registry as reg_mod
+
+        monkeypatch.delenv("SKYLARK_SESSION_DIR", raising=False)
+        monkeypatch.setattr(reg_mod.tempfile, "gettempdir",
+                            lambda: str(tmp_path))
+        target = tmp_path / "elsewhere"
+        target.mkdir()
+        os.symlink(str(target), str(
+            tmp_path / f"skylark_sessions_{os.getuid()}"))
+        with pytest.raises(sk_errors.IOError_, match="symlink"):
+            sessions.SessionRegistry()
+
+    @pytest.mark.skipif(os.getuid() != 0,
+                        reason="needs root to fake a foreign owner")
+    def test_default_dir_refuses_foreign_owner(self, tmp_path,
+                                               monkeypatch):
+        import libskylark_tpu.sessions.registry as reg_mod
+
+        monkeypatch.delenv("SKYLARK_SESSION_DIR", raising=False)
+        monkeypatch.setattr(reg_mod.tempfile, "gettempdir",
+                            lambda: str(tmp_path))
+        d = tmp_path / f"skylark_sessions_{os.getuid()}"
+        d.mkdir()
+        os.chown(str(d), 12345, 12345)
+        with pytest.raises(sk_errors.IOError_, match="owned by uid"):
+            sessions.SessionRegistry()
+
+    def test_journal_payload_is_not_executable(self, tmp_path):
+        """A planted journal record must never run code: the payload
+        is a json header + raw npy bodies, and a pickle smuggled into
+        a record decodes as damage, not as an object."""
+        import pickle
+        import struct
+        import zlib
+
+        from libskylark_tpu.sessions import journal as jr
+
+        marker = tmp_path / "pwned"
+
+        class Evil:
+            def __reduce__(self):
+                return (os.mkdir, (str(marker),))
+
+        payload = pickle.dumps((1, {"X": Evil()}), protocol=4)
+        p = str(tmp_path / "evil.journal")
+        with open(p, "wb") as fh:
+            fh.write(jr.MAGIC)
+            fh.write(struct.pack("<II", len(payload),
+                                 zlib.crc32(payload)))
+            fh.write(payload)
+        records, good = scan(p)
+        assert records == []               # damage, not an object
+        assert good == len(jr.MAGIC)
+        assert not marker.exists()
+
+    def test_failed_append_write_rolls_back_to_intact_prefix(
+            self, tmp_path):
+        """ENOSPC mid-record must not leave a torn record mid-file
+        with later appends landing past it (scan would then drop
+        every acknowledged record after the damage)."""
+        p = str(tmp_path / "j")
+        j = SessionJournal.create(p, fsync_every=100)
+        j.append(1, {"X": np.ones((2, 2), np.float32)})
+
+        class ShortOnce:
+            def __init__(self, fh):
+                self._fh = fh
+                self.tripped = False
+
+            def write(self, b):
+                if not self.tripped:
+                    self.tripped = True
+                    self._fh.write(b[: len(b) // 2])
+                    raise OSError(28, "No space left on device")
+                return self._fh.write(b)
+
+            def __getattr__(self, a):
+                return getattr(self._fh, a)
+
+        j._fh = ShortOnce(j._fh)
+        with pytest.raises(OSError):
+            j.append(2, {"X": np.full((2, 2), 2.0, np.float32)})
+        # the torn half-record was truncated away; the retry lands
+        # cleanly and the scan sees an undamaged file
+        j.append(2, {"X": np.full((2, 2), 2.0, np.float32)})
+        j.close()
+        records, good = scan(p)
+        assert [s for s, _ in records] == [1, 2]
+        assert good == os.path.getsize(p)
+
+    def test_unrollbackable_write_poisons_the_journal(self, tmp_path):
+        """If even the rollback fails, the journal refuses further
+        appends — acknowledging appends past damage would silently
+        drop them at replay."""
+        p = str(tmp_path / "j")
+        j = SessionJournal.create(p, fsync_every=100)
+        j.append(1, {"X": np.ones((1, 1), np.float32)})
+
+        class Broken:
+            def __init__(self, fh):
+                self._fh = fh
+
+            def write(self, b):
+                self._fh.write(b[: len(b) // 2])
+                raise OSError(5, "I/O error")
+
+            def truncate(self, n):
+                raise OSError(5, "I/O error")
+
+            def __getattr__(self, a):
+                return getattr(self._fh, a)
+
+        j._fh = Broken(j._fh)
+        with pytest.raises(OSError):
+            j.append(2, {"X": np.ones((1, 1), np.float32)})
+        with pytest.raises(sk_errors.IOError_, match="refused"):
+            j.append(3, {"X": np.ones((1, 1), np.float32)})
 
 
 class TestCrashFaultSpec:
