@@ -44,7 +44,18 @@ asserts the resilience subsystem's contract end to end:
   drain handoff resumes on the peer, the same-seq retry absorbs the
   fault (idempotent replay), finalize is bit-equal to the one-shot
   sketch, zero client-visible failures, and two same-seed runs replay
-  the identical fired sequence.
+  the identical fired sequence;
+- **fault-tolerant distributed sketching** (the dist leg,
+  docs/distributed): a fixed-seed ``dist.shard`` crash/retry storm
+  through a 2-replica :class:`~libskylark_tpu.dist.
+  DistSketchCoordinator` (``max_inflight=1`` serializes dispatch so
+  the hit order is deterministic by construction) — every fired fault
+  is absorbed by a reassigned re-execution, the full-coverage merge
+  is **bit-equal to the one-shot** ``sketch_local`` reference, two
+  same-seed runs replay the identical fired sequence AND identical
+  bits; a second, budget-exhausting plan forces abandonment and the
+  leg asserts the degraded path's exact coverage arithmetic, missing
+  row ranges, and the ``min_coverage`` raise.
 
 Usage: ``python benchmarks/chaos_battery.py --gate`` (script/ci wires
 ``JAX_PLATFORMS=cpu`` and the canned ``SKYLARK_FAULT_PLAN``). Prints
@@ -459,6 +470,118 @@ def _session_leg(violations):
     }
 
 
+def _dist_run(A, plan_doc, *, retries, min_coverage):
+    """One fixed-seed distributed-sketch storm (docs/distributed): a
+    7-shard CWT plan over a 2-replica fleet, dispatch serialized
+    (``max_inflight=1``) so the ``dist.shard`` hit order — and
+    therefore the fired sequence — is deterministic by construction."""
+    from libskylark_tpu import dist, fleet
+    from libskylark_tpu.base import errors as sk_errors
+    from libskylark_tpu.resilience import faults
+
+    plan = dist.ShardPlan(kind="cwt", n=64, s_dim=S_DIM, d=8, seed=23,
+                          shard_rows=10)
+    src = dist.ArraySource(A)
+    pool = fleet.ReplicaPool(2, max_batch=4)
+    try:
+        co = dist.DistSketchCoordinator(pool, retries=retries,
+                                        max_inflight=1)
+        with faults.fault_plan(plan_doc) as p:
+            gate_raised = False
+            result = None
+            try:
+                result = co.sketch(plan, src,
+                                   min_coverage=min_coverage)
+            except sk_errors.SketchCoverageError:
+                gate_raised = True
+            fired = list(p.fired)
+        return {"result": result, "fired": fired,
+                "gate_raised": gate_raised, "stats": co.stats(),
+                "plan": plan, "source": src}
+    finally:
+        pool.shutdown()
+
+
+def _dist_leg(violations):
+    """Distributed sketching under chaos, twice per plan seed."""
+    from libskylark_tpu import dist
+
+    A = np.random.default_rng(23).standard_normal(
+        (64, 8)).astype(np.float32)
+
+    # -- retry storm: every third shard-task execution fails ------------
+    storm_plan = {"seed": 7, "faults": [
+        {"site": "dist.shard", "error": "IOError_", "every": 3}]}
+    rec1 = _dist_run(A, storm_plan, retries=3, min_coverage=1.0)
+    rec2 = _dist_run(A, storm_plan, retries=3, min_coverage=1.0)
+    ref = dist.sketch_local(rec1["plan"], rec1["source"])
+    for run, rec in (("run1", rec1), ("run2", rec2)):
+        r = rec["result"]
+        if r is None:
+            violations.append(
+                f"dist leg {run}: storm raised instead of absorbing "
+                "the injected shard faults")
+            continue
+        if r.coverage != 1.0 or rec["stats"]["abandoned"]:
+            violations.append(
+                f"dist leg {run}: coverage {r.coverage} with "
+                f"{rec['stats']['abandoned']} abandoned — the retry "
+                "budget should have absorbed every fault")
+        if not np.array_equal(r.SX, ref.SX):
+            violations.append(
+                f"dist leg {run}: merged sketch not bit-equal to the "
+                "one-shot sketch_local reference")
+        if rec["stats"]["retried"] < 1:
+            violations.append(
+                f"dist leg {run}: plan fired but nothing retried")
+    if not rec1["fired"]:
+        violations.append("dist leg: plan injected nothing — inert")
+    if rec1["fired"] != rec2["fired"]:
+        violations.append(
+            f"dist leg: fired sequences differ across same-seed runs: "
+            f"{rec1['fired']} vs {rec2['fired']}")
+    if (rec1["result"] is not None and rec2["result"] is not None
+            and not np.array_equal(rec1["result"].SX,
+                                   rec2["result"].SX)):
+        violations.append(
+            "dist leg: merged bits differ across same-seed runs")
+
+    # -- forced abandonment: everything after hit 2 fails ---------------
+    kill_plan = {"seed": 7, "faults": [
+        {"site": "dist.shard", "error": "IOError_", "after": 2}]}
+    gated = _dist_run(A, kill_plan, retries=1, min_coverage=1.0)
+    if not gated["gate_raised"]:
+        violations.append(
+            "dist leg: degraded merge below min_coverage=1.0 did not "
+            "raise SketchCoverageError")
+    deg = _dist_run(A, kill_plan, retries=1, min_coverage=0.25)
+    r = deg["result"]
+    if r is None:
+        violations.append(
+            "dist leg: degraded run raised despite min_coverage=0.25")
+    else:
+        # shards 0,1 complete (hits 1,2); shards 2..6 fail every
+        # attempt: coverage = 20/64, missing = rows [20, 64)
+        if (r.coverage != 20 / 64 or r.missing != ((20, 64),)
+                or r.rows_merged != 20):
+            violations.append(
+                f"dist leg: degraded accounting wrong — coverage "
+                f"{r.coverage} missing {r.missing} rows "
+                f"{r.rows_merged}, expected 20/64, ((20, 64),), 20")
+        if deg["stats"]["abandoned"] != 5:
+            violations.append(
+                f"dist leg: {deg['stats']['abandoned']} abandoned "
+                "shards, expected 5")
+    return {
+        "fired": [list(f) for f in rec1["fired"]],
+        "retried": rec1["stats"]["retried"],
+        "reassigned": rec1["stats"]["reassigned"],
+        "degraded_coverage": (None if r is None else r.coverage),
+        "degraded_missing": (None if r is None else list(r.missing)),
+        "deterministic": rec1["fired"] == rec2["fired"],
+    }
+
+
 def main() -> int:
     from libskylark_tpu import engine
     from libskylark_tpu.base import errors  # noqa: F401 — class names
@@ -537,6 +660,9 @@ def main() -> int:
     # -- session leg: drain handoff + injected append fault -------------
     session_rec = _session_leg(violations)
 
+    # -- dist leg: shard-crash storm + degraded-merge arithmetic --------
+    dist_rec = _dist_leg(violations)
+
     # -- lock-order witness (instrumented-lock mode) --------------------
     # With SKYLARK_LOCK_WITNESS=1 (the CI chaos gate sets it) every
     # lock the storm touched — executor state/stats/pub, engine cache,
@@ -585,6 +711,7 @@ def main() -> int:
         "fleet": fleet_rec,
         "hedge": hedge_rec,
         "sessions": session_rec,
+        "dist": dist_rec,
         "lock_witness": witness_rec,
         "violations": violations,
     }

@@ -384,6 +384,46 @@ SESSION_FSYNC_EVERY = declare(
         "(process-crash durable); the fsync cadence bounds what a "
         "whole-machine crash can lose. 1 = fsync every append.")
 
+# -- distributed sketching (libskylark_tpu/dist) ----------------------------
+
+DIST_SHARD_ROWS = declare(
+    "SKYLARK_DIST_SHARD_ROWS", default=8192, parser=parse_positive_int,
+    kind="int",
+    doc="Default rows per shard task when a ``ShardPlan`` does not pin "
+        "``shard_rows`` (``libskylark_tpu.dist``): the unit of "
+        "re-executable work in distributed sketching "
+        "(docs/distributed).")
+
+DIST_RETRIES = declare(
+    "SKYLARK_DIST_RETRIES", default=3, parser=parse_int, kind="int",
+    doc="Per-shard retry budget of the distributed-sketch coordinator: "
+        "how many times a failed shard task is re-executed (with "
+        "reassignment to the next ring-preference replica) before it "
+        "is abandoned into the degraded-merge accounting.")
+
+DIST_MIN_COVERAGE = declare(
+    "SKYLARK_DIST_MIN_COVERAGE", default=1.0, parser=parse_float,
+    kind="float",
+    doc="Default ``min_coverage`` gate of a distributed sketch merge: "
+        "a merged coverage (fraction of declared rows folded in) below "
+        "this raises ``SketchCoverageError`` instead of returning a "
+        "degraded result. 1.0 = any abandoned shard raises.")
+
+DIST_HEDGE = declare(
+    "SKYLARK_DIST_HEDGE", default=False, parser=parse_flag, kind="flag",
+    doc="Mirror straggler shard tasks to the next ring-preference "
+        "replica after ``SKYLARK_DIST_HEDGE_DELAY_MS`` and take the "
+        "first result (the r15 hedging discipline applied to shard "
+        "tasks; bit-equal by construction — shard partials are pure "
+        "functions of the plan).")
+
+DIST_HEDGE_DELAY_MS = declare(
+    "SKYLARK_DIST_HEDGE_DELAY_MS", default=1000.0, parser=parse_float,
+    kind="float",
+    doc="Straggler threshold for shard-task hedging: an unresolved "
+        "shard task older than this is mirrored when "
+        "``SKYLARK_DIST_HEDGE`` is on.")
+
 FAULT_PLAN = declare(
     "SKYLARK_FAULT_PLAN", default=None, kind="json",
     doc="Deterministic fault-injection plan (inline JSON or a path); "

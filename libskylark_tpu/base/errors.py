@@ -111,6 +111,24 @@ class SessionEvictedError(SkylarkError):
     code = 113
 
 
+class SketchCoverageError(SkylarkError):
+    """A distributed sketch merge could not reach the caller's
+    ``min_coverage``: one or more row shards exhausted their retry
+    budget and were abandoned, so the merged sketch covers only a
+    fraction of the declared rows. The error carries the exact
+    ``coverage`` achieved and the missing row ranges — the degraded
+    result is *reported*, never silently returned
+    (:mod:`libskylark_tpu.dist`, docs/distributed)."""
+
+    code = 114
+
+    def __init__(self, message: str = "", *, coverage: float = 0.0,
+                 missing=()):
+        super().__init__(message)
+        self.coverage = float(coverage)
+        self.missing = tuple(tuple(r) for r in missing)
+
+
 _CODE_TABLE = {
     cls.code: cls
     for cls in [
@@ -128,6 +146,7 @@ _CODE_TABLE = {
         IOError_,
         NotImplementedYetError,
         SessionEvictedError,
+        SketchCoverageError,
     ]
 }
 

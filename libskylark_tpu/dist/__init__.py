@@ -1,0 +1,51 @@
+"""Distributed sketching over row-sharded data — failure handling as
+the design center (docs/distributed).
+
+The source library's entire premise is *distributed* RandNLA (MPI +
+Elemental, VC★/★VR row distributions — PAPER.md); this package is
+that heritage rebuilt on the repo's own serving substrate, exploiting
+the fault-tolerance gift the reference never used: sketching
+linearity makes a row shard a **recomputable, idempotent unit of
+work**, and a permanently lost shard still leaves a valid sketch of
+the surviving rows whose coverage is *reported*, never silent.
+
+- :mod:`~libskylark_tpu.dist.plan` — :class:`ShardPlan` (numbered
+  row-range shard tasks whose operator slices are pure positional
+  functions of the plan seed: re-execution anywhere is bit-equal),
+  range-readable :class:`ShardSource` descriptors (in-memory rows,
+  HDF5, libsvm/line streams with resume-at-consumed-offset ingest),
+  the canonical deterministic merge tree, and the
+  coverage-quantified results (:class:`DistSketchResult` /
+  :class:`DegradedSketchResult`).
+- :mod:`~libskylark_tpu.dist.coordinator` —
+  :class:`DistSketchCoordinator`: dispatch across a
+  :class:`~libskylark_tpu.fleet.ReplicaPool` with ring-preference
+  placement, retry + reassignment under ``SKYLARK_DIST_RETRIES``,
+  straggler hedging, and the ``min_coverage`` gate
+  (:class:`~libskylark_tpu.base.errors.SketchCoverageError`).
+- :mod:`~libskylark_tpu.dist.algorithms` — distributed randomized SVD
+  and sketched least-squares whose only cross-host traffic is the
+  merged sketch.
+
+Chaos-replayed by ``benchmarks/chaos_battery.py`` (the ``dist.shard``
+/ ``dist.ingest`` / ``dist.merge`` fault sites) and CI-gated by
+``benchmarks/dist_smoke.py`` (a SIGKILLed process replica mid-storm:
+every shard reassigned, the merge bit-equal to the one-shot
+reference).
+"""
+
+from libskylark_tpu.dist.algorithms import randomized_svd, sketched_lstsq
+from libskylark_tpu.dist.coordinator import (DistSketchCoordinator,
+                                             dist_stats)
+from libskylark_tpu.dist.plan import (ArraySource, DegradedSketchResult,
+                                      DistSketchResult, HDF5Source,
+                                      LibsvmSource, ShardPlan,
+                                      ShardSource, merge_partials,
+                                      sketch_local)
+
+__all__ = [
+    "ArraySource", "DegradedSketchResult", "DistSketchCoordinator",
+    "DistSketchResult", "HDF5Source", "LibsvmSource", "ShardPlan",
+    "ShardSource", "dist_stats", "merge_partials", "randomized_svd",
+    "sketch_local", "sketched_lstsq",
+]

@@ -1,0 +1,94 @@
+"""Sketch-size-communication algorithms over the distributed sketch.
+
+The libSkylark heritage at pod scale (ROADMAP item 2): randomized SVD
+and sketched least-squares whose ONLY cross-host traffic is the
+merged ``s_dim × d`` sketch — each replica streams its own row shards
+(or receives just its shard's rows) and returns a partial sketch;
+communication is proportional to sketch size, not data size. Both
+entry points ride the full fault-tolerance contract: retried shard
+tasks, quantified degraded merges, the ``min_coverage`` gate.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from libskylark_tpu.base import errors
+from libskylark_tpu.dist import plan as _plan
+from libskylark_tpu.dist.coordinator import DistSketchCoordinator
+
+
+def _run(plan: _plan.ShardPlan, source: _plan.ShardSource,
+         coordinator: Optional[DistSketchCoordinator],
+         min_coverage: Optional[float]) -> _plan.DistSketchResult:
+    if coordinator is None:
+        result = _plan.sketch_local(plan, source)
+        gate = 1.0 if min_coverage is None else float(min_coverage)
+        return result.require(gate)
+    return coordinator.sketch(plan, source, min_coverage=min_coverage)
+
+
+def randomized_svd(source: _plan.ShardSource, rank: int, *,
+                   s_dim: Optional[int] = None, seed: int = 0,
+                   kind: str = "jlt", shard_rows: int = 0,
+                   coordinator: Optional[DistSketchCoordinator] = None,
+                   min_coverage: Optional[float] = None) -> dict:
+    """Distributed one-pass randomized SVD of a row-sharded dataset:
+    merge the ``s_dim × d`` row sketch, then factor the small sketch
+    locally (the streaming-rSVD math of the ``isvd`` sessions, fed by
+    shard tasks instead of appends). Returns ``singular_values``,
+    ``Vt`` (top ``rank``), plus the merge's exact ``coverage`` and
+    ``missing`` ranges — a degraded merge above ``min_coverage``
+    yields the SVD *of the surviving rows' sketch*, labeled as such."""
+    if rank < 1:
+        raise errors.InvalidParametersError(f"rank must be >= 1, got {rank}")
+    s = int(s_dim) if s_dim else max(2 * int(rank), int(rank) + 8)
+    if kind not in _plan.ADDITIVE_KINDS:
+        raise errors.InvalidParametersError(
+            f"randomized_svd needs an additive sketch kind, got {kind!r}")
+    plan = _plan.ShardPlan(kind=kind, n=source.n, s_dim=min(s, source.n),
+                           d=source.d, seed=seed,
+                           shard_rows=shard_rows).validate()
+    res = _run(plan, source, coordinator, min_coverage)
+    import jax.numpy as jnp
+
+    _, sv, Vt = jnp.linalg.svd(jnp.asarray(res.SX), full_matrices=False)
+    k = min(int(rank), plan.s_dim, plan.d)
+    return {"singular_values": np.asarray(sv[:k]),
+            "Vt": np.asarray(Vt[:k]),
+            "coverage": res.coverage, "missing": list(res.missing),
+            "degraded": res.degraded}
+
+
+def sketched_lstsq(source: _plan.ShardSource, *,
+                   s_dim: int, seed: int = 0, kind: str = "cwt",
+                   shard_rows: int = 0,
+                   coordinator: Optional[DistSketchCoordinator] = None,
+                   min_coverage: Optional[float] = None) -> dict:
+    """Distributed sketch-and-solve least squares
+    ``min_w ||X w − Y||``: merge the joint ``(S·X, S·Y)`` sketch off
+    the row shards, solve the small ``s_dim × d`` problem locally.
+    The source must carry targets (``Y``). Returns ``coef`` (d ×
+    targets) plus the coverage accounting."""
+    if source.targets < 1:
+        raise errors.InvalidParametersError(
+            "sketched_lstsq needs a source with targets (Y rows)")
+    if kind not in _plan.ADDITIVE_KINDS:
+        raise errors.InvalidParametersError(
+            f"sketched_lstsq needs an additive sketch kind, got {kind!r}")
+    plan = _plan.ShardPlan(kind=kind, n=source.n,
+                           s_dim=min(int(s_dim), source.n), d=source.d,
+                           seed=seed, targets=source.targets,
+                           shard_rows=shard_rows).validate()
+    res = _run(plan, source, coordinator, min_coverage)
+    import jax.numpy as jnp
+
+    w, *_ = jnp.linalg.lstsq(jnp.asarray(res.SX), jnp.asarray(res.SY))
+    return {"coef": np.asarray(w),
+            "coverage": res.coverage, "missing": list(res.missing),
+            "degraded": res.degraded}
+
+
+__all__ = ["randomized_svd", "sketched_lstsq"]

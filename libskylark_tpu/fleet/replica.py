@@ -123,6 +123,17 @@ class Replica:
         ``(seq, rows)``, ``finalize`` to the result dict)."""
         raise NotImplementedError
 
+    def shard(self, task: dict) -> Future:
+        """Distributed-sketch shard-task verb (docs/distributed): the
+        payload is :func:`libskylark_tpu.dist.plan.execute_task`'s —
+        a serialized :class:`~libskylark_tpu.dist.plan.ShardPlan`, the
+        shard index, and a range-readable source. Resolves to the
+        task's ``{"index", "rows", "partial"}`` dict. Idempotent by
+        construction (the partial is a pure function of the plan), so
+        the coordinator retries a failed/crashed future by simply
+        re-invoking this on the next ring-preference replica."""
+        raise NotImplementedError
+
     def queue_depth(self) -> int:
         raise NotImplementedError
 
@@ -187,6 +198,27 @@ class ThreadReplica(Replica):
             raise
         except BaseException as e:  # noqa: BLE001 — resolve, don't leak
             fut.set_exception(e)
+        return fut
+
+    def shard(self, task: dict) -> Future:
+        # a one-shot thread, not the executor queue: shard compute is
+        # host-side ingest + eager folds — queueing it behind flush
+        # cohorts would stall serve traffic, and a thread per task
+        # keeps the coordinator's dispatch loop non-blocking
+        from libskylark_tpu.dist.plan import execute_task
+
+        fut: Future = Future()
+
+        def _run():
+            try:
+                fut.set_result(execute_task(task))
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — resolve
+                fut.set_exception(e)
+
+        threading.Thread(target=_run, name=f"{self.name}-shard",
+                         daemon=True).start()
         return fut
 
     def queue_depth(self) -> int:
@@ -412,6 +444,26 @@ def _worker_main(conn, name: str, executor_kwargs: dict,
                     fut.add_done_callback(functools.partial(reply, rid))
                 else:
                     raise ValueError(f"unknown session op {op!r}")
+            elif kind == "shard":
+                # distributed-sketch shard task (docs/distributed):
+                # computed on a one-shot thread — ingest + eager folds
+                # must not stall the message loop (the same reasoning
+                # as session opens above). The ``dist.shard`` fault
+                # site fires INSIDE execute_task, in this process —
+                # which is how a ``crash`` spec in a victim child's
+                # SKYLARK_FAULT_PLAN delivers the deterministic
+                # kill -9 mid-storm.
+                def _shard_reply(rid=rid, task=msg[2]):
+                    from libskylark_tpu.dist.plan import execute_task
+
+                    try:
+                        send(("rpc", rid, execute_task(task)))
+                    except Exception as e:  # noqa: BLE001
+                        _send_exception(send, rid, e)
+
+                threading.Thread(target=_shard_reply,
+                                 name=f"{name}-shard",
+                                 daemon=True).start()
             elif kind == "stats":
                 send(("rpc", rid, ex.stats()))
             elif kind == "env":
@@ -617,6 +669,13 @@ class ProcessReplica(Replica):
                 self._futures.pop(rid, None)
                 raise ServeOverloadedError(
                     f"replica process {self.name!r} pipe closed") from e
+            except BaseException:
+                # e.g. an unpicklable payload (PicklingError /
+                # AttributeError from a local callable): the message
+                # never left, so the rid must not sit in _futures
+                # waiting for a reply that cannot come
+                self._futures.pop(rid, None)
+                raise
         return fut
 
     def _rpc(self, kind: str, *payload, timeout: float = 30.0):
@@ -645,6 +704,12 @@ class ProcessReplica(Replica):
         # session operands ride the pickle pipe (see _worker_main's
         # "session" branch); the child re-validates against its spec
         return self._send("session", op, kwargs)
+
+    def shard(self, task: dict) -> Future:
+        # shard payloads ride the pickle pipe: the task is a plan +
+        # source descriptor (or one shard's rows), the reply an
+        # s_dim × d partial — both sketch-sized, not data-sized
+        return self._send("shard", task)
 
     def queue_depth(self) -> int:
         # outstanding submits the parent knows about — no pipe

@@ -153,6 +153,26 @@ def prefetch_batches(
             pass
 
 
+def grid_spans(lo: int, hi: int, batch_rows: int
+               ) -> Iterator[Tuple[int, int]]:
+    """Split ``[lo, hi)`` on the ABSOLUTE ``batch_rows`` grid: batch
+    boundaries are multiples of ``batch_rows`` regardless of where the
+    range starts, so a resumed range read (``lo`` = a prior batch end)
+    yields the same subsequent boundaries — the bit-equal-resume
+    invariant the HDF5 range reader and the distributed shard-task
+    ingest (:mod:`libskylark_tpu.dist.plan`) both build on.
+    ``batch_rows <= 0`` yields the whole range as one span."""
+    if batch_rows <= 0:
+        if lo < hi:
+            yield lo, hi
+        return
+    at = lo
+    while at < hi:
+        nxt = min(hi, (at // batch_rows + 1) * batch_rows)
+        yield at, nxt
+        at = nxt
+
+
 def _line_iter(source) -> Iterator[str]:
     """Path / file-like / iterable-of-lines → line iterator (the
     transport seam; see module doc)."""
@@ -308,10 +328,17 @@ def iter_array_batches(
 def iter_hdf5_batches(
     path, batch_rows: int, dtype=np.float32,
     retry: Optional[RetryPolicy] = None,
+    start_row: int = 0, stop_row: Optional[int] = None,
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Yield ``(X_batch, Y_batch)`` row slices off an HDF5 file written in
     the reference's dense layout (ref: ml/io.hpp:256-507 reads the file in
     root-side chunks; h5py's partial reads provide the same bound).
+
+    ``start_row``/``stop_row`` bound the read to a row range — the
+    shard-task ingest path (:mod:`libskylark_tpu.dist`) reads only its
+    own rows. Batch boundaries stay on the absolute ``batch_rows``
+    grid regardless of the range, so a resumed/re-executed range read
+    yields byte-identical batches.
 
     HDF5 slice reads are re-executable, so transient read failures
     (``io.chunked.read`` fault site; NFS blips on real deployments)
@@ -336,8 +363,10 @@ def iter_hdf5_batches(
     with h5py.File(path, "r") as f:
         X, Y = f["X"], f["Y"]  # the reference's dense layout (io/hdf5.py)
         n = X.shape[0]
-        for lo in range(0, n, batch_rows):
-            hi = min(lo + batch_rows, n)
+        if stop_row is not None:
+            n = min(n, int(stop_row))
+        for lo, hi in grid_spans(max(0, int(start_row)), n,
+                                 batch_rows):
             batch = (read_slice(X, lo, hi, "X"),
                      read_slice(Y, lo, hi, "Y"))
             # counted after both slice reads survived their retry

@@ -35,6 +35,18 @@ Sites planted today:
                       fired fault (or ``crash``) kills the append
                       pre-durability, so the client's retry lands
                       exactly once
+``dist.shard``        shard-task execution entry
+                      (:mod:`libskylark_tpu.dist.plan`) — fires in
+                      the process EXECUTING the task, so a ``crash``
+                      spec riding a victim replica's env is the
+                      deterministic kill -9 mid-storm; an error spec
+                      fails one attempt and the coordinator
+                      reassigns to the next ring preference
+``dist.ingest``       the shard ingest loop, once per source batch —
+                      a transient error here exercises the
+                      resume-at-consumed-offset path
+``dist.merge``        partial-sketch merge entry
+                      (:func:`libskylark_tpu.dist.plan.merge_partials`)
 ====================  ====================================================
 
 A plan is a JSON document (or the equivalent dict)::

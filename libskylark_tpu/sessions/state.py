@@ -52,7 +52,6 @@ journal tail finalizes **bit-equal** to the uninterrupted session.
 from __future__ import annotations
 
 import dataclasses
-import math
 from typing import Optional
 
 import numpy as np
@@ -114,31 +113,6 @@ class SessionSpec:
                       if f.name in d}).validate()
 
 
-def _popcount_parity(a: np.ndarray) -> np.ndarray:
-    """Elementwise popcount parity of a uint64 array. ``np.bitwise_count``
-    when this numpy has it (>= 2.0); otherwise the xor-fold parity
-    trick (six shifts — parity is all the Hadamard sign needs)."""
-    if hasattr(np, "bitwise_count"):
-        return np.bitwise_count(a) & np.uint64(1)
-    for shift in (32, 16, 8, 4, 2, 1):
-        a = a ^ (a >> np.uint64(shift))
-    return a & np.uint64(1)
-
-
-def _srht_panel(idx: np.ndarray, d_diag: np.ndarray, lo: int, hi: int,
-                s_dim: int, dtype) -> np.ndarray:
-    """Columns [lo, hi) of the WHT-FJLT operator in closed form:
-    the Sylvester Hadamard entry at (sampled row, position) times the
-    Rademacher diagonal, scaled to ``1/sqrt(s)`` (the FJLT's
-    ``sqrt(n/s)`` times the WHT's ``1/sqrt(n)``)."""
-    cols = np.arange(lo, hi, dtype=np.uint64)
-    par = _popcount_parity(idx[:, None].astype(np.uint64)
-                           & cols[None, :])
-    signs = (1.0 - 2.0 * par).astype(dtype)
-    return (signs * d_diag[lo:hi]) / np.asarray(
-        math.sqrt(s_dim), dtype)
-
-
 class SessionState:
     """One live session's maintained sketch + positional cursor.
 
@@ -175,9 +149,15 @@ class SessionState:
         elif spec.kind == "srht":
             from libskylark_tpu.sketch.fjlt import FJLT
 
+            # the transform itself: operator_panel is the positional
+            # column-panel stream (closed-form Sylvester-Hadamard —
+            # moved to sketch/fjlt.py where the dist shard tasks share
+            # it). The full diagonal is generated ONCE here — a
+            # session folds thousands of small appends, so per-append
+            # stream regeneration would be pure waste (shard tasks,
+            # whose n may dwarf one task, slice per panel instead)
             t = FJLT(spec.n, spec.s_dim, ctx, fut="wht")
-            self._srht = (np.asarray(t.sample_indices()),
-                          np.asarray(t.diagonal(jnp.dtype(dt))))
+            self._srht = (t, np.asarray(t.diagonal(jnp.dtype(dt))))
         else:  # krr
             from libskylark_tpu.sketch.rft import GaussianRFT
 
@@ -232,7 +212,16 @@ class SessionState:
     def fold(self, X: np.ndarray, Y: Optional[np.ndarray]) -> None:
         """Fold one coerced batch into the maintained sketch at the
         current row position. Deterministic eager ops on the carried
-        accumulator — the replay invariant (module doc)."""
+        accumulator — the replay invariant (module doc).
+
+        The cwt/jlt/srht fold math here has a twin in
+        ``dist/plan._Folder.fold`` (shard tasks fold the same way at
+        shard offsets, but materialize O(shard) stream slices instead
+        of this class's cached O(n) streams — different memory/reuse
+        trade, same bits). A change to either fold must land in both;
+        the cross-subsystem ``transform.apply`` oracles in
+        tests/test_sessions.py and tests/test_dist.py pin them to the
+        same bit pattern."""
         import jax.numpy as jnp
 
         s = self.spec
@@ -254,9 +243,9 @@ class SessionState:
             if Y is not None:
                 self.acc["SY"] = self.acc["SY"] + panel @ jnp.asarray(Y)
         elif s.kind == "srht":
-            idx, diag = self._srht
-            panel = jnp.asarray(_srht_panel(
-                idx, diag, lo, hi, s.s_dim, np.dtype(s.dtype)))
+            t, diag = self._srht
+            panel = jnp.asarray(t.operator_panel(
+                lo, hi, np.dtype(s.dtype), diagonal=diag))
             self.acc["SX"] = self.acc["SX"] + panel @ Xj
             if Y is not None:
                 self.acc["SY"] = self.acc["SY"] + panel @ jnp.asarray(Y)

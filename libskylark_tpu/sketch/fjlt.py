@@ -21,10 +21,22 @@ import math
 from typing import Any
 
 import jax.numpy as jnp
+import numpy as np
 
-from libskylark_tpu.base import randgen
+from libskylark_tpu.base import errors, randgen
 from libskylark_tpu.sketch.fut import make_fut
 from libskylark_tpu.sketch.transform import SketchTransform, register
+
+
+def _popcount_parity(a: np.ndarray) -> np.ndarray:
+    """Elementwise popcount parity of a uint64 array. ``np.bitwise_count``
+    when this numpy has it (>= 2.0); otherwise the xor-fold parity
+    trick (six shifts — parity is all the Hadamard sign needs)."""
+    if hasattr(np, "bitwise_count"):
+        return np.bitwise_count(a) & np.uint64(1)
+    for shift in (32, 16, 8, 4, 2, 1):
+        a = a ^ (a >> np.uint64(shift))
+    return a & np.uint64(1)
 
 
 @register
@@ -94,6 +106,55 @@ class FJLT(SketchTransform):
             self._S,
             dtype=jnp.int32,
         )
+
+    def operator_panel(self, col_start: int, col_stop: int,
+                       dtype=jnp.float32,
+                       diagonal=None) -> np.ndarray:
+        """Columns ``[col_start, col_stop)`` of the sampled-WHT operator
+        in closed form, as a host array:
+        ``S[k, j] = D[j] · (−1)^popcount(idx_k & j) / sqrt(s)`` — the
+        Sylvester Hadamard entry at (sampled row ``idx_k``, position
+        ``j``) times the Rademacher diagonal, scaled to ``1/sqrt(s)``
+        (the FJLT's ``sqrt(n/s)`` times the WHT's ``1/sqrt(n)``).
+
+        This is the positional column-panel stream the streaming SRHT
+        appenders (:mod:`libskylark_tpu.sessions`) and the row-sharded
+        partial sketches (:mod:`libskylark_tpu.dist`) fold against: a
+        pure function of ``(seed, col_start, col_stop)``, so any
+        process recomputes a shard's panel bit-identically. Only the
+        ``wht`` mixer has this closed form (``n`` a power of two).
+
+        ``diagonal`` lets a long-lived caller amortize the Rademacher
+        stream: pass the FULL host :meth:`diagonal` (length ``n``,
+        panel dtype) and only its slice is used — the sessions
+        appender generates it once at open (thousands of small
+        appends), while shard tasks omit it and materialize just their
+        own O(shard) slice (``n`` may dwarf any one task). Both paths
+        are bit-identical (positional streams)."""
+        if self._fut_name != "wht":
+            raise errors.UnsupportedError(
+                "operator_panel is closed-form only for the 'wht' "
+                f"(Sylvester-Hadamard) mixer, not {self._fut_name!r}")
+        dt = np.dtype(dtype)
+        # the s sampled rows never change for this instance: memoize
+        # the host copy so a long panel stream pays that PRNG
+        # generation and device->host transfer once, not per panel.
+        # Runtime state only — never serialized (the OperatorCache
+        # discipline).
+        idx = getattr(self, "_panel_idx_cache", None)
+        if idx is None:
+            idx = np.asarray(self.sample_indices()).astype(np.uint64)
+            self._panel_idx_cache = idx
+        cols = np.arange(col_start, col_stop, dtype=np.uint64)
+        par = _popcount_parity(idx[:, None] & cols[None, :])
+        signs = (1.0 - 2.0 * par).astype(dt)
+        if diagonal is not None:
+            diag = np.asarray(diagonal, dtype=dt)[col_start:col_stop]
+        else:
+            diag = np.asarray(randgen.stream_slice(
+                self.subkey(0), randgen.Rademacher(), col_start,
+                col_stop, dtype=dt))
+        return (signs * diag) / np.asarray(math.sqrt(self._S), dt)
 
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
         D = self.diagonal(A.dtype)

@@ -55,6 +55,13 @@ METRICS: Dict[str, str] = {
     "sessions.checkpoints": "counter",
     "sessions.fenced": "counter",
     "sessions.live": "gauge",
+    # distributed sketching (dist/coordinator.py)
+    "dist.shards_dispatched": "counter",
+    "dist.shards_retried": "counter",
+    "dist.shards_reassigned": "counter",
+    "dist.shards_abandoned": "counter",
+    "dist.merges": "counter",
+    "dist.coverage": "gauge",
     # fleet (fleet/router.py)
     "fleet.session_handoffs": "counter",
     "fleet.routed": "counter",

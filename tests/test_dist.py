@@ -1,0 +1,662 @@
+"""Fault-tolerant distributed sketching (libskylark_tpu/dist,
+docs/distributed).
+
+The contract under test: a row shard is a recomputable, idempotent
+unit of work — re-execution anywhere is bit-equal, merge order is
+invariant (canonical tree), lost shards degrade with EXACT coverage
+accounting gated by ``min_coverage``, and the coordinator absorbs
+injected shard faults by retry + ring reassignment with the final
+merge bit-equal to the one-shot ``sketch_local`` reference.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libskylark_tpu import dist
+from libskylark_tpu import sketch as sk
+from libskylark_tpu.base import errors as sk_errors
+from libskylark_tpu.dist import plan as dp
+from libskylark_tpu.resilience import faults
+
+KINDS = ("cwt", "jlt", "srht", "ust")
+N, D, S_DIM, TARGETS = 64, 8, 16, 2
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    return (rng.standard_normal((N, D)).astype(np.float32),
+            rng.standard_normal((N, TARGETS)).astype(np.float32))
+
+
+def _plan(kind, **kw):
+    base = dict(kind=kind, n=N, s_dim=S_DIM, d=D, seed=5,
+                targets=TARGETS, shard_rows=10)
+    base.update(kw)
+    return dp.ShardPlan(**base).validate()
+
+
+def _partials(plan, src):
+    return {i: dp.compute_shard(plan, i, src)
+            for i, _, _ in plan.shards()}
+
+
+# ---------------------------------------------------------------------------
+# plan geometry + identity
+# ---------------------------------------------------------------------------
+
+
+class TestShardPlan:
+    def test_geometry_ragged_tail(self):
+        p = _plan("cwt")
+        assert p.num_shards == 7
+        assert p.shard_range(0) == (0, 10)
+        assert p.shard_range(6) == (60, 64)
+        assert sum(hi - lo for _, lo, hi in p.shards()) == N
+
+    def test_env_default_shard_rows(self, monkeypatch):
+        monkeypatch.setenv("SKYLARK_DIST_SHARD_ROWS", "16")
+        p = dp.ShardPlan(kind="cwt", n=N, s_dim=S_DIM, d=D)
+        assert p.rows_per_shard == 16 and p.num_shards == 4
+        # serialization pins the effective grid at dispatch time: a
+        # replica under a different env computes the same ranges
+        doc = p.to_dict()
+        monkeypatch.setenv("SKYLARK_DIST_SHARD_ROWS", "7")
+        assert dp.ShardPlan.from_dict(doc).rows_per_shard == 16
+
+    def test_roundtrip_and_fingerprint(self):
+        p = _plan("jlt")
+        q = dp.ShardPlan.from_dict(p.to_dict())
+        assert q.fingerprint() == p.fingerprint()
+        assert _plan("jlt", seed=6).fingerprint() != p.fingerprint()
+
+    @pytest.mark.parametrize("kw", [
+        dict(kind="nope"), dict(n=0), dict(s_dim=0),
+        dict(kind="srht", n=60), dict(shard_rows=-1),
+    ])
+    def test_validation(self, kw):
+        with pytest.raises(sk_errors.InvalidParametersError):
+            _plan(kw.pop("kind", "cwt"), **kw)
+
+
+# ---------------------------------------------------------------------------
+# partials: correctness vs the one-shot apply + re-execution identity
+# ---------------------------------------------------------------------------
+
+
+class TestPartials:
+    @pytest.mark.parametrize("kind", KINDS)
+    def test_full_merge_matches_oneshot_apply(self, data, kind):
+        A, Y = data
+        plan = _plan(kind)
+        res = dp.sketch_local(plan, dp.ArraySource(A, Y))
+        t = plan._transform()
+        ref = np.asarray(t.apply(jnp.asarray(A), sk.COLUMNWISE))
+        refy = np.asarray(t.apply(jnp.asarray(Y), sk.COLUMNWISE))
+        assert res.coverage == 1.0 and not res.degraded
+        if kind == "ust":
+            # sampler merges are placement, not addition: exact
+            assert np.array_equal(res.SX, ref)
+            assert np.array_equal(res.SY, refy)
+        else:
+            np.testing.assert_allclose(res.SX, ref, atol=1e-4)
+            np.testing.assert_allclose(res.SY, refy, atol=1e-4)
+
+    @pytest.mark.parametrize("kind", KINDS)
+    def test_reexecution_bit_equal(self, data, kind):
+        """Same shard, fresh transform state, different batching-free
+        source object: bit-identical partial (the idempotent-unit
+        contract)."""
+        A, Y = data
+        plan = _plan(kind)
+        p1 = dp.compute_shard(plan, 3, dp.ArraySource(A, Y))
+        p2 = dp.compute_shard(plan, 3, dp.ArraySource(A.copy(),
+                                                      Y.copy()))
+        assert set(p1) == set(p2)
+        for k in p1:
+            assert np.array_equal(p1[k], p2[k]), k
+
+    @pytest.mark.parametrize("kind", KINDS)
+    def test_reexecution_on_replica_bit_equal(self, data, kind):
+        """A shard re-executed through the fleet ``shard`` verb (a
+        different 'replica') reproduces the local partial bit-exactly
+        — the dispatch payload is the serialized plan."""
+        from libskylark_tpu.fleet import ThreadReplica
+
+        A, Y = data
+        plan = _plan(kind)
+        local = dp.compute_shard(plan, 2, dp.ArraySource(A, Y))
+        r = ThreadReplica("rx", max_batch=2)
+        try:
+            lo, hi = plan.shard_range(2)
+            out = r.shard({"plan": plan.to_dict(), "index": 2,
+                           "source": dp.ArraySource(A, Y).subrange(
+                               lo, hi)}).result(timeout=60.0)
+        finally:
+            r.shutdown()
+        assert out["index"] == 2 and out["rows"] == hi - lo
+        for k in local:
+            assert np.array_equal(local[k], out["partial"][k]), k
+
+    def test_subrange_ships_only_shard_rows(self, data):
+        A, Y = data
+        src = dp.ArraySource(A, Y)
+        sub = src.subrange(10, 20)
+        assert sub._X.shape == (10, D)
+        got = list(sub.read(10, 20))
+        assert len(got) == 1 and got[0][0] == 10
+        assert np.array_equal(got[0][1], A[10:20])
+        with pytest.raises(sk_errors.InvalidParametersError):
+            list(sub.read(0, 10))
+
+    def test_operator_panel_diagonal_amortization_bit_equal(self):
+        """The sessions appender's pre-generated full diagonal and the
+        shard tasks' per-slice stream must produce identical panel
+        bits (positional-stream invariance)."""
+        from libskylark_tpu import Context
+        from libskylark_tpu.sketch.fjlt import FJLT
+
+        t = FJLT(64, 16, Context(seed=2), fut="wht")
+        diag = np.asarray(t.diagonal(jnp.float32))
+        sliced = t.operator_panel(10, 30, np.float32)
+        amortized = t.operator_panel(10, 30, np.float32, diagonal=diag)
+        assert np.array_equal(sliced, amortized)
+
+    def test_batching_invariant_partial_cwt(self, data):
+        """CWT folds scatter in row order into the carried
+        accumulator: the partial is bit-identical across source batch
+        grids (the io/streaming invariant at shard scope)."""
+        A, _ = data
+        plan = _plan("cwt", targets=0)
+        outs = [dp.compute_shard(plan, 1,
+                                 dp.ArraySource(A, batch_rows=b))
+                for b in (0, 3, 4, 10)]
+        for o in outs[1:]:
+            assert np.array_equal(outs[0]["SX"], o["SX"])
+
+
+# ---------------------------------------------------------------------------
+# merge: order invariance + degraded accounting
+# ---------------------------------------------------------------------------
+
+
+class TestMerge:
+    @pytest.mark.parametrize("kind", KINDS)
+    def test_merge_order_invariance_property(self, data, kind):
+        """Any arrival permutation (and any grouping a coordinator
+        could have buffered them in) merges bit-equal: the merge
+        canonicalizes to ascending shard index and reduces through a
+        fixed pairwise tree, so the bits depend only on the present
+        SET of shards."""
+        A, Y = data
+        plan = _plan(kind)
+        parts = _partials(plan, dp.ArraySource(A, Y))
+        ref = dp.merge_partials(plan, parts)
+        rng = random.Random(0)
+        keys = list(parts)
+        for _ in range(6):
+            rng.shuffle(keys)
+            perm = {k: parts[k] for k in keys}
+            got = dp.merge_partials(plan, perm)
+            for name in ref:
+                assert np.array_equal(ref[name], got[name]), name
+        # subsets are deterministic too (the degraded-merge path):
+        # same present-set, any order => same bits
+        for drop in range(plan.num_shards):
+            sub = [k for k in parts if k != drop]
+            m1 = dp.merge_partials(plan, {k: parts[k] for k in sub})
+            m2 = dp.merge_partials(
+                plan, {k: parts[k] for k in reversed(sub)})
+            for name in m1:
+                assert np.array_equal(m1[name], m2[name]), name
+
+    def test_missing_ranges_coalesce(self):
+        plan = _plan("cwt")
+        assert dp.missing_ranges(plan, [0, 3, 6]) == \
+            ((10, 30), (40, 60))
+        assert dp.missing_ranges(plan, range(7)) == ()
+        assert dp.missing_ranges(plan, []) == ((0, 64),)
+
+    def test_degraded_result_accounting(self, data):
+        A, Y = data
+        plan = _plan("cwt")
+        parts = _partials(plan, dp.ArraySource(A, Y))
+        del parts[2], parts[3], parts[6]
+        res = dp.build_result(plan, parts)
+        assert isinstance(res, dp.DegradedSketchResult)
+        assert res.degraded
+        assert res.rows_merged == 40 and res.coverage == 40 / 64
+        assert res.missing == ((20, 40), (60, 64))
+        assert res.shards == 7 and res.shards_merged == 4
+        # the surviving-rows sketch is still a valid sketch: equal to
+        # the one-shot apply of the surviving rows zeroed-out data
+        mask = np.ones(N, bool)
+        mask[20:40] = mask[60:64] = False
+        ref = np.asarray(_plan("cwt")._transform().apply(
+            jnp.asarray(np.where(mask[:, None], A, 0.0)),
+            sk.COLUMNWISE))
+        np.testing.assert_allclose(res.SX, ref, atol=1e-4)
+
+    def test_min_coverage_gate(self, data):
+        A, Y = data
+        plan = _plan("cwt")
+        parts = _partials(plan, dp.ArraySource(A, Y))
+        del parts[5]
+        res = dp.build_result(plan, parts)
+        assert res.require(0.8) is res
+        with pytest.raises(sk_errors.SketchCoverageError) as ei:
+            res.require(1.0)
+        assert ei.value.coverage == res.coverage
+        assert ei.value.missing == ((50, 60),)
+
+    def test_merge_fault_site(self, data):
+        A, Y = data
+        plan = _plan("cwt")
+        parts = _partials(plan, dp.ArraySource(A, Y))
+        with faults.fault_plan({"seed": 1, "faults": [
+                {"site": "dist.merge", "error": "SketchError"}]}):
+            with pytest.raises(sk_errors.SketchError):
+                dp.merge_partials(plan, parts)
+
+
+# ---------------------------------------------------------------------------
+# ingest: grid alignment + resume-at-consumed-offset
+# ---------------------------------------------------------------------------
+
+
+class _FlakyOnce(dp.ShardSource):
+    """Wraps a source; the first ``read`` raises after ``ok_batches``
+    yields — the transient-mid-shard transport failure. Records every
+    read's start offset so the test can assert the resume point."""
+
+    def __init__(self, inner, ok_batches):
+        self.inner = inner
+        self.n, self.d, self.targets = (inner.n, inner.d,
+                                        inner.targets)
+        self.ok_batches = ok_batches
+        self.read_offsets = []
+        self._tripped = False
+
+    def read(self, lo, hi):
+        self.read_offsets.append(lo)
+        it = self.inner.read(lo, hi)
+        for k, item in enumerate(it):
+            if not self._tripped and k == self.ok_batches:
+                self._tripped = True
+                raise sk_errors.IOError_("injected transport loss")
+            yield item
+
+
+class TestIngest:
+    def test_resume_at_consumed_offset(self, data):
+        A, _ = data
+        plan = _plan("cwt", targets=0, shard_rows=20)
+        flaky = _FlakyOnce(dp.ArraySource(A, batch_rows=4), 2)
+        out = dp.compute_shard(plan, 1, flaky)
+        ref = dp.compute_shard(plan, 1, dp.ArraySource(A,
+                                                       batch_rows=4))
+        assert np.array_equal(out["SX"], ref["SX"])
+        # first read started at the shard base; the retry re-entered
+        # at the consumed offset (2 batches in), not from scratch
+        assert flaky.read_offsets == [20, 28]
+
+    def test_ingest_fault_site_resumes(self, data):
+        A, _ = data
+        plan = _plan("cwt", targets=0, shard_rows=20)
+        src = dp.ArraySource(A, batch_rows=4)
+        with faults.fault_plan({"seed": 1, "faults": [
+                {"site": "dist.ingest", "error": "IOError_",
+                 "on_hit": 3}]}) as p:
+            out = dp.compute_shard(plan, 0, src)
+        assert [f[0] for f in p.fired] == ["dist.ingest"]
+        ref = dp.compute_shard(plan, 0, src)
+        assert np.array_equal(out["SX"], ref["SX"])
+
+    def test_short_source_raises_after_retries(self, data):
+        """A stream that ends before the shard bound must surface (no
+        fabricated rows) — after the retry ladder gave a reconnect its
+        shot."""
+        A, _ = data
+        plan = _plan("cwt", targets=0)
+        reads = []
+
+        class Short(dp.ShardSource):
+            n, d, targets = N, D, 0
+
+            def read(self, lo, hi):
+                reads.append(lo)
+                yield lo, A[lo:hi - 2], None
+
+        from libskylark_tpu.resilience.policy import RetryPolicy
+
+        with pytest.raises(sk_errors.IOError_):
+            dp.compute_shard(plan, 0, Short(), retry=RetryPolicy(
+                max_attempts=3, base_delay=0.0, max_delay=0.0,
+                jitter="none", sleep=lambda s: None))
+        assert len(reads) == 3      # the ladder re-entered, then gave up
+
+    def test_grid_spans_absolute(self):
+        assert list(dp._grid_spans(0, 10, 4)) == [(0, 4), (4, 8),
+                                                  (8, 10)]
+        # a resumed read (lo = prior batch end) keeps the boundaries
+        assert list(dp._grid_spans(4, 10, 4)) == [(4, 8), (8, 10)]
+        assert list(dp._grid_spans(3, 10, 4)) == [(3, 4), (4, 8),
+                                                  (8, 10)]
+        assert list(dp._grid_spans(3, 10, 0)) == [(3, 10)]
+
+
+class TestFileSources:
+    def test_hdf5_source_matches_array(self, data, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        A, Y = data
+        path = str(tmp_path / "rows.h5")
+        with h5py.File(path, "w") as f:
+            f["X"] = A
+            f["Y"] = Y
+        src = dp.HDF5Source.probe(path, batch_rows=10)
+        assert (src.n, src.d, src.targets) == (N, D, TARGETS)
+        plan = _plan("cwt")
+        res = dp.sketch_local(plan, src)
+        ref = dp.sketch_local(plan, dp.ArraySource(A, Y,
+                                                   batch_rows=10))
+        assert np.array_equal(res.SX, ref.SX)
+        assert np.array_equal(res.SY, ref.SY)
+
+    def test_libsvm_source_range_reads(self, tmp_path):
+        rng = np.random.default_rng(3)
+        Araw = rng.integers(1, 5, size=(N, D)).astype(np.float32)
+        y = rng.integers(0, 2, size=N)
+        path = tmp_path / "rows.svm"
+        with open(path, "w") as f:
+            for i in range(N):
+                feats = " ".join(f"{j + 1}:{Araw[i, j]:.1f}"
+                                 for j in range(D))
+                f.write(f"{y[i]} {feats}\n")
+        src = dp.LibsvmSource(path=str(path), n=N, d=D, targets=1,
+                              batch_rows=10)
+        got = np.concatenate([X for _, X, _ in src.read(15, 40)])
+        assert np.array_equal(got, Araw[15:40])
+        plan = dp.ShardPlan(kind="cwt", n=N, s_dim=S_DIM, d=D, seed=5,
+                            targets=1, shard_rows=10)
+        res = dp.sketch_local(plan, src)
+        ref = dp.sketch_local(
+            plan, dp.ArraySource(Araw, y.astype(np.float32),
+                                 batch_rows=10))
+        assert np.array_equal(res.SX, ref.SX)
+        assert np.array_equal(res.SY, ref.SY)
+
+
+# ---------------------------------------------------------------------------
+# coordinator: fleet dispatch, retries, reassignment, hedging
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def thread_pool():
+    from libskylark_tpu import fleet
+
+    pool = fleet.ReplicaPool(2, max_batch=4)
+    yield pool
+    pool.shutdown()
+
+
+class TestCoordinator:
+    def test_fleet_bit_equal_to_local(self, data, thread_pool):
+        A, Y = data
+        plan = _plan("jlt")
+        src = dp.ArraySource(A, Y)
+        ref = dp.sketch_local(plan, src)
+        co = dist.DistSketchCoordinator(thread_pool)
+        res = co.sketch(plan, src)
+        assert np.array_equal(res.SX, ref.SX)
+        assert np.array_equal(res.SY, ref.SY)
+        st = co.stats()
+        assert st["dispatched"] == plan.num_shards
+        assert sum(st["by_replica"].values()) == plan.num_shards
+        assert len(st["by_replica"]) == 2   # both replicas drew work
+
+    def test_local_mode_no_fleet(self, data):
+        A, Y = data
+        plan = _plan("srht")
+        src = dp.ArraySource(A, Y)
+        co = dist.DistSketchCoordinator()
+        res = co.sketch(plan, src)
+        ref = dp.sketch_local(plan, src)
+        assert np.array_equal(res.SX, ref.SX)
+
+    def test_injected_faults_retry_and_reassign(self, data,
+                                                thread_pool):
+        A, Y = data
+        plan = _plan("cwt")
+        src = dp.ArraySource(A, Y)
+        ref = dp.sketch_local(plan, src)
+        co = dist.DistSketchCoordinator(thread_pool, retries=3,
+                                        max_inflight=1)
+        with faults.fault_plan({"seed": 7, "faults": [
+                {"site": "dist.shard", "error": "IOError_",
+                 "every": 3}]}) as p:
+            res = co.sketch(plan, src)
+        assert p.fired and res.coverage == 1.0
+        assert np.array_equal(res.SX, ref.SX)
+        st = co.stats()
+        assert st["retried"] == len(p.fired)
+        assert st["reassigned"] >= 1 and st["abandoned"] == 0
+
+    def test_exhausted_budget_gates_and_degrades(self, data,
+                                                 thread_pool):
+        A, Y = data
+        plan = _plan("cwt")
+        src = dp.ArraySource(A, Y)
+        kill = {"seed": 7, "faults": [
+            {"site": "dist.shard", "error": "IOError_", "after": 2}]}
+        co = dist.DistSketchCoordinator(thread_pool, retries=1,
+                                        max_inflight=1)
+        with faults.fault_plan(kill):
+            with pytest.raises(sk_errors.SketchCoverageError):
+                co.sketch(plan, src)           # default gate 1.0
+        co2 = dist.DistSketchCoordinator(thread_pool, retries=1,
+                                         max_inflight=1)
+        with faults.fault_plan(kill):
+            res = co2.sketch(plan, src, min_coverage=0.2)
+        assert isinstance(res, dp.DegradedSketchResult)
+        assert res.rows_merged == 20 and res.missing == ((20, 64),)
+        assert co2.stats()["abandoned"] == 5
+
+    def test_logic_errors_propagate_immediately(self, data,
+                                                thread_pool):
+        A, Y = data
+        plan = _plan("cwt", n=N * 2)        # source too small
+        co = dist.DistSketchCoordinator(thread_pool)
+        with pytest.raises(sk_errors.InvalidParametersError):
+            co.sketch(plan, dp.ArraySource(A, Y))
+
+    def test_hedge_rescues_straggler(self, data, thread_pool):
+        import time as _time
+
+        A, Y = data
+        plan = _plan("cwt")
+        src = dp.ArraySource(A, Y)
+        ref = dp.sketch_local(plan, src)
+        co = dist.DistSketchCoordinator(thread_pool, retries=2,
+                                        hedge=True,
+                                        hedge_delay_s=0.25)
+        t0 = _time.monotonic()
+        with faults.fault_plan({"seed": 7, "faults": [
+                {"site": "dist.shard", "stall_s": 20.0,
+                 "on_hit": 1}]}):
+            res = co.sketch(plan, src)
+        assert _time.monotonic() - t0 < 15.0
+        assert co.stats()["hedged"] == 1
+        assert np.array_equal(res.SX, ref.SX)
+
+    def test_hedge_twins_completing_together(self, data):
+        """Primary and mirror resolving within one wait window must
+        not crash the loop (regression: the winner purges its twin
+        from the tracking map while the twin still sits in the done
+        set)."""
+        from concurrent.futures import Future
+
+        A, Y = data
+        plan = _plan("cwt", shard_rows=64)      # one shard
+        src = dp.ArraySource(A, Y)
+        ref = dp.sketch_local(plan, src)
+        pending = []
+
+        class FakeReplica:
+            def __init__(self, name):
+                self.name = name
+
+            def state(self):
+                return "SERVING"
+
+            def shard(self, task):
+                fut = Future()
+                if not pending:
+                    pending.append((fut, task))     # primary: stall
+                else:
+                    # the mirror: resolve BOTH twins at once, so both
+                    # land in the same wait round's done set
+                    out = dp.execute_task(task)
+                    pfut, _ = pending[0]
+                    pfut.set_result(out)
+                    fut.set_result(out)
+                return fut
+
+        co = dist.DistSketchCoordinator(
+            replicas=[FakeReplica("a"), FakeReplica("b")],
+            retries=1, hedge=True, hedge_delay_s=0.05)
+        res = co.sketch(plan, src)
+        assert np.array_equal(res.SX, ref.SX)
+        assert co.stats()["hedged"] == 1
+
+    def test_env_knob_defaults(self, monkeypatch):
+        monkeypatch.setenv("SKYLARK_DIST_RETRIES", "9")
+        monkeypatch.setenv("SKYLARK_DIST_MIN_COVERAGE", "0.5")
+        monkeypatch.setenv("SKYLARK_DIST_HEDGE", "1")
+        monkeypatch.setenv("SKYLARK_DIST_HEDGE_DELAY_MS", "250")
+        co = dist.DistSketchCoordinator()
+        assert co.retries == 9
+        assert co.min_coverage == 0.5
+        assert co.hedge is True and co.hedge_delay_s == 0.25
+
+    def test_lifetime_collector(self, data):
+        A, Y = data
+        before = dist.dist_stats()
+        co = dist.DistSketchCoordinator()
+        co.sketch(_plan("cwt"), dp.ArraySource(A, Y))
+        after = dist.dist_stats()
+        assert after["dispatched"] >= before["dispatched"] + 7
+        assert after["merges"] == before["merges"] + 1
+        assert after["last_coverage"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# sketch-size-communication algorithms
+# ---------------------------------------------------------------------------
+
+
+class TestAlgorithms:
+    def test_randomized_svd_recovers_spectrum(self):
+        rng = np.random.default_rng(4)
+        U = np.linalg.qr(rng.standard_normal((256, 4)))[0]
+        V = np.linalg.qr(rng.standard_normal((12, 4)))[0]
+        svals = np.array([10.0, 6.0, 3.0, 1.0])
+        A = (U * svals) @ V.T
+        out = dist.randomized_svd(
+            dp.ArraySource(A.astype(np.float32)), rank=4, s_dim=64,
+            seed=3, shard_rows=64)
+        assert out["coverage"] == 1.0 and not out["degraded"]
+        np.testing.assert_allclose(out["singular_values"], svals,
+                                   rtol=0.2)
+
+    def test_sketched_lstsq_recovers_coef(self):
+        rng = np.random.default_rng(5)
+        X = rng.standard_normal((512, 6)).astype(np.float32)
+        w = rng.standard_normal((6, 1)).astype(np.float32)
+        src = dp.ArraySource(X, X @ w)
+        out = dist.sketched_lstsq(src, s_dim=128, seed=3,
+                                  shard_rows=128)
+        assert out["coverage"] == 1.0
+        np.testing.assert_allclose(out["coef"], w, atol=5e-2)
+
+    def test_degraded_svd_reports_coverage(self, thread_pool):
+        rng = np.random.default_rng(6)
+        A = rng.standard_normal((64, D)).astype(np.float32)
+        co = dist.DistSketchCoordinator(thread_pool, retries=0,
+                                        max_inflight=1)
+        with faults.fault_plan({"seed": 7, "faults": [
+                {"site": "dist.shard", "error": "IOError_",
+                 "on_hit": 3}]}):
+            out = dist.randomized_svd(
+                dp.ArraySource(A), rank=2, s_dim=8, seed=3,
+                shard_rows=16, coordinator=co, min_coverage=0.5)
+        assert out["degraded"] and out["coverage"] == 48 / 64
+        assert out["missing"] == [(32, 48)]
+
+    def test_lstsq_requires_targets(self):
+        with pytest.raises(sk_errors.InvalidParametersError):
+            dist.sketched_lstsq(
+                dp.ArraySource(np.zeros((8, 2), np.float32)), s_dim=4)
+
+
+# ---------------------------------------------------------------------------
+# process replicas: the real preemption domain (slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestProcessReplicaE2E:
+    def test_crash_mid_storm_reassigns_bit_equal(self, data):
+        import json as _json
+
+        from libskylark_tpu import fleet
+
+        A, Y = data
+        plan = _plan("cwt")
+        src = dp.ArraySource(A, Y)
+        ref = dp.sketch_local(plan, src)
+        crash = _json.dumps({"seed": 7, "faults": [
+            {"site": "dist.shard", "crash": True, "on_hit": 2}]})
+
+        def victim_env(name):
+            return ({"SKYLARK_FAULT_PLAN": crash}
+                    if name == "r0" else None)
+
+        pool = fleet.ReplicaPool(2, backend="process", max_batch=4,
+                                 replica_env=victim_env)
+        try:
+            co = dist.DistSketchCoordinator(pool, retries=3)
+            res = co.sketch(plan, src)
+            assert np.array_equal(res.SX, ref.SX)
+            assert np.array_equal(res.SY, ref.SY)
+            assert res.coverage == 1.0
+            assert pool.crashed_names() == ["r0"]
+            st = co.stats()
+            assert st["reassigned"] >= 1 and st["abandoned"] == 0
+        finally:
+            pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# merge-order invariance across execution paths (the property the
+# chaos gates lean on): local, fleet, permuted — one bit pattern
+# ---------------------------------------------------------------------------
+
+
+def test_all_paths_one_bit_pattern(data):
+    A, Y = data
+    plan = _plan("cwt")
+    src = dp.ArraySource(A, Y)
+    ref = dp.sketch_local(plan, src)
+    parts = _partials(plan, src)
+    for perm in itertools.islice(
+            itertools.permutations(list(parts)), 0, 24, 7):
+        got = dp.merge_partials(plan, {k: parts[k] for k in perm})
+        assert np.array_equal(got["SX"], ref.SX)
+        assert np.array_equal(got["SY"], ref.SY)

@@ -112,7 +112,7 @@ class TestOneShotEquality:
     def test_popcount_parity_fallback_matches(self, monkeypatch):
         """The numpy<2 xor-fold path must agree with bitwise_count —
         srht operator bits may not depend on the numpy version."""
-        from libskylark_tpu.sessions.state import _popcount_parity
+        from libskylark_tpu.sketch.fjlt import _popcount_parity
 
         a = np.random.default_rng(0).integers(
             0, 2**63, size=256, dtype=np.uint64)
