@@ -450,6 +450,37 @@ USE_PLAN_CACHE = declare(
     doc="Consult the plan cache at dispatch time (default on); "
         "``0`` disables all cached-plan consultation.")
 
+# -- sparse serve operands (engine/serve.py, docs/serving) ------------------
+
+SPARSE_MIN_DENSITY = declare(
+    "SKYLARK_SPARSE_MIN_DENSITY", default=0.25, parser=parse_float,
+    kind="float",
+    doc="Density (nnz / height·width) at or above which ``submit_"
+        "sparse`` auto-densifies the operand onto the dense serve "
+        "path instead of the CSR lanes (counted as "
+        "``serve.sparse_densified``). At high density the padded "
+        "CSR lanes carry more bytes than the dense operand and the "
+        "O(nnz) scatter loses to the dense contraction.")
+
+SPARSE_NNZ_FLOOR = declare(
+    "SKYLARK_SPARSE_NNZ_FLOOR", default=64, parser=parse_positive_int,
+    kind="int",
+    doc="Granularity floor of the serve layer's pow2 **nnz class**: "
+        "requests below this many nonzeros share one class, so a "
+        "flood of tiny sparse requests coalesces into a single "
+        "bucket instead of one per exact nnz.")
+
+SPARSE_KERNEL = declare(
+    "SKYLARK_SPARSE_KERNEL", default=None, kind="choice", propagate=True,
+    parser=lambda raw: (raw.strip().lower()
+                        if raw.strip().lower() in SERVE_KERNEL_BACKENDS
+                        else None),
+    doc="Flush-kernel pin for the sparse serve family only "
+        "(``pallas`` | ``xla``); sits between the executor "
+        "``kernel=`` argument and ``SKYLARK_SERVE_KERNEL`` in the "
+        "sparse buckets' precedence. Anything else degrades to the "
+        "general precedence chain.")
+
 # -- sketch kernels ---------------------------------------------------------
 
 PALLAS_MTILE = declare(

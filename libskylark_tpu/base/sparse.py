@@ -82,6 +82,26 @@ class SparseMatrix:
         return cls(A.indptr, A.indices, A.data, A.shape)
 
     @classmethod
+    def from_csr(
+        cls,
+        data,
+        indices,
+        indptr,
+        shape: Tuple[int, int],
+    ) -> "SparseMatrix":
+        """Build from CSR parts (the serve wire format — the inverse of
+        :meth:`csr_parts`). Converted to the canonical CSC host layout;
+        duplicates are summed (ref: sparse_matrix.hpp set():136)."""
+        import scipy.sparse as sp
+
+        A = sp.csr_matrix(
+            (np.asarray(data), np.asarray(indices), np.asarray(indptr)),
+            shape=shape,
+        ).tocsc()
+        A.sum_duplicates()
+        return cls(A.indptr, A.indices, A.data, A.shape)
+
+    @classmethod
     def from_dense(cls, A, threshold: float = 0.0) -> "SparseMatrix":
         import scipy.sparse as sp
 
@@ -107,6 +127,13 @@ class SparseMatrix:
     @property
     def nnz(self) -> int:
         return len(self._values)
+
+    @property
+    def density(self) -> float:
+        """nnz / (height·width) — the serve layer's auto-densify signal
+        (``SKYLARK_SPARSE_MIN_DENSITY``, docs/serving)."""
+        cells = self._shape[0] * self._shape[1]
+        return (len(self._values) / cells) if cells else 0.0
 
     @property
     def dtype(self):
@@ -156,6 +183,27 @@ class SparseMatrix:
             )
         return self._coo_cache
 
+    def csr_parts(self, dtype=None) -> Tuple[np.ndarray, np.ndarray,
+                                             np.ndarray]:
+        """Canonical CSR parts ``(data, indices, indptr)`` as host numpy
+        arrays — row-major, sorted column indices, duplicates summed —
+        the lane layout the sparse serve endpoints pack
+        (:mod:`libskylark_tpu.engine.serve`, ``submit_sparse``). The
+        row-major nonzero order is load-bearing: the serve scatter
+        accumulates in exactly this order, which is what makes the CSR
+        flush bit-equal to the dense reference's row-order
+        ``segment_sum`` (docs/serving, "Sparse operands on the serve
+        path"). ``dtype=None`` resolves to :attr:`device_dtype` (the
+        f32 precision-policy default)."""
+        eff = np.dtype(dtype) if dtype is not None else np.dtype(
+            jax.dtypes.canonicalize_dtype(self.device_dtype))
+        A = self.to_scipy().tocsr()
+        A.sum_duplicates()
+        A.sort_indices()
+        return (np.asarray(A.data, dtype=eff),
+                np.asarray(A.indices, dtype=np.int32),
+                np.asarray(A.indptr, dtype=np.int32))
+
     def todense(self, dtype=None) -> jax.Array:
         r, c, v = self.coo(dtype)
         return jnp.zeros(self._shape, v.dtype).at[r, c].add(v)
@@ -204,11 +252,45 @@ def is_sparse_operand(A) -> bool:
     return isinstance(A, (SparseMatrix, DistSparseMatrix))
 
 
+# The sparse products route through the engine's executable cache
+# (:mod:`libskylark_tpu.engine.compiled`): eagerly, every spmm call
+# re-dispatched a gather + multiply + segment_sum op-by-op — repeated
+# sparse products over the same shapes (ADMM sweeps, blocked sketch
+# loops, the serve layer's densify A/B) paid per-call op dispatch and
+# jax-level retracing instead of one cached executable. The wrappers
+# are built lazily (first product) so importing ``base.sparse`` never
+# pulls the engine, and keyed on the op name + avals (nnz and operand
+# shapes are static per call signature), so the jit-leak gate's
+# zero-recompile contract covers them.
+_COMPILED_PRODUCTS: dict = {}
+
+
+def _product_kernel(op: str):
+    cf = _COMPILED_PRODUCTS.get(op)
+    if cf is None:
+        from libskylark_tpu.engine.compiled import compiled as _compiled
+
+        if op == "spmm":
+            def kern(r, c, v, B, *, segments: int):
+                return jax.ops.segment_sum(v[:, None] * B[c], r,
+                                           num_segments=segments)
+        else:
+            def kern(r, c, v, B, *, segments: int):
+                return jax.ops.segment_sum(v[:, None] * B[r], c,
+                                           num_segments=segments)
+        cf = _compiled(kern, name=f"sparse.{op}",
+                       static_argnames=("segments",),
+                       key_fn=lambda *a, **k: (op,))
+        _COMPILED_PRODUCTS[op] = cf
+    return cf
+
+
 def spmm(A: SparseMatrix, B) -> jax.Array:
     """A @ B with A sparse (h×w), B dense (w×k) → dense (h×k).
 
     Segment-sum over nonzeros (ref: base/Gemm.hpp:335-519 CSC kernels):
-    out[r] += v · B[c] for each (r, c, v)."""
+    out[r] += v · B[c] for each (r, c, v) — one cached executable per
+    (nnz, operand-shape) class via ``engine.compiled``."""
     B = jnp.asarray(B)
     squeeze = B.ndim == 1
     if squeeze:
@@ -218,9 +300,7 @@ def spmm(A: SparseMatrix, B) -> jax.Array:
             f"spmm: A is {A.shape}, B is {B.shape}"
         )
     r, c, v = A.coo(B.dtype)
-    out = jax.ops.segment_sum(
-        v[:, None] * B[c], r, num_segments=A.height
-    )
+    out = _product_kernel("spmm")(r, c, v, B, segments=A.height)
     return out[:, 0] if squeeze else out
 
 
@@ -235,9 +315,7 @@ def spmm_t(A: SparseMatrix, B) -> jax.Array:
             f"spmm_t: A is {A.shape}, B is {B.shape}"
         )
     r, c, v = A.coo(B.dtype)
-    out = jax.ops.segment_sum(
-        v[:, None] * B[r], c, num_segments=A.width
-    )
+    out = _product_kernel("spmm_t")(r, c, v, B, segments=A.width)
     return out[:, 0] if squeeze else out
 
 

@@ -57,6 +57,20 @@ def pad_shape(shape: Sequence[int], pad_axes: Sequence[int],
     )
 
 
+def nnz_class(nnz: int, floor: int = 64) -> int:
+    """The **nnz class** of a sparse operand: next power of two ≥
+    max(nnz, floor). Sparse serve buckets key on this alongside the
+    padded dims/dtype (docs/serving, "Sparse operands on the serve
+    path"): two ragged-nnz requests in one class pad their (data,
+    indices) lanes to the class extent and coalesce into one flush
+    executable — padding entries carry value 0.0 at position 0, which
+    contributes exact zeros through every sparse endpoint. The floor
+    (``SKYLARK_SPARSE_NNZ_FLOOR``) keeps a flood of tiny sparse
+    requests in a single bucket, the same anti-fragmentation role
+    ``PAD_FLOOR`` plays for dense extents."""
+    return pow2_pad(nnz, max(int(floor), 1))
+
+
 def capacity_class(k: int, max_batch: int, multiple: int = 1) -> int:
     """Batch capacity for a cohort of ``k`` requests: pow2 ≥ k, clamped
     to ``max_batch``, then rounded up to ``multiple`` (the mesh device

@@ -9,6 +9,18 @@ sketched least squares, KRR predict — and are grouped by **bucket**:
 (endpoint statics, dtype, pow2 shape class, sharding) as defined in
 :mod:`libskylark_tpu.engine.bucket`.
 
+Sparse operands are first-class (docs/serving, "Sparse operands on
+the serve path"): :meth:`~MicrobatchExecutor.submit_sparse` /
+:meth:`~MicrobatchExecutor.submit_sparse_solve` pack a
+:class:`~libskylark_tpu.base.sparse.SparseMatrix` (or scipy sparse)
+operand as padded (data, indices, indptr) CSR lanes whose bucket keys
+carry a pow2 **nnz class** next to the dims/dtype — ragged-nnz
+cohorts coalesce into one flush executable, bit-equal to the dense
+reference (``todense()`` → ``transform.apply``), with operands past
+``SKYLARK_SPARSE_MIN_DENSITY`` auto-densified onto the dense path
+(counted). Sparse CWT buckets participate in the flush-kernel ladder
+via :mod:`libskylark_tpu.sketch.pallas_sparse`.
+
 Flush kernels: the sketch-apply and fastfood buckets can flush through
 the endpoint's **batched Pallas kernel** (one ``pallas_call`` over the
 stacked cohort — ``sketch/pallas_hash.py`` scatter-free CountSketch,
@@ -103,6 +115,7 @@ import numpy as np
 from libskylark_tpu import telemetry as _telemetry
 from libskylark_tpu.base import env as _env
 from libskylark_tpu.base import locks as _locks
+from libskylark_tpu.telemetry import metrics as _metrics
 from libskylark_tpu.engine import bucket as bucketing
 from libskylark_tpu.engine.compiled import compiled as engine_compile
 from libskylark_tpu.engine.compiled import digest as engine_digest
@@ -112,12 +125,39 @@ from libskylark_tpu.resilience.policy import Deadline
 from libskylark_tpu.telemetry import trace as _trace
 
 ENDPOINTS = ("sketch_apply", "fastfood_features", "solve_l2_sketched",
-             "krr_predict")
+             "krr_predict", "sparse_sketch_apply",
+             "sparse_solve_l2_sketched")
 
 # endpoints with a batched Pallas flush kernel behind the selection
 # seam (arg > env > plan cache > default); the others always flush
 # through the vmapped XLA path
-_KERNEL_ENDPOINTS = ("sketch_apply", "fastfood_features")
+_KERNEL_ENDPOINTS = ("sketch_apply", "fastfood_features",
+                     "sparse_sketch_apply")
+
+# sparse-operand intake telemetry (docs/serving, "Sparse operands on
+# the serve path") — registry metrics created HERE once (the
+# metric-names rule's one-creation-site contract); the per-executor
+# disaggregation lives in ``stats()["sparse"]`` and rides the serve
+# collector.
+_SPARSE_SUBMITS = _metrics.counter(
+    "serve.sparse_submits",
+    "Sparse (CSR) serve submissions accepted by submit_sparse / "
+    "submit_sparse_solve, before the densify decision")
+_SPARSE_DENSIFIED = _metrics.counter(
+    "serve.sparse_densified",
+    "Sparse submissions auto-densified onto the dense serve path "
+    "(operand density >= SKYLARK_SPARSE_MIN_DENSITY)")
+_SPARSE_KERNEL_FLUSHES = _metrics.counter(
+    "serve.sparse_kernel_flushes",
+    "Sparse-bucket flushes by resolved flush backend (pallas = the "
+    "scatter-free sparse-CountSketch kernel, xla = the O(nnz) "
+    "scatter)")
+_SPARSE_NNZ_HIST = _metrics.histogram(
+    "serve.sparse_nnz_class",
+    "pow2 nnz class of accepted sparse submissions — the sparse "
+    "bucket-population signal (one bucket per (shape class, nnz "
+    "class, dtype))",
+    buckets=tuple(float(1 << p) for p in range(6, 21)))
 
 _KERNEL_BACKENDS = _env.SERVE_KERNEL_BACKENDS
 
@@ -312,6 +352,89 @@ def _fastfood_statics(transform, A, pad_floor):
                      "family": type(transform).sketch_type}
 
 
+def _coerce_sparse(A):
+    """The framework's :class:`~libskylark_tpu.base.sparse
+    .SparseMatrix` view of a sparse serve operand (scipy sparse
+    accepted and attached zero-copy where possible). Dense operands
+    are a type error — they belong on ``submit_sketch``."""
+    from libskylark_tpu.base.sparse import SparseMatrix
+
+    if isinstance(A, SparseMatrix):
+        return A
+    try:
+        import scipy.sparse as sp
+
+        if sp.issparse(A):
+            return SparseMatrix.from_scipy(A)
+    except ImportError:  # pragma: no cover - scipy is a hard dep here
+        pass
+    raise TypeError(
+        "sparse serve endpoints take a SparseMatrix or scipy.sparse "
+        f"operand; got {type(A).__name__} (dense operands go through "
+        "submit_sketch)")
+
+
+def _sparse_sketch_statics(transform, A, dimension, pad_floor):
+    """(statics, info) for a sparse_sketch_apply request: the CSR twin
+    of :func:`_sketch_statics`, with the pow2 **nnz class**
+    (``engine.bucket.nnz_class`` at the ``SKYLARK_SPARSE_NNZ_FLOOR``
+    granularity) riding the statics next to the padded dims/dtype —
+    two ragged-nnz requests in one class share one flush executable,
+    their (data, indices) lanes zero-padded to the class extent."""
+    from libskylark_tpu.sketch import COLUMNWISE, Dimension
+
+    dimension = dimension or COLUMNWISE
+    rowwise = Dimension(dimension) == Dimension.ROWWISE
+    A = _coerce_sparse(A)
+    n = A.width if rowwise else A.height
+    if n != transform.input_dim:
+        raise ValueError(
+            f"operand dim {n} != transform input dim "
+            f"{transform.input_dim}")
+    family, dist = _sketch_family(transform)
+    padded = bucketing.pad_shape(A.shape, (0, 1), pad_floor)
+    nnz_cls = bucketing.nnz_class(A.nnz, _env.SPARSE_NNZ_FLOOR.get())
+    dtype = str(np.dtype(A.device_dtype))
+    statics = ("sparse_sketch_apply", family, repr(dist),
+               transform.sketch_dim, rowwise, dtype, padded, nnz_cls)
+    return statics, {"A": A, "family": family, "dist": dist,
+                     "rowwise": rowwise, "padded": padded,
+                     "nnz_class": nnz_cls, "dtype": dtype}
+
+
+def _sparse_solve_statics(transform, A, B, method, pad_floor):
+    """(statics, info) for a sparse_solve_l2_sketched request: CSR
+    design matrix, dense target block."""
+    A = _coerce_sparse(A)
+    B = np.asarray(B)
+    squeeze = B.ndim == 1
+    if squeeze:
+        B = B[:, None]
+    if B.shape[0] != A.height:
+        raise ValueError(f"solve expects (n,d) A and (n,t) B, got "
+                         f"{A.shape} / {B.shape}")
+    if A.height != transform.input_dim:
+        raise ValueError(
+            f"operand rows {A.height} != transform input dim "
+            f"{transform.input_dim}")
+    family, dist = _sketch_family(transform)
+    if family not in ("JLT", "CWT"):
+        raise TypeError(f"sparse solve serve path supports JLT/CWT, "
+                        f"got {family}")
+    n_pad = bucketing.pow2_pad(A.height, pad_floor)
+    nnz_cls = bucketing.nnz_class(A.nnz, _env.SPARSE_NNZ_FLOOR.get())
+    dtype = str(np.dtype(A.device_dtype))
+    # d and t are exact bucket components (zero feature/target columns
+    # would make the compressed problem singular) — same rule as the
+    # dense solve bucket
+    statics = ("sparse_solve_l2_sketched", family,
+               transform.sketch_dim, method, A.width, B.shape[1],
+               dtype, n_pad, nnz_cls)
+    return statics, {"A": A, "B": B, "squeeze": squeeze,
+                     "family": family, "n_pad": n_pad,
+                     "nnz_class": nnz_cls, "dtype": dtype}
+
+
 def _solve_statics(transform, A, B, method, pad_floor):
     """(statics, info) for a solve_l2_sketched request."""
     A = np.asarray(A)
@@ -404,6 +527,15 @@ def derive_request(endpoint: str, *,
         return _krr_statics(kwargs["kernel"], kwargs["X_new"],
                             kwargs["X_train"], kwargs["coef"],
                             pad_floor)
+    if endpoint == "sparse_sketch_apply":
+        kwargs.setdefault("dimension", None)
+        return _sparse_sketch_statics(kwargs["transform"], kwargs["A"],
+                                      kwargs["dimension"], pad_floor)
+    if endpoint == "sparse_solve_l2_sketched":
+        kwargs.setdefault("method", "qr")
+        return _sparse_solve_statics(kwargs["transform"], kwargs["A"],
+                                     kwargs["B"], kwargs["method"],
+                                     pad_floor)
     raise ValueError(f"unknown serve endpoint {endpoint!r}; "
                      f"expected one of {ENDPOINTS}")
 
@@ -504,6 +636,12 @@ class MicrobatchExecutor:
         self._kernel_dec: "collections.Counter" = collections.Counter()
         self._batch_hist: "collections.Counter" = collections.Counter()
         self._cohort_hist: "collections.Counter" = collections.Counter()
+        # sparse-operand intake/flush disaggregation (docs/serving,
+        # "Sparse operands on the serve path")
+        self._sparse_kernel_sel: "collections.Counter" = \
+            collections.Counter()
+        self._sparse_nnz_hist: "collections.Counter" = \
+            collections.Counter()
         self._pad_real = 0
         self._pad_total = 0
         self._latency = collections.deque(maxlen=8192)
@@ -585,6 +723,12 @@ class MicrobatchExecutor:
             elif endpoint == "krr_predict":
                 key, statics, ctx, req = self._prep_krr(
                     _derived=derived, **kwargs)
+            elif endpoint == "sparse_sketch_apply":
+                key, statics, ctx, req = self._prep_sparse_sketch(
+                    _derived=derived, **kwargs)
+            elif endpoint == "sparse_solve_l2_sketched":
+                key, statics, ctx, req = self._prep_sparse_solve(
+                    _derived=derived, **kwargs)
             else:
                 raise ValueError(f"unknown serve endpoint {endpoint!r}; "
                                  f"expected one of {ENDPOINTS}")
@@ -613,6 +757,65 @@ class MicrobatchExecutor:
     def submit_solve(self, A, B, transform, method: str = "qr",
                      **kw) -> Future:
         return self.submit("solve_l2_sketched", A=A, B=B,
+                           transform=transform, method=method, **kw)
+
+    def _note_sparse_intake(self, A) -> bool:
+        """Count one sparse submission and decide the densify fallback
+        (docs/serving, "Sparse operands on the serve path"): an operand
+        at or above ``SKYLARK_SPARSE_MIN_DENSITY`` routes to the dense
+        endpoint — at high density the padded CSR lanes carry more
+        bytes than the dense operand and the O(nnz) scatter loses to
+        the dense contraction. Returns whether to densify."""
+        nnz_cls = bucketing.nnz_class(A.nnz,
+                                      _env.SPARSE_NNZ_FLOOR.get())
+        _SPARSE_SUBMITS.inc_always()
+        _SPARSE_NNZ_HIST.observe_always(float(nnz_cls))
+        densify = A.density >= _env.SPARSE_MIN_DENSITY.get()
+        if densify:
+            _SPARSE_DENSIFIED.inc_always()
+        with self._stats_lock:
+            self._counts["sparse_submits"] += 1
+            self._sparse_nnz_hist[nnz_cls] += 1
+            if densify:
+                self._counts["sparse_densified"] += 1
+        return densify
+
+    def submit_sparse(self, transform, A, dimension=None, **kw) -> Future:
+        """Sparse (CSR-packed) sketch-apply endpoint: ``A`` is a
+        :class:`~libskylark_tpu.base.sparse.SparseMatrix` or
+        scipy.sparse operand; resolves to what
+        ``transform.apply(A.todense(), dimension)`` returns, as a host
+        array — bit-equal to the densified request through the serve
+        layer (for CWT that extends to the eager dense apply at any
+        shape: the CSR lanes accumulate in the dense scatter's
+        row-major order; dense families carry the dense serve
+        endpoint's own epsilon band off pow2 stream classes — docs/
+        serving, "Sparse operands on the serve path"). Operands at or
+        above the auto-densify threshold route through the dense
+        serve path (counted as ``sparse_densified``)."""
+        A = _coerce_sparse(A)
+        if self._note_sparse_intake(A):
+            Ad = np.asarray(A.to_scipy().toarray(),
+                            dtype=np.dtype(A.device_dtype))
+            return self.submit("sketch_apply", transform=transform,
+                               A=Ad, dimension=dimension, **kw)
+        return self.submit("sparse_sketch_apply", transform=transform,
+                           A=A, dimension=dimension, **kw)
+
+    def submit_sparse_solve(self, A, B, transform, method: str = "qr",
+                            **kw) -> Future:
+        """Sparse sketched least-squares: CSR design matrix ``A``,
+        dense target block ``B``; resolves to what
+        ``solve_l2_sketched(A.todense(), B, transform)`` returns. Same
+        densify fallback rule as :meth:`submit_sparse`."""
+        A = _coerce_sparse(A)
+        if self._note_sparse_intake(A):
+            Ad = np.asarray(A.to_scipy().toarray(),
+                            dtype=np.dtype(A.device_dtype))
+            return self.submit("solve_l2_sketched", A=Ad, B=B,
+                               transform=transform, method=method,
+                               **kw)
+        return self.submit("sparse_solve_l2_sketched", A=A, B=B,
                            transform=transform, method=method, **kw)
 
     def submit_krr_predict(self, kernel, X_new, X_train, coef,
@@ -809,6 +1012,75 @@ class MicrobatchExecutor:
             meta={"padded_A": (n_pad, A.shape[1]),
                   "padded_B": (n_pad, B.shape[1]),
                   "squeeze": info["squeeze"]},
+        )
+        return statics, statics, ctx, req
+
+    @staticmethod
+    def _pack_csr(A, rows_pad: int, nnz_class: int, dtype):
+        """One request's padded (data, indices, indptr) CSR lanes:
+        data/indices zero-padded to the nnz class (value 0.0 at a
+        clamped coordinate — exact no-ops through every sparse
+        endpoint), indptr monotone-padded with the true nnz to the
+        padded row extent (so the in-executable row-id expansion stays
+        a valid binary search; docs/serving)."""
+        data, indices, indptr = A.csr_parts(dtype)
+        nnz = len(data)
+        d = np.zeros(int(nnz_class), dtype=dtype)
+        d[:nnz] = data
+        idx = np.zeros(int(nnz_class), dtype=np.int32)
+        idx[:nnz] = indices
+        ptr = np.full(int(rows_pad) + 1, nnz, dtype=np.int32)
+        ptr[: len(indptr)] = indptr
+        return d, idx, ptr
+
+    def _prep_sparse_sketch(self, transform, A, dimension=None,
+                            _derived=None):
+        statics, info = _derived or _sparse_sketch_statics(
+            transform, A, dimension, self.pad_floor)
+        A = info["A"]
+        dtype = np.dtype(info["dtype"])
+        data, idx, ptr = self._pack_csr(
+            A, info["padded"][0], info["nnz_class"], dtype)
+        ctx = {"dist": info["dist"], "family": info["family"],
+               "s_dim": transform.sketch_dim,
+               "rowwise": info["rowwise"], "padded": info["padded"],
+               "nnz_class": info["nnz_class"], "dtype": info["dtype"]}
+        req = _Request(
+            endpoint="sparse_sketch_apply",
+            arrays={"kd": self._key_data(transform),
+                    "scale": np.asarray(
+                        getattr(transform, "scale", 1.0), dtype=dtype),
+                    "data": data, "indices": idx, "indptr": ptr},
+            true_shapes={"data": (A.nnz,)},
+            meta={"padded": info["padded"],
+                  "rowwise": info["rowwise"],
+                  "s_dim": transform.sketch_dim,
+                  "shape": A.shape, "nnz": A.nnz},
+        )
+        return statics, statics, ctx, req
+
+    def _prep_sparse_solve(self, A, B, transform, method: str = "qr",
+                           _derived=None):
+        statics, info = _derived or _sparse_solve_statics(
+            transform, A, B, method, self.pad_floor)
+        A, B, n_pad = info["A"], info["B"], info["n_pad"]
+        dtype = np.dtype(info["dtype"])
+        data, idx, ptr = self._pack_csr(A, n_pad, info["nnz_class"],
+                                        dtype)
+        ctx = {"family": info["family"],
+               "s_dim": transform.sketch_dim, "method": method,
+               "padded_A": (n_pad, A.width),
+               "nnz_class": info["nnz_class"], "dtype": info["dtype"]}
+        req = _Request(
+            endpoint="sparse_solve_l2_sketched",
+            arrays={"kd": self._key_data(transform),
+                    "scale": np.asarray(
+                        getattr(transform, "scale", 1.0), dtype=dtype),
+                    "data": data, "indices": idx, "indptr": ptr,
+                    "B": B.astype(dtype, copy=False)},
+            true_shapes={"data": (A.nnz,), "B": B.shape},
+            meta={"padded_B": (n_pad, B.shape[1]),
+                  "nnz": A.nnz, "squeeze": info["squeeze"]},
         )
         return statics, statics, ctx, req
 
@@ -1170,6 +1442,11 @@ class MicrobatchExecutor:
             return tune.serve_workload(
                 "fastfood_features", ctx["family"], ctx["dtype"],
                 ctx["padded"], ctx["s_dim"], capacity)
+        if endpoint == "sparse_sketch_apply":
+            return tune.serve_workload(
+                "sparse_sketch_apply", ctx["family"], ctx["dtype"],
+                ctx["padded"], ctx["s_dim"], capacity,
+                rowwise=ctx["rowwise"], nnz=ctx["nnz_class"])
         return None
 
     def _qualify_serve_kernel(self, b: _Bucket,
@@ -1181,6 +1458,18 @@ class MicrobatchExecutor:
         ctx = b.ctx
         endpoint = b.statics[0]
         interpret = not _pallas_native()
+        if endpoint == "sparse_sketch_apply":
+            if ctx["family"] != "CWT":
+                return False, ("dense-family sparse flush has no "
+                               "kernel (in-executable densify serves)")
+            from libskylark_tpu.sketch import pallas_sparse
+
+            padded, rowwise = ctx["padded"], ctx["rowwise"]
+            n = padded[1] if rowwise else padded[0]
+            m = padded[0] if rowwise else padded[1]
+            return pallas_sparse.qualify(
+                ctx["s_dim"], n, m, ctx["nnz_class"], ctx["dtype"],
+                interpret=interpret)
         if endpoint == "fastfood_features":
             from libskylark_tpu.sketch import pallas_fastfood
 
@@ -1229,8 +1518,16 @@ class MicrobatchExecutor:
         if got is not None:
             return got
         plan = None
+        sparse_pin = (_env.SPARSE_KERNEL.get()
+                      if b.statics[0] == "sparse_sketch_apply" else None)
         if self.kernel is not None:
             choice, source = self.kernel, "arg"
+        elif sparse_pin is not None:
+            # the sparse-family pin (SKYLARK_SPARSE_KERNEL) sits
+            # between the executor argument and the general
+            # SKYLARK_SERVE_KERNEL: an operator can route just the
+            # sparse buckets without disturbing the dense ladder
+            choice, source = sparse_pin, "env"
         elif _serve_kernel_env() is not None:
             choice, source = _serve_kernel_env(), "env"
         else:
@@ -1302,6 +1599,14 @@ class MicrobatchExecutor:
 
         if self.kernel is not None or _serve_kernel_env() is not None:
             return False
+        statics = tuple(statics)
+        if (statics and statics[0] == "sparse_sketch_apply"
+                and _env.SPARSE_KERNEL.get() is not None):
+            # the sparse-family pin outranks a pack decision exactly
+            # like the general pin does: the memo is consulted before
+            # the pin in _resolve_flush_kernel, so seeding it would
+            # silently override the operator's sparse routing
+            return False
         if not sketch_params.get_use_plan_cache():
             return False
         value = None
@@ -1317,7 +1622,7 @@ class MicrobatchExecutor:
         if fp != self._kernel_memo_fp:
             self._kernel_memo.clear()
             self._kernel_memo_fp = fp
-        self._kernel_memo[(tuple(statics), int(capacity), fp)] = value
+        self._kernel_memo[(statics, int(capacity), fp)] = value
         return True
 
     def load_warmup_pack(self, pack_dir: str, *,
@@ -1424,6 +1729,71 @@ class MicrobatchExecutor:
                 batched_fastfood, name="serve.fastfood_features",
                 donate_argnums=(0, 1),
                 key_fn=serve_key)
+        if endpoint == "sparse_sketch_apply":
+            from libskylark_tpu.sketch import sparse_serve as _ssrv
+
+            s_dim, rowwise = ctx["s_dim"], ctx["rowwise"]
+            padded = ctx["padded"]
+            if ctx["family"] == "CWT":
+                def one_sp(kd, scale, data, indices, indptr):
+                    return _ssrv.cwt_sparse_serve_apply(
+                        kd, data, indices, indptr, s_dim=s_dim,
+                        rowwise=rowwise, shape=padded)
+            else:
+                dist = ctx["dist"]
+
+                def one_sp(kd, scale, data, indices, indptr):
+                    return _ssrv.dense_sparse_serve_apply(
+                        kd, scale, data, indices, indptr, dist=dist,
+                        s_dim=s_dim, rowwise=rowwise, shape=padded)
+
+            inner_sp = jax.vmap(one_sp)
+
+            def batched_sparse(kd, scale, data, indices, indptr):
+                backend, _plan, _src, _why = self._resolve_flush_kernel(
+                    b, int(data.shape[0]))
+                if backend == "pallas":
+                    from libskylark_tpu.sketch import pallas_sparse
+
+                    interpret = not _pallas_native()
+                    nnz_pad = int(data.shape[1])
+                    rows = jax.vmap(
+                        lambda p: _ssrv.csr_row_ids(p, nnz_pad))(indptr)
+                    return pallas_sparse.cwt_sparse_apply_batched(
+                        kd, data, rows, indices, s_dim=s_dim,
+                        rowwise=rowwise, shape=padded,
+                        accum="exact" if interpret else "mxu",
+                        interpret=interpret)
+                return inner_sp(kd, scale, data, indices, indptr)
+
+            return engine_compile(
+                batched_sparse, name="serve.sparse_sketch_apply",
+                donate_argnums=(0, 1, 2, 3, 4),
+                key_fn=serve_key)
+        if endpoint == "sparse_solve_l2_sketched":
+            from libskylark_tpu.sketch import sparse_serve as _ssrv
+
+            family, s_dim, method = (ctx["family"], ctx["s_dim"],
+                                     ctx["method"])
+            padded_a = ctx["padded_A"]
+
+            def one_sps(kd, scale, data, indices, indptr, B):
+                return _ssrv.sparse_solve_serve(
+                    kd, scale, data, indices, indptr, B,
+                    sketch_type=family, s_dim=s_dim, method=method,
+                    shape=padded_a)
+
+            inner_sps = jax.vmap(one_sps)
+
+            def batched_sparse_solve(kd, scale, data, indices, indptr,
+                                     B):
+                return inner_sps(kd, scale, data, indices, indptr, B)
+
+            return engine_compile(
+                batched_sparse_solve,
+                name="serve.sparse_solve_l2_sketched",
+                donate_argnums=(0, 1, 2, 3, 4, 5),
+                key_fn=lambda *a: statics)
         if endpoint == "solve_l2_sketched":
             from libskylark_tpu.algorithms.regression import (
                 sketched_solve_serve,
@@ -1524,6 +1894,39 @@ class MicrobatchExecutor:
                 cohort, padded, capacity, with_b=True,
                 padded_b=cohort[0].meta["padded_B"])
             primary = "A"
+        elif endpoint in ("sparse_sketch_apply",
+                          "sparse_solve_l2_sketched"):
+            # CSR lanes: every request in the bucket shares the nnz
+            # class (a bucket static), so the (data, indices, indptr)
+            # arrays are uniform; the nnz lane extent is the waste
+            # accounting's "padded shape"
+            nnz_pad = cohort[0].arrays["data"].shape[0]
+            padded = (nnz_pad,)
+            dtype = cohort[0].arrays["data"].dtype
+            ptr_len = cohort[0].arrays["indptr"].shape[0]
+            args = [
+                self._device_put_batch(bucketing.stack_pad(
+                    [r.arrays["kd"] for r in cohort], (2,), capacity,
+                    np.uint32)),
+                self._device_put_batch(bucketing.stack_pad(
+                    [np.asarray(r.arrays["scale"]).reshape(())
+                     for r in cohort], (), capacity, dtype)),
+                self._device_put_batch(bucketing.stack_pad(
+                    [r.arrays["data"] for r in cohort], (nnz_pad,),
+                    capacity, dtype)),
+                self._device_put_batch(bucketing.stack_pad(
+                    [r.arrays["indices"] for r in cohort], (nnz_pad,),
+                    capacity, np.int32)),
+                self._device_put_batch(bucketing.stack_pad(
+                    [r.arrays["indptr"] for r in cohort], (ptr_len,),
+                    capacity, np.int32)),
+            ]
+            if endpoint == "sparse_solve_l2_sketched":
+                args.append(self._device_put_batch(bucketing.stack_pad(
+                    [r.arrays["B"] for r in cohort],
+                    cohort[0].meta["padded_B"], capacity, dtype)))
+            args = tuple(args)
+            primary = "data"
         else:
             padded = cohort[0].meta["padded"]
             Xq = bucketing.stack_pad(
@@ -1613,6 +2016,10 @@ class MicrobatchExecutor:
                 self._kernel_sel[kernel_backend] += 1
                 if kdeclined:
                     self._kernel_dec[kdeclined] += 1
+                if endpoint == "sparse_sketch_apply":
+                    self._sparse_kernel_sel[kernel_backend] += 1
+                    _SPARSE_KERNEL_FLUSHES.inc_always(
+                        backend=kernel_backend)
             self._batch_hist[capacity] += 1
             self._cohort_hist[k] += 1
             self._pad_total += bucketing.padded_elements(padded, capacity)
@@ -1649,6 +2056,14 @@ class MicrobatchExecutor:
             p = out[lane, : r.meta["m"], :]
             return p[0] if r.meta["squeeze"] else p
         if endpoint == "solve_l2_sketched":
+            x = out[lane]
+            return x[:, 0] if r.meta["squeeze"] else x
+        if endpoint == "sparse_sketch_apply":
+            h, w = r.meta["shape"]
+            if r.meta["rowwise"]:
+                return out[lane, :h, :]
+            return out[lane, :, :w]
+        if endpoint == "sparse_solve_l2_sketched":
             x = out[lane]
             return x[:, 0] if r.meta["squeeze"] else x
         p = out[lane, : r.meta["q"], :]
@@ -1786,6 +2201,8 @@ class MicrobatchExecutor:
             pad_real, pad_total = self._pad_real, self._pad_total
             ksel = dict(sorted(self._kernel_sel.items()))
             kdec = dict(sorted(self._kernel_dec.items()))
+            sp_sel = dict(sorted(self._sparse_kernel_sel.items()))
+            sp_nnz = dict(sorted(self._sparse_nnz_hist.items()))
         with self._lock:
             queued = self._pending
         return {
@@ -1813,6 +2230,16 @@ class MicrobatchExecutor:
                                for k, v in ksel.items()},
                 "by_reason": {k: {"declined_flushes": int(v)}
                               for k, v in kdec.items()},
+            },
+            # sparse-operand intake/flush disaggregation (docs/serving,
+            # "Sparse operands on the serve path"); by_backend renders
+            # as skylark_serve_sparse_kernel_flushes{backend="..."}
+            "sparse": {
+                "submits": c.get("sparse_submits", 0),
+                "densified": c.get("sparse_densified", 0),
+                "by_backend": {k: {"kernel_flushes": int(v)}
+                               for k, v in sp_sel.items()},
+                "nnz_class_hist": sp_nnz,
             },
             "batch_capacity_hist": batch_hist,
             "cohort_size_hist": cohort_hist,
@@ -1897,6 +2324,10 @@ def serve_stats() -> dict:
     states: "collections.Counter" = collections.Counter()
     ksel: "collections.Counter" = collections.Counter()
     kdec: "collections.Counter" = collections.Counter()
+    sparse_sums: "collections.Counter" = collections.Counter(
+        {"submits": 0, "densified": 0})
+    sparse_sel: "collections.Counter" = collections.Counter()
+    sparse_nnz: "collections.Counter" = collections.Counter()
     by_replica: dict = {}
     lat_all: list = []
     waste_real = waste_total = 0
@@ -1913,6 +2344,11 @@ def serve_stats() -> dict:
             ksel[kk] += vv["flushes"]
         for kk, vv in s["kernel"]["by_reason"].items():
             kdec[kk] += vv["declined_flushes"]
+        sparse_sums["submits"] += s["sparse"]["submits"]
+        sparse_sums["densified"] += s["sparse"]["densified"]
+        for kk, vv in s["sparse"]["by_backend"].items():
+            sparse_sel[kk] += vv["kernel_flushes"]
+        sparse_nnz.update(s["sparse"]["nnz_class_hist"])
         states[s["state"]] += 1
         if s["padding_waste_ratio"] is not None:
             with ex._stats_lock:
@@ -1933,6 +2369,13 @@ def serve_stats() -> dict:
                        for k, v in sorted(ksel.items())},
         "by_reason": {k: {"declined_flushes": int(v)}
                       for k, v in sorted(kdec.items())},
+    }
+    agg["sparse"] = {
+        "submits": sparse_sums["submits"],
+        "densified": sparse_sums["densified"],
+        "by_backend": {k: {"kernel_flushes": int(v)}
+                       for k, v in sorted(sparse_sel.items())},
+        "nnz_class_hist": dict(sorted(sparse_nnz.items())),
     }
     agg["states"] = dict(sorted(states.items()))
     agg["padding_waste_ratio"] = (

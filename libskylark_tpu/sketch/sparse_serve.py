@@ -1,0 +1,156 @@
+"""Pure, vmap-batchable sparse (CSR-lane) serve endpoints.
+
+The microbatch serving layer (:mod:`libskylark_tpu.engine.serve`)
+accepts sparse operands as padded **(data, indices, indptr) CSR lanes**:
+``data``/``indices`` zero-padded to the bucket's pow2 nnz class,
+``indptr`` monotone-padded with the true nnz to the padded row extent
+(so ragged-nnz cohorts coalesce into one flush executable — docs/
+serving, "Sparse operands on the serve path"). The functions here are
+the per-lane programs those flushes vmap over; each is a pure function
+of the transform's raw key data plus the CSR lanes, with every shape
+static, mirroring ``sketch.hash.cwt_serve_apply`` / ``sketch.dense
+.serve_apply`` for dense operands.
+
+Exactness contract (the CI sparse-serve gate pins it):
+
+- **CWT** (:func:`cwt_sparse_serve_apply`): the scatter-add runs over
+  the CSR nonzeros in row-major order — exactly the order in which the
+  dense reference's ``segment_sum`` retires the same nonzero terms
+  (dense zero entries contribute exact ±0.0, which never perturbs an
+  accumulator) — so the sparse flush is **bit-equal** to
+  ``transform.apply(A.todense())`` at any shape and to the densified
+  request through the dense serve path. Padded lane entries carry
+  value 0.0 at clamped position 0: exact zeros, any capacity class.
+- **dense families** (:func:`dense_sparse_serve_apply`, JLT/CT): the
+  lanes are scattered to the padded dense class shape *inside the
+  executable* (the integer scatter reproduces ``todense()`` exactly)
+  and the request then runs the literal dense serve program
+  (``dense.serve_apply``) on it — bit-equal to the densified request
+  by construction, with the client-side densify + dense-operand
+  stacking cost (the flush hot path's host bytes) eliminated. Against
+  the *eager* ``transform.apply`` this coincides bitwise when the
+  stream extent is its own pow2 class and otherwise sits in the dense
+  serve endpoint's documented float-epsilon band (padding the
+  reduction length re-blocks an f32 dot), exactly like the dense
+  buckets themselves.
+- **sketched least-squares** (:func:`sparse_solve_serve`): the sketch
+  stage is one of the above; equal sketch bits feed the identical
+  ``solve_l2_exact``, so the solve inherits the sketch's contract.
+
+The CWT path is where sparsity pays: O(nnz) scatter work instead of the
+dense path's O(N·m) segment-sum — the committed
+``benchmarks/results_sparse_cpu.json`` A/B quantifies it. On TPU the
+scatter-free Pallas sparse kernel (:mod:`libskylark_tpu.sketch
+.pallas_sparse`) replaces this scatter per the serve ladder's
+autotuned selection.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from libskylark_tpu.base import randgen
+
+
+def csr_row_ids(indptr, nnz_pad: int) -> jnp.ndarray:
+    """Expand a (rows+1,) CSR ``indptr`` into per-nonzero row ids for
+    the leading ``nnz_pad`` lane positions (int32). Positions past the
+    true nnz (the lane padding; ``indptr`` is monotone-padded with nnz)
+    clamp to the last row — their data is 0.0, so the clamped target
+    accumulates exact zeros. Jittable: one ``searchsorted`` over the
+    static lane extent."""
+    j = jnp.arange(nnz_pad, dtype=indptr.dtype)
+    rows = jnp.searchsorted(indptr[1:], j, side="right")
+    return jnp.minimum(rows, indptr.shape[0] - 2).astype(jnp.int32)
+
+
+def cwt_sparse_serve_apply(key_data, data, indices, indptr, *,
+                           s_dim: int, rowwise: bool,
+                           shape: tuple) -> jnp.ndarray:
+    """One request's CountSketch of a CSR operand: O(nnz) scatter-add,
+    bit-equal to ``cwt_serve_apply`` on the densified operand (module
+    doc). ``shape`` is the padded (rows, cols) class shape the lanes
+    describe; the sketched extent (rows columnwise, cols rowwise) is
+    stream-exact under zero-padding, the kept extent is sliced by the
+    caller. Returns (s_dim, cols) columnwise / (rows, s_dim) rowwise.
+    """
+    import jax.random as jr
+
+    key = jr.wrap_key_data(jnp.asarray(key_data))
+    n_rows, n_cols = int(shape[0]), int(shape[1])
+    n = n_cols if rowwise else n_rows
+    h = randgen.stream_slice(
+        jax.random.fold_in(key, 0), randgen.UniformInt(0, s_dim - 1),
+        0, n, dtype=jnp.int32)
+    v = randgen.stream_slice(
+        jax.random.fold_in(key, 1), randgen.Rademacher(), 0, n,
+        dtype=data.dtype)
+    rows = csr_row_ids(indptr, data.shape[0])
+    cols = indices
+    if rowwise:
+        # out[r, h[c]] += v[c]·val — CSR row-major order IS the dense
+        # segment-sum's coordinate order per output cell
+        out = jnp.zeros((n_rows, s_dim), data.dtype)
+        return out.at[rows, h[cols]].add(v[cols] * data)
+    out = jnp.zeros((s_dim, n_cols), data.dtype)
+    return out.at[h[rows], cols].add(v[rows] * data)
+
+
+def scatter_dense(data, indices, indptr, *, shape: tuple) -> jnp.ndarray:
+    """Densify CSR lanes to the padded class shape on device — the
+    integer scatter reproduces ``SparseMatrix.todense()`` exactly
+    (canonical CSR has no duplicate coordinates, so accumulation order
+    is irrelevant; padded entries add 0.0 at a clamped coordinate)."""
+    rows = csr_row_ids(indptr, data.shape[0])
+    return jnp.zeros(tuple(int(e) for e in shape),
+                     data.dtype).at[rows, indices].add(data)
+
+
+def dense_sparse_serve_apply(key_data, scale, data, indices, indptr, *,
+                             dist, s_dim: int, rowwise: bool,
+                             shape: tuple) -> jnp.ndarray:
+    """One request's dense-family (JLT/CT) sketch of a CSR operand:
+    in-executable densify + the literal dense serve program — bit-equal
+    to the densified request (module doc)."""
+    from libskylark_tpu.sketch.dense import serve_apply
+
+    A = scatter_dense(data, indices, indptr, shape=shape)
+    return serve_apply(key_data, scale, A, dist=dist, s_dim=s_dim,
+                       rowwise=rowwise)
+
+
+def sparse_solve_serve(key_data, scale, data, indices, indptr, B, *,
+                       sketch_type: str, s_dim: int, method: str,
+                       shape: tuple) -> jnp.ndarray:
+    """Sketch-and-solve with a CSR design matrix: SA from the sparse
+    columnwise sketch above, SB from the dense serve sketch of the
+    (dense) target block, then the identical ``solve_l2_exact`` the
+    dense serve endpoint runs — so equal sketch bits mean equal
+    solutions. Zero-padded rows contribute nothing through either
+    family; the feature/target extents are exact bucket components
+    (a zero feature column would make the compressed problem
+    singular)."""
+    from libskylark_tpu.algorithms.regression import solve_l2_exact
+    from libskylark_tpu.base import errors
+    from libskylark_tpu.sketch import dense, hash as sketch_hash
+
+    if sketch_type == "CWT":
+        SA = cwt_sparse_serve_apply(key_data, data, indices, indptr,
+                                    s_dim=s_dim, rowwise=False,
+                                    shape=shape)
+        SB = sketch_hash.cwt_serve_apply(key_data, B, s_dim=s_dim,
+                                         rowwise=False)
+    elif sketch_type == "JLT":
+        SA = dense_sparse_serve_apply(
+            key_data, scale, data, indices, indptr,
+            dist=randgen.Normal(), s_dim=s_dim, rowwise=False,
+            shape=shape)
+        SB = dense.serve_apply(key_data, scale, B,
+                               dist=randgen.Normal(), s_dim=s_dim,
+                               rowwise=False)
+    else:
+        raise errors.InvalidParametersError(
+            f"sparse solve serve path supports JLT/CWT sketches, got "
+            f"{sketch_type!r}")
+    return solve_l2_exact(SA, SB, method=method)

@@ -45,6 +45,11 @@ METRICS: Dict[str, str] = {
     "resilience.faults_fired": "counter",
     "resilience.retries": "counter",
     "resilience.health_transitions": "counter",
+    # sparse serve operands (engine/serve.py, docs/serving)
+    "serve.sparse_submits": "counter",
+    "serve.sparse_densified": "counter",
+    "serve.sparse_kernel_flushes": "counter",
+    "serve.sparse_nnz_class": "histogram",
     # stateful serve sessions (sessions/registry.py)
     "sessions.opened": "counter",
     "sessions.appends": "counter",

@@ -102,15 +102,24 @@ def hash_workload(sketch_type: str, shape, dtype, s_dim: int,
 
 def serve_workload(endpoint: str, family: str, dtype, lane_shape,
                    s_dim: int, capacity: int, *, rowwise: bool = True,
+                   nnz: int = 0,
                    device_kind: Optional[str] = None) -> Workload:
     """Workload for one microbatch serve bucket (engine/serve.py flush
     builders): a batched-kernel-vs-vmapped-XLA decision per (endpoint /
     orientation, transform family, dtype, pow2 lane shape class, batch
     capacity class). ``lane_shape`` is ONE lane's padded class shape
-    ((m, n) rowwise / (n, m) columnwise for sketch_apply; (m, n_dim)
-    for fastfood_features); ``capacity`` the pow2 batch class."""
+    ((m, n) rowwise / (n, m) columnwise for sketch_apply and
+    sparse_sketch_apply; (m, n_dim) for fastfood_features);
+    ``capacity`` the pow2 batch class. Sparse buckets additionally
+    carry their pow2 ``nnz`` class — the sparse ladder's costs are
+    nnz-proportional, so two density regimes of one dense shape class
+    tune independently."""
     if endpoint == "sketch_apply":
         op = "serve_sketch_rw" if rowwise else "serve_sketch_cw"
+        m = int(lane_shape[0]) if rowwise else int(lane_shape[1])
+        n = int(lane_shape[1]) if rowwise else int(lane_shape[0])
+    elif endpoint == "sparse_sketch_apply":
+        op = "serve_sparse_rw" if rowwise else "serve_sparse_cw"
         m = int(lane_shape[0]) if rowwise else int(lane_shape[1])
         n = int(lane_shape[1]) if rowwise else int(lane_shape[0])
     elif endpoint == "fastfood_features":
@@ -122,7 +131,7 @@ def serve_workload(endpoint: str, family: str, dtype, lane_shape,
     return Workload(
         device_kind=device_kind or current_device_kind(),
         op=op, transform=str(family), dtype=str(dtype),
-        shape=(m, n, int(s_dim)), batch=int(capacity))
+        shape=(m, n, int(s_dim)), batch=int(capacity), nnz=int(nnz))
 
 
 # -- the three public verbs --

@@ -33,7 +33,7 @@ from typing import Optional, Sequence
 
 from libskylark_tpu.tune.plans import (FASTFOOD_OPS, HASH_OPS,
                                        SERVE_DENSE_FAMILIES, SERVE_OPS,
-                                       Plan, Workload,
+                                       SPARSE_SERVE_OPS, Plan, Workload,
                                        normalize_device_kind)
 
 # --------------------------------------------------------------------------
@@ -235,6 +235,34 @@ def _serve_dense_lane_cost(m: int, n: int, s: int, p: Plan,
                              compute_s)}
 
 
+def _sparse_lane_cost(m: int, n: int, s: int, nnz: int, p: Plan,
+                      rates: dict) -> dict:
+    """One sparse-CSR serve lane (m kept extent, n sketched extent, s
+    buckets, nnz the pow2 nonzero class — the quantity every term here
+    scales with, which is the whole point of the sparse path). XLA: the
+    O(nnz) ``scatter-add`` — nnz update rows retired serially by the
+    scatter unit — plus the 2·n stream generation. Pallas (sketch/
+    pallas_sparse.py): ceil(nnz/128) bucket-tiled one-hot MXU
+    contractions at HIGHEST (~6 bf16 passes of (s×128)·(128×m) each),
+    same generation bill, gather on the VPU; no pipelined variant, so
+    generation serializes against the MXU."""
+    bytes_moved = 4.0 * (3 * nnz + m * s)  # CSR lanes in, dense out
+    hbm_s = bytes_moved / rates["hbm_bytes_per_s"]
+    gen_entries = 2.0 * n                  # h + v streams (full extent)
+    gen_s = gen_entries * GEN_OPS_PER_ENTRY / rates["vpu_ops_per_s"]
+    if p.backend == "xla":
+        scatter_s = nnz / rates["scatter_rows_per_s"]
+        return {"flops": 2.0 * nnz, "bytes": bytes_moved,
+                "gen_entries": gen_entries,
+                "modeled_s": max(hbm_s, scatter_s + gen_s)}
+    tiles = max(1, -(-nnz // 128))
+    flops = 2.0 * s * 128.0 * m * tiles * MXU_PASSES["f32"]
+    mxu_s = flops / rates["mxu_flops_per_s"]
+    return {"flops": flops, "bytes": bytes_moved,
+            "gen_entries": gen_entries,
+            "modeled_s": max(hbm_s, mxu_s + gen_s)}
+
+
 def _hash_or_serve_cost(w: Workload, p: Plan, rates: dict) -> dict:
     """Cost record for the hash direct-apply sites and the serve-bucket
     sites. Serve workloads scale one lane's cost by the batch capacity
@@ -249,6 +277,8 @@ def _hash_or_serve_cost(w: Workload, p: Plan, rates: dict) -> dict:
         ff = Plan("fused" if p.backend == "pallas" else "xla_chain",
                   precision=p.precision)
         rec = _fastfood_cost(w, ff, rates)
+    elif w.op in SPARSE_SERVE_OPS:
+        rec = _sparse_lane_cost(m, n, s, max(int(w.nnz), 1), p, rates)
     elif w.op in HASH_OPS or w.transform == "CWT":
         rec = _hash_lane_cost(m, n, s, p, rates)
     elif w.transform in SERVE_DENSE_FAMILIES:

@@ -51,8 +51,17 @@ HASH_OPS = ("hash_rowwise", "hash_columnwise")
 # workload per (endpoint/orientation, transform family, dtype, pow2
 # shape class, batch capacity class). The ``batch`` field carries the
 # capacity class; backends are "pallas" (the endpoint's batched kernel
-# — hash, dense, or fused-fastfood) vs "xla" (the vmapped XLA flush).
-SERVE_OPS = ("serve_sketch_cw", "serve_sketch_rw", "serve_fastfood")
+# — hash, dense, fused-fastfood, or sparse-CSR) vs "xla" (the vmapped
+# XLA flush). The sparse ops additionally carry the pow2 **nnz class**
+# (``Workload.nnz``) — the sparse kernel's cost is a function of the
+# nonzero count, not the dense extents.
+SERVE_OPS = ("serve_sketch_cw", "serve_sketch_rw", "serve_fastfood",
+             "serve_sparse_cw", "serve_sparse_rw")
+
+# the sparse-CSR serve sites (subset of SERVE_OPS): scatter-free
+# sparse-CountSketch kernel (sketch/pallas_sparse.py) vs the XLA
+# O(nnz) scatter
+SPARSE_SERVE_OPS = ("serve_sparse_cw", "serve_sparse_rw")
 
 # dense-family serve buckets enumerate a small m-tile ladder (the
 # batched kernel's only knob); CWT/fastfood serve kernels are knobless.
@@ -112,6 +121,9 @@ class Workload:
     # including the committed benchmarks/plan_cache.json entries —
     # is unchanged.
     batch: int = 0
+    # pow2 nnz class (sparse serve workloads only; 0 = dense). Same
+    # append-only key rule as ``batch``: pre-sparse keys are unchanged.
+    nnz: int = 0
 
     def bucket(self) -> tuple[int, int, int]:
         return tuple(bucket_dim(d) for d in self.shape)
@@ -120,7 +132,11 @@ class Workload:
         b = "x".join(str(d) for d in self.bucket())
         base = "|".join((normalize_device_kind(self.device_kind),
                          self.op, self.transform, str(self.dtype), b))
-        return f"{base}|b{self.batch}" if self.batch else base
+        if self.batch:
+            base = f"{base}|b{self.batch}"
+        if self.nnz:
+            base = f"{base}|z{self.nnz}"
+        return base
 
 
 @dataclasses.dataclass(frozen=True)
@@ -220,10 +236,18 @@ def _fastfood_candidates(precisions: Sequence[str]) -> Iterator[Plan]:
 
 def _serve_candidates(w: Workload) -> Iterator[Plan]:
     """Kernel-vs-XLA candidates for one serve bucket. The dense
-    families enumerate the batched kernel's m-tile ladder; the hash and
-    fastfood serve kernels are knobless — precision stays the serve
-    layer's own policy (oracle regimes only), so a committed cache
-    entry can never opt a flush into bf16."""
+    families enumerate the batched kernel's m-tile ladder; the hash,
+    fastfood and sparse serve kernels are knobless — precision stays
+    the serve layer's own policy (oracle regimes only), so a committed
+    cache entry can never opt a flush into bf16. Sparse buckets whose
+    family is not CWT have no kernel (the dense-family sparse flush is
+    an in-executable densify + the dense program) and enumerate only
+    the XLA path."""
+    if w.op in SPARSE_SERVE_OPS:
+        if w.transform == "CWT":
+            yield Plan("pallas")
+        yield Plan("xla")
+        return
     if w.transform in SERVE_DENSE_FAMILIES:
         m, _n, _s = w.bucket()
         for mt in SERVE_DENSE_M_TILES:
