@@ -578,6 +578,153 @@ def _solver(m: int = 1024, n: int = 512, rank: int = 8) -> None:
 
 
 # ---------------------------------------------------------------------------
+# qos-level measurement: adaptive-vs-static batching A/B (docs/qos)
+# ---------------------------------------------------------------------------
+
+
+def _qos(rounds: int = 6, per_round: int = 16) -> None:
+    """Adaptive-vs-static A/B for the QoS subsystem (``python bench.py
+    --qos``; backend-agnostic — run with JAX_PLATFORMS=cpu for the
+    hardware-free record).
+
+    Workload: an interactive request *trickle* (one in flight at a
+    time — the pattern a static linger taxes hardest: every request
+    waits out the full linger alone) over a deliberately generous
+    static config (linger 20 ms), with a best_effort burst riding
+    along each round. The *static* side serves it as configured; the
+    *adaptive* side runs the controller (tight interactive SLO), which
+    walks the bucket's linger target down until the trickle stops
+    paying for batching it never gets. The record carries both sides'
+    final-round interactive p99 (client-observed), the controller's
+    adjustment counters, the zero-compile proof across both measured
+    windows, and a bit-equality check between the sides (same
+    transform, same bits regardless of scheduling policy). The CI qos
+    gate asserts adaptive p99 <= static p99 — adaptation must not
+    regress the interactive class against the static baseline."""
+    import jax
+    import numpy as np
+
+    from libskylark_tpu import Context, engine, qos
+    from libskylark_tpu import sketch as sk
+    from libskylark_tpu.qos.controller import AdaptiveController
+
+    rng = np.random.default_rng(0)
+    ctx = Context(seed=0)
+    T = sk.CWT(256, 32, ctx)
+    ops = [rng.standard_normal((256, 3 + i % 3)).astype(np.float32)
+           for i in range(per_round)]
+    be_ops = ops[: per_round // 2]
+
+    reg = qos.TenantRegistry()
+    reg.register("ui", qos.INTERACTIVE)
+    reg.register("etl", qos.BEST_EFFORT)
+
+    slo_env = "SKYLARK_QOS_SLO_INTERACTIVE_MS"
+
+    def run_mode(adaptive: bool):
+        ex = engine.MicrobatchExecutor(
+            max_batch=8, linger_us=20_000, max_queue=1024,
+            workers=2, tenants=reg)
+        ctrl = (AdaptiveController(ex, start=False)
+                if adaptive else None)
+        # capacity-ladder warmup (shared executable cache: the second
+        # mode's warmup is all hits)
+        cap = 1
+        while cap <= 8:
+            futs = [ex.submit_sketch(T, ops[i % per_round],
+                                     tenant="ui")
+                    for i in range(cap)]
+            ex.flush()
+            [f.result(timeout=120) for f in futs]
+            cap *= 2
+        st0 = engine.stats()
+        warm = (st0.misses, st0.recompiles)
+        last_round_lat: list = []
+        sample = None
+        for r in range(rounds):
+            # best_effort burst rides along (not awaited serially)
+            be = [ex.submit_sketch(T, A, tenant="etl")
+                  for A in be_ops]
+            lats = []
+            for i in range(per_round):
+                t0 = time.perf_counter()
+                out = ex.submit_sketch(
+                    T, ops[i], tenant="ui").result(timeout=120)
+                lats.append(time.perf_counter() - t0)
+                if sample is None:
+                    sample = np.asarray(out)
+            for f in be:
+                f.result(timeout=120)
+            if ctrl is not None:
+                ctrl.tick()
+            last_round_lat = lats
+        st1 = engine.stats()
+        stats = ex.stats()["qos"]
+        targets = dict(stats["targets"])
+        ctrl_stats = ctrl.stats() if ctrl is not None else None
+        ex.shutdown()
+        last_round_lat.sort()
+        p99 = last_round_lat[
+            min(int(0.99 * (len(last_round_lat) - 1) + 0.5),
+                len(last_round_lat) - 1)]
+        return {
+            "p99_interactive_last_round_s": round(p99, 6),
+            "mean_interactive_last_round_s": round(
+                float(np.mean(last_round_lat)), 6),
+            "misses_measured": st1.misses - warm[0],
+            "recompiles_measured": st1.recompiles - warm[1],
+            "targets": targets,
+            "controller": ctrl_stats,
+            "by_class": {c: {k: stats["by_class"][c][k]
+                             for k in ("admitted", "shed")}
+                         for c in qos.CLASSES},
+        }, sample
+
+    engine.reset()
+    prev_slo = os.environ.get(slo_env)
+    os.environ[slo_env] = "5.0"    # the adaptive side's target
+    try:
+        static_rec, static_sample = run_mode(adaptive=False)
+        adaptive_rec, adaptive_sample = run_mode(adaptive=True)
+    finally:
+        if prev_slo is None:
+            os.environ.pop(slo_env, None)
+        else:
+            os.environ[slo_env] = prev_slo
+
+    p99_s = static_rec["p99_interactive_last_round_s"]
+    p99_a = adaptive_rec["p99_interactive_last_round_s"]
+    rec = {
+        "bench": "QOS",
+        "backend": jax.default_backend(),
+        "rounds": rounds,
+        "per_round": per_round,
+        "static": static_rec,
+        "adaptive": adaptive_rec,
+        "p99_ratio_adaptive_vs_static": (round(p99_a / p99_s, 4)
+                                         if p99_s else None),
+        "interactive_p99_no_regression": p99_a <= p99_s * 1.1,
+        "bit_equal_across_modes": bool(
+            np.array_equal(static_sample, adaptive_sample)),
+        "zero_compiles_measured": not (
+            static_rec["misses_measured"]
+            or static_rec["recompiles_measured"]
+            or adaptive_rec["misses_measured"]
+            or adaptive_rec["recompiles_measured"]),
+        "host_cores": os.cpu_count(),
+        "telemetry": _telemetry_snapshot(),
+    }
+    print(json.dumps(rec), flush=True)
+    ok = (rec["interactive_p99_no_regression"]
+          and rec["bit_equal_across_modes"]
+          and rec["zero_compiles_measured"]
+          and (adaptive_rec["controller"] or {}).get(
+              "adjustments", 0) >= 1)
+    if not ok:
+        sys.exit(1)
+
+
+# ---------------------------------------------------------------------------
 # serve-level measurement: microbatch coalescing vs sequential dispatch
 # ---------------------------------------------------------------------------
 
@@ -2261,6 +2408,11 @@ if __name__ == "__main__":
         # microbatch serving throughput A/B (batched vs sequential
         # dispatch); backend-agnostic, in-process like --solver
         _serve()
+    elif "--qos" in sys.argv:
+        # multi-tenant QoS adaptive-vs-static batching A/B
+        # (interactive p99 + zero-compile + bit-equality proof);
+        # backend-agnostic, in-process like --serve
+        _qos()
     elif "--fleet" in sys.argv:
         # N-replica router vs single-executor A/B + one-replica drain
         # failover; backend-agnostic, in-process like --serve

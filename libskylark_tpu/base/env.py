@@ -481,6 +481,98 @@ SPARSE_KERNEL = declare(
         "sparse buckets' precedence. Anything else degrades to the "
         "general precedence chain.")
 
+# -- multi-tenant QoS (libskylark_tpu/qos, docs/qos) ------------------------
+
+#: The QoS priority classes, most- to least-protected (the authority —
+#: ``qos.tenants`` imports this so the env parser, the scheduler's
+#: shed ordering and the tenant registry can never disagree).
+QOS_CLASSES = ("interactive", "standard", "best_effort")
+
+QOS_ADAPT = declare(
+    "SKYLARK_QOS_ADAPT", default=True, parser=parse_bool_default_on,
+    kind="flag", propagate=True,
+    doc="Freeze switch for the adaptive batching controller "
+        "(``libskylark_tpu.qos.controller``): ``0`` freezes every "
+        "executor's per-bucket linger/batch targets at their static "
+        "config even when the executor was built with "
+        "``adaptive=True``. Default on (controllers run where "
+        "requested).")
+
+QOS_DEFAULT_CLASS = declare(
+    "SKYLARK_QOS_DEFAULT_CLASS", default="standard", kind="choice",
+    propagate=True,
+    parser=lambda raw: (raw.strip().lower()
+                        if raw.strip().lower() in QOS_CLASSES
+                        else "standard"),
+    doc="Priority class of requests with no ``tenant=`` (and of "
+        "tenants the registry does not know): ``interactive`` | "
+        "``standard`` | ``best_effort``. Anything else degrades to "
+        "``standard``.")
+
+QOS_SHED_INTERACTIVE = declare(
+    "SKYLARK_QOS_SHED_INTERACTIVE", default=0.5, parser=parse_float,
+    kind="float",
+    doc="DEGRADED-shed fraction of ``max_queue`` for the interactive "
+        "class: interactive intake sheds only past this exposure — "
+        "the LAST class to shed (docs/qos, \"Shed ordering\").")
+
+QOS_SHED_STANDARD = declare(
+    "SKYLARK_QOS_SHED_STANDARD", default=0.25, parser=parse_float,
+    kind="float",
+    doc="DEGRADED-shed fraction of ``max_queue`` for the standard "
+        "class (the pre-QoS ``shed_fraction`` behavior — the executor "
+        "argument scales all three class fractions together).")
+
+QOS_SHED_BEST_EFFORT = declare(
+    "SKYLARK_QOS_SHED_BEST_EFFORT", default=0.1, parser=parse_float,
+    kind="float",
+    doc="DEGRADED-shed fraction of ``max_queue`` for the best_effort "
+        "class — the FIRST class to shed. Best-effort intake "
+        "additionally sheds at half the queue bound even when "
+        "healthy, so a best-effort storm can never fill the queue "
+        "against higher classes.")
+
+QOS_RATE_DEFAULT = declare(
+    "SKYLARK_QOS_RATE_DEFAULT", default=None, parser=parse_float,
+    kind="float",
+    doc="Default per-tenant admission rate (requests/second) for "
+        "tenants registered without an explicit ``rate=``. Unset: "
+        "registered tenants are unlimited unless they pin a rate.")
+
+QOS_BURST_DEFAULT = declare(
+    "SKYLARK_QOS_BURST_DEFAULT", default=None, parser=parse_float,
+    kind="float",
+    doc="Default token-bucket burst capacity for rate-limited tenants "
+        "without an explicit ``burst=``. Unset: 2x the tenant's rate "
+        "(one second of headroom above steady state).")
+
+QOS_ADAPT_INTERVAL = declare(
+    "SKYLARK_QOS_ADAPT_INTERVAL", default=0.25, parser=parse_float,
+    kind="float",
+    doc="Seconds between adaptive-controller ticks (the cadence at "
+        "which per-bucket linger/batch targets are re-evaluated "
+        "against the class SLOs).")
+
+QOS_SLO_INTERACTIVE_MS = declare(
+    "SKYLARK_QOS_SLO_INTERACTIVE_MS", default=25.0, parser=parse_float,
+    kind="float",
+    doc="p99 request-latency SLO (milliseconds) of the interactive "
+        "class — the adaptive controller's target for buckets "
+        "carrying interactive traffic.")
+
+QOS_SLO_STANDARD_MS = declare(
+    "SKYLARK_QOS_SLO_STANDARD_MS", default=250.0, parser=parse_float,
+    kind="float",
+    doc="p99 request-latency SLO (milliseconds) of the standard "
+        "class.")
+
+QOS_SLO_BEST_EFFORT_MS = declare(
+    "SKYLARK_QOS_SLO_BEST_EFFORT_MS", default=5000.0,
+    parser=parse_float, kind="float",
+    doc="p99 request-latency SLO (milliseconds) of the best_effort "
+        "class (throughput-oriented: the controller optimizes padding "
+        "waste, not latency, while this holds).")
+
 # -- sketch kernels ---------------------------------------------------------
 
 PALLAS_MTILE = declare(

@@ -129,6 +129,24 @@ class SketchCoverageError(SkylarkError):
         self.missing = tuple(tuple(r) for r in missing)
 
 
+class TenantQuotaError(SkylarkError):
+    """A serve request exceeded its tenant's admission quota: the
+    tenant's token bucket (:mod:`libskylark_tpu.qos`) was empty when
+    the request arrived. Retryable after the bucket refills — the
+    error carries ``retry_after_s``, the deterministic time until one
+    token is available — but never queued: a rate-limited request is
+    refused at admission so it cannot occupy queue space ahead of
+    in-quota traffic (docs/qos)."""
+
+    code = 115
+
+    def __init__(self, message: str = "", *, tenant: str = "",
+                 retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.tenant = str(tenant)
+        self.retry_after_s = float(retry_after_s)
+
+
 _CODE_TABLE = {
     cls.code: cls
     for cls in [
@@ -147,6 +165,7 @@ _CODE_TABLE = {
         NotImplementedYetError,
         SessionEvictedError,
         SketchCoverageError,
+        TenantQuotaError,
     ]
 }
 

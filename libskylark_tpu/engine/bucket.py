@@ -82,6 +82,30 @@ def capacity_class(k: int, max_batch: int, multiple: int = 1) -> int:
     return max(cap, 1)
 
 
+def capacity_ladder(max_batch: int, multiple: int = 1) -> tuple:
+    """Every capacity class reachable below ``max_batch`` — the pow2
+    rungs (rounded to ``multiple``), ascending. Any bucket's *warm*
+    capacity set — the rungs it has actually flushed at, which is
+    what the adaptive batching controller
+    (:mod:`libskylark_tpu.qos.controller`) restricts its batch-target
+    moves to — is a subset of this ladder; warmup drivers and
+    capacity planning enumerate it to pre-compile the whole set."""
+    rungs = []
+    k = 1
+    while k <= int(max_batch):
+        cap = capacity_class(k, max_batch, multiple)
+        if not rungs or cap != rungs[-1]:
+            rungs.append(cap)
+        k <<= 1
+    # a non-pow2 max_batch clamps full cohorts to a rung the pow2
+    # sweep never visits (capacity_class(12, 12) = 12) — the most
+    # common capacity under load must be on the ladder
+    top = capacity_class(int(max_batch), max_batch, multiple)
+    if top != rungs[-1]:
+        rungs.append(top)
+    return tuple(rungs)
+
+
 def stack_pad(arrays: Sequence[np.ndarray], padded_shape: Sequence[int],
               capacity: int, dtype) -> np.ndarray:
     """One host-side (capacity, *padded_shape) buffer holding every

@@ -78,6 +78,30 @@ evictions resolve append futures to :class:`ServeOverloadedError` /
 :class:`~libskylark_tpu.base.errors.SessionEvictedError` instead of
 hanging.
 
+Multi-tenant QoS (:mod:`libskylark_tpu.qos`, docs/qos): every request
+carries a **priority class** (interactive / standard / best_effort)
+resolved from its ``tenant=`` argument by a
+:class:`~libskylark_tpu.qos.TenantRegistry` — with token-bucket rate
+limits refusing over-quota tenants at admission
+(:class:`~libskylark_tpu.base.errors.TenantQuotaError`). The class
+rides the bucket *key* (classes queue separately, share executables)
+and the flusher drains the per-class queues with **weighted-fair
+deficit round robin** (8:4:1); shedding — DEGRADED and queue-pressure
+— is class-ordered: best_effort before standard before interactive,
+session appends below interactive. An optional per-executor
+**adaptive batching controller** (``adaptive=True``,
+:mod:`libskylark_tpu.qos.controller`) retunes per-bucket
+linger/batch targets against the class p99 SLOs, moving batch
+targets only along already-warm capacity rungs — zero recompiles by
+construction. Heterogeneous library endpoints ride the same
+machinery: :meth:`~MicrobatchExecutor.submit_graph_ase` /
+:meth:`~MicrobatchExecutor.submit_graph_ppr` (adjacency over the
+sparse CSR lanes), :meth:`~MicrobatchExecutor.submit_condest`,
+:meth:`~MicrobatchExecutor.submit_lowrank`,
+:meth:`~MicrobatchExecutor.submit_rlsc_predict` — each a distinct
+bucket family, each bit-equal to its capacity-1 dispatch and to its
+eager twin.
+
 Resilience (r9, :mod:`libskylark_tpu.resilience`): a failed flush no
 longer fans its exception to the whole cohort — the executor retries
 **bisection-style**, splitting the cohort in half and re-executing each
@@ -102,6 +126,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import dataclasses
+import functools
 import itertools
 import threading
 import time
@@ -114,7 +139,10 @@ import numpy as np
 
 from libskylark_tpu import telemetry as _telemetry
 from libskylark_tpu.base import env as _env
+from libskylark_tpu.base import errors as _errors
 from libskylark_tpu.base import locks as _locks
+from libskylark_tpu.qos import scheduler as _qsched
+from libskylark_tpu.qos import tenants as _qtenants
 from libskylark_tpu.telemetry import metrics as _metrics
 from libskylark_tpu.engine import bucket as bucketing
 from libskylark_tpu.engine.compiled import compiled as engine_compile
@@ -126,7 +154,8 @@ from libskylark_tpu.telemetry import trace as _trace
 
 ENDPOINTS = ("sketch_apply", "fastfood_features", "solve_l2_sketched",
              "krr_predict", "sparse_sketch_apply",
-             "sparse_solve_l2_sketched")
+             "sparse_solve_l2_sketched", "graph_ase", "graph_ppr",
+             "condest", "lowrank", "rlsc_predict")
 
 # endpoints with a batched Pallas flush kernel behind the selection
 # seam (arg > env > plan cache > default); the others always flush
@@ -161,6 +190,33 @@ _SPARSE_NNZ_HIST = _metrics.histogram(
 
 _KERNEL_BACKENDS = _env.SERVE_KERNEL_BACKENDS
 
+# multi-tenant QoS instruments (docs/qos) — created HERE once (the
+# metric-names one-creation-site contract); the always-on per-class
+# accounting lives in ``stats()["qos"]`` and rides the ``qos``
+# collector registered at the bottom of this module. The controller
+# gauges (qos.linger_target / qos.batch_target) are created in
+# ``qos/controller.py``.
+_QOS_ADMITTED = _metrics.counter(
+    "qos.admitted",
+    "Requests admitted past QoS admission, by priority class and "
+    "tenant")
+_QOS_SHED = _metrics.counter(
+    "qos.shed",
+    "Requests shed by the class-ordered shed policy (DEGRADED or "
+    "queue pressure), by priority class and tenant")
+_QOS_RATE_LIMITED = _metrics.counter(
+    "qos.rate_limited",
+    "Requests refused at admission by a tenant token bucket "
+    "(TenantQuotaError), by priority class and tenant")
+_QOS_QUEUE_DEPTH = _metrics.gauge(
+    "qos.queue_depth",
+    "Queued (not yet dispatched) requests, by priority class and "
+    "replica (per-executor series — N executors must not clobber one "
+    "label key)")
+_QOS_LATENCY = _metrics.histogram(
+    "qos.request_latency",
+    "Request latency (submit to resolve, seconds), by priority class")
+
 # auto-assigned replica identity labels ("ex-0", "ex-1", ...) for
 # executors constructed without an explicit ``name`` — every executor
 # has an identity so per-replica telemetry disaggregation never falls
@@ -192,6 +248,8 @@ class _Request:
     tags: frozenset = frozenset()         # fault-injection tags (chaos)
     request_id: Optional[str] = None      # telemetry request identity
     tctx: Optional[object] = None         # telemetry SpanContext handoff
+    qos_class: str = "standard"           # resolved priority class
+    tenant: str = ""                      # resolved tenant name
 
 
 @dataclasses.dataclass
@@ -200,6 +258,10 @@ class _Bucket:
     statics: tuple          # engine key_fn extras (no object ids)
     ctx: dict               # closure objects: dist/kernel/model arrays
     reqs: list = dataclasses.field(default_factory=list)
+    qos_class: str = "standard"   # the per-class queue this bucket
+    #                               belongs to (class is part of the
+    #                               bucket KEY, never of the statics:
+    #                               classes share executables)
 
     @property
     def oldest(self) -> float:
@@ -435,6 +497,124 @@ def _sparse_solve_statics(transform, A, B, method, pad_floor):
                      "nnz_class": nnz_cls, "dtype": dtype}
 
 
+@functools.lru_cache(maxsize=1024)
+def _seed_key_data(seed: int) -> np.ndarray:
+    """Raw PRNG key data of ``jax.random.key(seed)`` as a host array —
+    the key material of the seed-addressed endpoints (graph_ase,
+    condest). Cached: the key derivation is a host-synced jax op worth
+    paying once per seed, not once per request."""
+    import jax.random as jr
+
+    return np.asarray(jr.key_data(jr.key(int(seed))), dtype=np.uint32)
+
+
+def _coerce_adjacency(A):
+    from libskylark_tpu.ml.graph import coerce_adjacency
+
+    return coerce_adjacency(A)[0]
+
+
+def _graph_ase_statics(A, k, iters, pad_floor):
+    """(statics, info) for a graph_ase request: adjacency spectral
+    embedding over the r18 sparse CSR lanes — adjacency matrices are
+    exactly the sparse regime those lanes optimize. ``k`` (embedding
+    dim) and ``iters`` (subspace iterations) are statics; the seed
+    rides as key-data operand bits so seeds share one executable."""
+    S = _coerce_adjacency(A)
+    padded = bucketing.pad_shape(S.shape, (0, 1), pad_floor)
+    nnz_cls = bucketing.nnz_class(S.nnz, _env.SPARSE_NNZ_FLOOR.get())
+    dtype = str(np.dtype(S.device_dtype))
+    k = int(k)
+    iters = max(int(iters), 1)
+    if not 0 < k <= S.height:
+        raise ValueError(f"embedding dim k={k} must be in (0, "
+                         f"{S.height}]")
+    statics = ("graph_ase", k, iters, dtype, padded, nnz_cls)
+    return statics, {"A": S, "padded": padded, "nnz_class": nnz_cls,
+                     "dtype": dtype, "k": k, "iters": iters}
+
+
+def _graph_ppr_statics(A, s, alpha, iters, pad_floor):
+    """(statics, info) for a graph_ppr request: fixed-iteration
+    personalized PageRank over the CSR adjacency. ``alpha``/``iters``
+    are statics; the personalization vector is an operand."""
+    S = _coerce_adjacency(A)
+    padded = bucketing.pad_shape(S.shape, (0, 1), pad_floor)
+    nnz_cls = bucketing.nnz_class(S.nnz, _env.SPARSE_NNZ_FLOOR.get())
+    dtype = str(np.dtype(S.device_dtype))
+    s = np.asarray(s, dtype=np.dtype(dtype))
+    if s.shape != (S.height,):
+        raise ValueError(f"personalization vector shape {s.shape} != "
+                         f"({S.height},)")
+    alpha = float(alpha)
+    if not 0.0 < alpha < 1.0:
+        raise ValueError(f"alpha must be in (0, 1), got {alpha}")
+    statics = ("graph_ppr", alpha, max(int(iters), 1), dtype, padded,
+               nnz_cls)
+    return statics, {"A": S, "s": s, "padded": padded,
+                     "nnz_class": nnz_cls, "dtype": dtype,
+                     "alpha": alpha, "iters": max(int(iters), 1)}
+
+
+def _condest_statics(A, steps, pad_floor):
+    """(statics, info) for a condest request: fixed-step Golub-Kahan
+    condition estimation (``nla.condest.condest_serve_apply``)."""
+    A = np.asarray(A)
+    if A.ndim != 2:
+        raise ValueError(f"condest expects a matrix, got {A.shape}")
+    steps = max(int(steps), 1)
+    if steps >= min(A.shape):
+        raise ValueError(
+            f"steps={steps} must be < min(shape)={min(A.shape)} "
+            "(the Krylov space is exhausted past that)")
+    padded = bucketing.pad_shape(A.shape, (0, 1), pad_floor)
+    statics = ("condest", steps, str(A.dtype), padded)
+    return statics, {"A": A, "padded": padded, "steps": steps}
+
+
+def _lowrank_statics(transform_s, transform_t, A, k, pad_floor):
+    """(statics, info) for a lowrank request: two-level-sketch
+    dominant-subspace basis (``nla.lowrank.lowrank_serve_apply``)
+    from two caller-held dense-family transforms. The row extent is
+    the paddable class dimension (rows sketch independently); the
+    feature extent is exact."""
+    fam_s, dist_s = _sketch_family(transform_s)
+    fam_t, dist_t = _sketch_family(transform_t)
+    if fam_s != fam_t or repr(dist_s) != repr(dist_t):
+        raise TypeError(
+            f"lowrank serves a matched dense transform pair, got "
+            f"{fam_s}/{fam_t}")
+    if dist_s is None:
+        raise TypeError("lowrank serves dense families (JLT/CT); CWT "
+                        "has no dense virtual panel here")
+    A = np.asarray(A)
+    if A.ndim != 2 or A.shape[1] != transform_s.input_dim \
+            or A.shape[1] != transform_t.input_dim:
+        raise ValueError(
+            f"operand {A.shape} does not match transform input dims "
+            f"{transform_s.input_dim}/{transform_t.input_dim}")
+    k = int(k)
+    if not 0 < k <= transform_s.sketch_dim:
+        raise ValueError(f"k={k} must be in (0, "
+                         f"{transform_s.sketch_dim}]")
+    m_pad = bucketing.pow2_pad(A.shape[0], pad_floor)
+    statics = ("lowrank", fam_s, repr(dist_s),
+               transform_s.sketch_dim, transform_t.sketch_dim, k,
+               A.shape[1], str(A.dtype), m_pad)
+    return statics, {"A": A, "dist": dist_s,
+                     "padded": (m_pad, A.shape[1]), "k": k}
+
+
+def _lowrank_key_data(transform, dtype):
+    """(key data, scale) operand pair of one lowrank transform —
+    shared with the eager twin (``nla.lowrank.lowrank_serve``) so
+    both sides feed the pure endpoint identical bits."""
+    kd = MicrobatchExecutor._key_data(transform)
+    scale = np.asarray(getattr(transform, "scale", 1.0),
+                       dtype=np.dtype(dtype))
+    return kd, scale
+
+
 def _solve_statics(transform, A, B, method, pad_floor):
     """(statics, info) for a solve_l2_sketched request."""
     A = np.asarray(A)
@@ -462,11 +642,15 @@ def _solve_statics(transform, A, B, method, pad_floor):
                      "family": family, "n_pad": n_pad}
 
 
-def _krr_statics(kernel, X_new, X_train, coef, pad_floor):
-    """(statics, info) for a krr_predict request. Shape-only on the
-    model operands — the router must not pay a device conversion to
-    compute an affinity key, so this reads ``np.shape`` where the
-    executor's prep later converts."""
+def _krr_statics(kernel, X_new, X_train, coef, pad_floor,
+                 endpoint: str = "krr_predict"):
+    """(statics, info) for a krr_predict request — and, with
+    ``endpoint="rlsc_predict"``, for its classification twin (same
+    bucket anatomy, distinct bucket family: the endpoints trace
+    different programs). Shape-only on the model operands — the
+    router must not pay a device conversion to compute an affinity
+    key, so this reads ``np.shape`` where the executor's prep later
+    converts."""
     X_new = np.asarray(X_new)
     squeeze_q = X_new.ndim == 1
     if squeeze_q:
@@ -480,7 +664,7 @@ def _krr_statics(kernel, X_new, X_train, coef, pad_floor):
             f"query dim {X_new.shape[1]} != train dim "
             f"{train_shape[1]}")
     q_pad = bucketing.pow2_pad(X_new.shape[0], pad_floor)
-    statics = ("krr_predict", engine_digest(kernel),
+    statics = (endpoint, engine_digest(kernel),
                train_shape, coef_shape, str(X_new.dtype), q_pad)
     return statics, {"X_new": X_new, "squeeze_q": squeeze_q,
                      "q_pad": q_pad}
@@ -510,7 +694,8 @@ def derive_request(endpoint: str, *,
     the result back to the chosen replica's ``submit`` (internal
     ``_derived=`` kwarg) so the derivation runs once per routed
     request, not once in the router and again in the executor."""
-    for transport in ("timeout", "deadline", "request_id"):
+    for transport in ("timeout", "deadline", "request_id", "tenant",
+                      "qos_class"):
         kwargs.pop(transport, None)
     if endpoint == "sketch_apply":
         kwargs.setdefault("dimension", None)
@@ -536,6 +721,24 @@ def derive_request(endpoint: str, *,
         return _sparse_solve_statics(kwargs["transform"], kwargs["A"],
                                      kwargs["B"], kwargs["method"],
                                      pad_floor)
+    if endpoint == "graph_ase":
+        return _graph_ase_statics(kwargs["A"], kwargs["k"],
+                                  kwargs.get("iters", 2), pad_floor)
+    if endpoint == "graph_ppr":
+        return _graph_ppr_statics(kwargs["A"], kwargs["s"],
+                                  kwargs.get("alpha", 0.85),
+                                  kwargs.get("iters", 16), pad_floor)
+    if endpoint == "condest":
+        return _condest_statics(kwargs["A"], kwargs.get("steps", 8),
+                                pad_floor)
+    if endpoint == "lowrank":
+        return _lowrank_statics(kwargs["transform_s"],
+                                kwargs["transform_t"], kwargs["A"],
+                                kwargs["k"], pad_floor)
+    if endpoint == "rlsc_predict":
+        return _krr_statics(kwargs["kernel"], kwargs["X_new"],
+                            kwargs["X_train"], kwargs["coef"],
+                            pad_floor, endpoint="rlsc_predict")
     raise ValueError(f"unknown serve endpoint {endpoint!r}; "
                      f"expected one of {ENDPOINTS}")
 
@@ -579,7 +782,9 @@ class MicrobatchExecutor:
                  shed_fraction: float = 0.25,
                  name: Optional[str] = None,
                  dispatch_queue=None,
-                 kernel: Optional[str] = None):
+                 kernel: Optional[str] = None,
+                 tenants=None,
+                 adaptive: bool = False):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if kernel is not None and kernel not in _KERNEL_BACKENDS:
@@ -613,6 +818,17 @@ class MicrobatchExecutor:
         self._idle_cv = threading.Condition(self._lock)   # drain quiescence
         self._buckets: "dict[tuple, _Bucket]" = {}
         self._pending = 0
+        # multi-tenant QoS (docs/qos): the tenant registry resolves
+        # tenant= submits to priority classes and charges token
+        # buckets; the deficit scheduler replaces the FIFO drain order
+        # across the per-class queues; per-bucket linger/batch targets
+        # start at the static config and move only when the adaptive
+        # controller is on
+        self._tenants = (tenants if tenants is not None
+                         else _qtenants.get_registry())
+        self._sched = _qsched.DeficitScheduler(quantum=self.max_batch)
+        self._class_pending = collections.Counter()  # under _lock
+        self._qos_targets: "dict[tuple, list]" = {}  # under _lock
         self._inflight = 0                # popped cohorts being executed
         self._stop = False
         self._draining = False
@@ -642,6 +858,14 @@ class MicrobatchExecutor:
             collections.Counter()
         self._sparse_nnz_hist: "collections.Counter" = \
             collections.Counter()
+        # QoS accounting (under _stats_lock): (kind, class, tenant)
+        # counters, per-class latency windows, per-bucket adaptive-
+        # controller observations (latency window, warm capacity set,
+        # padding-waste raw counts, classes seen)
+        self._qos_counts: "collections.Counter" = collections.Counter()
+        self._latency_by_class: dict = {
+            c: collections.deque(maxlen=4096) for c in _qtenants.CLASSES}
+        self._bucket_obs: dict = {}
         self._pad_real = 0
         self._pad_total = 0
         self._latency = collections.deque(maxlen=8192)
@@ -678,6 +902,13 @@ class MicrobatchExecutor:
             target=self._flusher_loop, name="skylark-serve-flusher",
             daemon=True)
         self._flusher.start()
+        # the adaptive batching controller (docs/qos): opt-in per
+        # executor; SKYLARK_QOS_ADAPT=0 freezes even opted-in ones
+        self._controller = None
+        if adaptive:
+            from libskylark_tpu.qos.controller import AdaptiveController
+
+            self._controller = AdaptiveController(self)
         _EXECUTORS.add(self)
 
     # ------------------------------------------------------------------
@@ -699,6 +930,38 @@ class MicrobatchExecutor:
         timeout = kwargs.pop("timeout", 30.0)
         deadline = Deadline.coerce(kwargs.pop("deadline", None))
         rid = kwargs.pop("request_id", None)
+        # QoS admission (docs/qos): resolve the tenant to its priority
+        # class and charge its token bucket. ``qos_class=`` marks a
+        # request the front door (a fleet Router, whose registry holds
+        # the token buckets) already admitted — re-charging here would
+        # double-bill every routed request.
+        tenant = kwargs.pop("tenant", None)
+        qos_class = kwargs.pop("qos_class", None)
+        if qos_class is None:
+            try:
+                tenant, qos_class = self._tenants.admit(tenant)
+            except _errors.TenantQuotaError as e:
+                _cls = self._tenants.resolve(tenant)[1]
+                with self._stats_lock:
+                    self._qos_counts[
+                        ("rate_limited", _cls, e.tenant)] += 1
+                _QOS_RATE_LIMITED.inc(
+                    **{"class": _cls, "tenant": e.tenant})
+                raise
+            # cardinality bound: unregistered tenant names account
+            # under the anonymous bucket — label sets and per-tenant
+            # stats must not grow with arbitrary caller strings. Only
+            # applied where the tenant is RESOLVED: a pre-resolved
+            # request (qos_class= from a fleet front door) carries a
+            # label its router already vetted against ITS registry —
+            # a process replica's own registry doesn't know it
+            tenant = self._tenants.accounting_name(tenant)
+        else:
+            qos_class = _qtenants.coerce_class(qos_class)
+            tenant = str(tenant) if tenant else ""
+        # chaos seam: a plan can deterministically fail admission
+        faults.check("qos.admit", tags=faults.current_tags(),
+                     detail=f"{endpoint} {tenant or '-'} {qos_class}")
         # internal fast path: the fleet router already derived the
         # bucket statics to pick this replica — reuse them instead of
         # re-deriving (the derivation is the submit hot path's single
@@ -729,11 +992,28 @@ class MicrobatchExecutor:
             elif endpoint == "sparse_solve_l2_sketched":
                 key, statics, ctx, req = self._prep_sparse_solve(
                     _derived=derived, **kwargs)
+            elif endpoint == "graph_ase":
+                key, statics, ctx, req = self._prep_graph_ase(
+                    _derived=derived, **kwargs)
+            elif endpoint == "graph_ppr":
+                key, statics, ctx, req = self._prep_graph_ppr(
+                    _derived=derived, **kwargs)
+            elif endpoint == "condest":
+                key, statics, ctx, req = self._prep_condest(
+                    _derived=derived, **kwargs)
+            elif endpoint == "lowrank":
+                key, statics, ctx, req = self._prep_lowrank(
+                    _derived=derived, **kwargs)
+            elif endpoint == "rlsc_predict":
+                key, statics, ctx, req = self._prep_rlsc(
+                    _derived=derived, **kwargs)
             else:
                 raise ValueError(f"unknown serve endpoint {endpoint!r}; "
                                  f"expected one of {ENDPOINTS}")
             req.deadline = deadline
             req.request_id = rid
+            req.qos_class = qos_class
+            req.tenant = tenant or ""
             if sp is not None:
                 req.tctx = sp.context()
             # capture the submitting thread's fault tags so chaos plans
@@ -822,6 +1102,57 @@ class MicrobatchExecutor:
                            **kw) -> Future:
         return self.submit("krr_predict", kernel=kernel, X_new=X_new,
                            X_train=X_train, coef=coef, **kw)
+
+    # -- heterogeneous library endpoints (docs/qos) --------------------
+
+    def submit_graph_ase(self, A, k: int, *, seed: int = 0,
+                         iters: int = 2, **kw) -> Future:
+        """Adjacency spectral embedding endpoint: ``A`` is a
+        :class:`~libskylark_tpu.ml.graph.Graph`, SparseMatrix, scipy
+        sparse, or dense square adjacency (packed as r18 CSR lanes);
+        resolves to the (n, k) embedding host array — bit-equal to
+        :func:`~libskylark_tpu.ml.graph.graph_ase_serve` with the
+        same seed."""
+        return self.submit("graph_ase", A=A, k=k, seed=seed,
+                           iters=iters, **kw)
+
+    def submit_graph_ppr(self, A, s, *, alpha: float = 0.85,
+                         iters: int = 16, **kw) -> Future:
+        """Personalized-PageRank endpoint: ``s`` is the (n,)
+        personalization vector in adjacency row order; resolves to
+        the (n,) diffusion vector — bit-equal to
+        :func:`~libskylark_tpu.ml.graph.graph_ppr_serve`."""
+        return self.submit("graph_ppr", A=A, s=s, alpha=alpha,
+                           iters=iters, **kw)
+
+    def submit_condest(self, A, *, steps: int = 8, seed: int = 0,
+                       **kw) -> Future:
+        """Condition-estimation endpoint: fixed-step Golub-Kahan;
+        resolves to the ``(cond, sigma_max, sigma_min)`` host (3,)
+        array — bit-equal to
+        :func:`~libskylark_tpu.nla.condest.condest_serve`."""
+        return self.submit("condest", A=A, steps=steps, seed=seed,
+                           **kw)
+
+    def submit_lowrank(self, transform_s, transform_t, A, k: int,
+                       **kw) -> Future:
+        """Dominant-subspace endpoint: two-level sketch basis from a
+        matched dense transform pair; resolves to the (n, k) basis —
+        bit-equal to
+        :func:`~libskylark_tpu.nla.lowrank.lowrank_serve` at pow2
+        row classes."""
+        return self.submit("lowrank", transform_s=transform_s,
+                           transform_t=transform_t, A=A, k=k, **kw)
+
+    def submit_rlsc_predict(self, kernel, X_new, X_train, coef,
+                            coding=None, **kw) -> Future:
+        """RLSC classification endpoint: argmax over the one-vs-all
+        KRR scores; resolves to int32 class indices (decoded to
+        labels when ``coding`` is given) — bit-equal to
+        :func:`~libskylark_tpu.ml.rlsc.rlsc_predict`."""
+        return self.submit("rlsc_predict", kernel=kernel, X_new=X_new,
+                           X_train=X_train, coef=coef, coding=coding,
+                           **kw)
 
     # ------------------------------------------------------------------
     # stateful sketch sessions (docs/sessions)
@@ -1120,6 +1451,111 @@ class MicrobatchExecutor:
         )
         return key, statics, ctx, req
 
+    def _prep_graph_ase(self, A, k, seed=0, iters=2, _derived=None):
+        statics, info = _derived or _graph_ase_statics(
+            A, k, iters, self.pad_floor)
+        S = info["A"]
+        dtype = np.dtype(info["dtype"])
+        data, idx, ptr = self._pack_csr(
+            S, info["padded"][0], info["nnz_class"], dtype)
+        ctx = {"k": info["k"], "iters": info["iters"],
+               "padded": info["padded"],
+               "nnz_class": info["nnz_class"], "dtype": info["dtype"]}
+        req = _Request(
+            endpoint="graph_ase",
+            arrays={"kd": _seed_key_data(int(seed)),
+                    "data": data, "indices": idx, "indptr": ptr},
+            true_shapes={"data": (S.nnz,)},
+            meta={"n": S.height, "k": info["k"]},
+        )
+        return statics, statics, ctx, req
+
+    def _prep_graph_ppr(self, A, s, alpha=0.85, iters=16,
+                        _derived=None):
+        statics, info = _derived or _graph_ppr_statics(
+            A, s, alpha, iters, self.pad_floor)
+        S, s = info["A"], info["s"]
+        dtype = np.dtype(info["dtype"])
+        data, idx, ptr = self._pack_csr(
+            S, info["padded"][0], info["nnz_class"], dtype)
+        ctx = {"alpha": info["alpha"], "iters": info["iters"],
+               "padded": info["padded"],
+               "nnz_class": info["nnz_class"], "dtype": info["dtype"]}
+        req = _Request(
+            endpoint="graph_ppr",
+            arrays={"data": data, "indices": idx, "indptr": ptr,
+                    "s": s},
+            true_shapes={"data": (S.nnz,)},
+            meta={"n": S.height},
+        )
+        return statics, statics, ctx, req
+
+    def _prep_condest(self, A, steps=8, seed=0, _derived=None):
+        statics, info = _derived or _condest_statics(
+            A, steps, self.pad_floor)
+        A = info["A"]
+        ctx = {"steps": info["steps"], "padded": info["padded"],
+               "dtype": str(A.dtype)}
+        req = _Request(
+            endpoint="condest",
+            arrays={"kd": _seed_key_data(int(seed)), "A": A},
+            true_shapes={"A": A.shape},
+            meta={"padded": info["padded"]},
+        )
+        return statics, statics, ctx, req
+
+    def _prep_lowrank(self, transform_s, transform_t, A, k,
+                      _derived=None):
+        statics, info = _derived or _lowrank_statics(
+            transform_s, transform_t, A, k, self.pad_floor)
+        A = info["A"]
+        kd_s, sc_s = _lowrank_key_data(transform_s, A.dtype)
+        kd_t, sc_t = _lowrank_key_data(transform_t, A.dtype)
+        ctx = {"dist": info["dist"], "k": info["k"],
+               "s_dim": transform_s.sketch_dim,
+               "t_dim": transform_t.sketch_dim,
+               "padded": info["padded"]}
+        req = _Request(
+            endpoint="lowrank",
+            arrays={"kd_s": kd_s, "scale_s": sc_s,
+                    "kd_t": kd_t, "scale_t": sc_t, "A": A},
+            true_shapes={"A": A.shape},
+            meta={"padded": info["padded"], "m": A.shape[0],
+                  "k": info["k"]},
+        )
+        return statics, statics, ctx, req
+
+    def _prep_rlsc(self, kernel, X_new, X_train, coef, coding=None,
+                   _derived=None):
+        import jax.numpy as jnp
+
+        statics, info = _derived or _krr_statics(
+            kernel, X_new, X_train, coef, self.pad_floor,
+            endpoint="rlsc_predict")
+        X_new, squeeze_q, q_pad = (info["X_new"], info["squeeze_q"],
+                                   info["q_pad"])
+        # same model-identity rule as krr_predict: ids of the CALLER's
+        # objects separate buckets, converted arrays live in the ctx
+        model_ids = (id(X_train), id(coef))
+        model_refs = (X_train, coef)
+        X_train = jnp.asarray(X_train)
+        coef = jnp.asarray(coef)
+        if coef.ndim == 1:
+            coef = coef[:, None]
+        key = statics + model_ids
+        ctx = {"kernel": kernel, "X_train": X_train, "coef": coef,
+               "model_refs": model_refs}
+        req = _Request(
+            endpoint="rlsc_predict",
+            arrays={"Xq": X_new},
+            true_shapes={"Xq": X_new.shape},
+            meta={"padded": (q_pad, X_new.shape[1]),
+                  "q": X_new.shape[0], "squeeze_q": squeeze_q,
+                  "coding": (list(coding)
+                             if coding is not None else None)},
+        )
+        return key, statics, ctx, req
+
     # ------------------------------------------------------------------
     # queueing + flushing
     # ------------------------------------------------------------------
@@ -1137,25 +1573,67 @@ class MicrobatchExecutor:
         if self._stop:
             raise RuntimeError("MicrobatchExecutor is shut down")
 
+    def _class_shed_bound(self, cls: str) -> int:
+        """DEGRADED shed bound (queued + in-flight requests) of one
+        priority class: ``max_queue x the class's shed fraction``,
+        scaled by the executor's ``shed_fraction`` argument relative
+        to the standard class's *declared default* (0.25) — so the
+        pre-QoS ctor knob still moves all three bounds together while
+        each ``SKYLARK_QOS_SHED_*`` env knob moves exactly its own
+        class (scaling by the LIVE standard value would make the
+        standard knob a no-op and inversely rescale the others)."""
+        scale = self.shed_fraction / float(
+            _env.QOS_SHED_STANDARD.default)
+        return max(1, int(self.max_queue
+                          * _qtenants.shed_fraction(cls) * scale))
+
+    def _note_shed(self, req: _Request) -> None:
+        with self._stats_lock:
+            self._counts["shed"] += 1
+            self._qos_counts[("shed", req.qos_class, req.tenant)] += 1
+        _QOS_SHED.inc(**{"class": req.qos_class,
+                         "tenant": req.tenant})
+
     def _enqueue(self, key, statics, ctx, req, timeout) -> None:
         deadline = time.monotonic() + (timeout if timeout else 0)
         degraded = self._is_degraded()
-        shed_bound = max(1, int(self.max_queue * self.shed_fraction))
+        cls = req.qos_class
+        # the per-class queue is the bucket itself: class rides the
+        # bucket KEY (same statics = same executable, the class only
+        # separates queues so the deficit scheduler can order them)
+        key = tuple(key) + (cls,)
+        shed_bound = self._class_shed_bound(cls)
+        pressure = _qtenants.PRESSURE_FRACTIONS.get(cls, 1.0)
         with self._lock:
             self._refuse_if_unavailable_locked()
             exposure = self._pending + self._inflight
             if degraded and exposure >= shed_bound:
-                # DEGRADED load shed: reject immediately at the reduced
-                # bound instead of letting callers linger behind a
-                # failing flush path. The bound counts queued AND
-                # in-flight requests — the full-cohort fast path moves
-                # work straight to the workers, so a queued-only count
-                # would let a max_batch-sized burst bypass the shed
-                with self._stats_lock:
-                    self._counts["shed"] += 1
+                # DEGRADED load shed, class-ordered (docs/qos): reject
+                # immediately at the class's reduced bound instead of
+                # letting callers linger behind a failing flush path —
+                # best_effort's bound is the smallest, so it sheds
+                # FIRST; interactive's is the largest, so it sheds
+                # LAST. The bound counts queued AND in-flight requests
+                # — the full-cohort fast path moves work straight to
+                # the workers, so a queued-only count would let a
+                # max_batch-sized burst bypass the shed
+                self._note_shed(req)
                 raise ServeOverloadedError(
                     f"load shed: executor DEGRADED and exposure at "
-                    f"{exposure} >= shed bound {shed_bound}")
+                    f"{exposure} >= {cls} shed bound {shed_bound}")
+            if pressure < 1.0 and exposure >= max(
+                    1, int(self.max_queue * pressure)):
+                # queue-pressure shed: a best_effort storm stops
+                # admitting at its fractional bound even on a HEALTHY
+                # executor, so it can never fill the queue against
+                # standard/interactive traffic (the global-shed
+                # unfairness fix — the regression test pins that one
+                # best_effort storm never sheds a concurrent
+                # interactive request)
+                self._note_shed(req)
+                raise ServeOverloadedError(
+                    f"load shed: {cls} exposure at {exposure} >= "
+                    f"pressure bound {int(self.max_queue * pressure)}")
             while self._pending >= self.max_queue:
                 wait = deadline - time.monotonic() if timeout else None
                 if timeout and wait <= 0:
@@ -1179,13 +1657,19 @@ class MicrobatchExecutor:
             b = self._buckets.get(key)
             if b is None:
                 b = self._buckets[key] = _Bucket(key=key, statics=statics,
-                                                ctx=ctx)
+                                                ctx=ctx, qos_class=cls)
             b.reqs.append(req)
             self._pending += 1
+            self._class_pending[cls] += 1
+            _QOS_QUEUE_DEPTH.set(float(self._class_pending[cls]),
+                                 **{"class": cls,
+                                    "replica": self.name})
             with self._stats_lock:
                 self._counts["submitted"] += 1
                 self._counts["queued_peak"] = max(
                     self._counts["queued_peak"], self._pending)
+                self._qos_counts[("admitted", cls, req.tenant)] += 1
+            _QOS_ADMITTED.inc(**{"class": cls, "tenant": req.tenant})
             # full-cohort fast path: hand the cohort straight to the
             # worker queue instead of waking the flusher thread to
             # rediscover it — one less wakeup/context switch on the
@@ -1198,22 +1682,76 @@ class MicrobatchExecutor:
             # in the cohort behind workers that already exited —
             # under the lock, FIFO orders the work ahead of the
             # sentinels. The queue is unbounded, so put cannot block.
+            # ... and it is QoS-gated: a full best_effort cohort must
+            # not jump the workers ahead of queued interactive work —
+            # the fast path only fires when no strictly-higher class
+            # has pending requests (then the scheduler's order is
+            # trivially respected); otherwise the flusher's deficit
+            # round-robin decides
+            ci = _qtenants.CLASSES.index(cls)
+            higher_pending = any(
+                self._class_pending.get(c, 0) > 0
+                for c in _qtenants.CLASSES[:ci])
             work = (self._pop_cohort_locked(key)
-                    if len(b.reqs) >= self.max_batch else None)
+                    if (len(b.reqs) >= self._bucket_cap_locked(statics)
+                        and not higher_pending)
+                    else None)
             if work is None:
                 self._work_cv.notify_all()
             else:
+                self._sched.charge(cls, len(work[1]))
                 self._workq.put((self, work))
+
+    def _bucket_targets_locked(self, statics: tuple) -> tuple:
+        """(linger seconds, cohort cap) of one bucket — the static
+        config unless the adaptive controller retuned it (caller
+        holds ``_lock``)."""
+        t = self._qos_targets.get(statics)
+        if t is None:
+            return self.linger, self.max_batch
+        return float(t[0]), int(t[1])
+
+    def _bucket_cap_locked(self, statics: tuple) -> int:
+        t = self._qos_targets.get(statics)
+        return self.max_batch if t is None else int(t[1])
+
+    def bucket_targets(self, statics) -> tuple:
+        """Public (linger_s, batch_cap) view of one bucket's live
+        targets (the adaptive controller's read side)."""
+        with self._lock:
+            return self._bucket_targets_locked(tuple(statics))
+
+    def set_bucket_targets(self, statics, *, linger_s=None,
+                           batch_cap=None) -> None:
+        """Retune one bucket (the adaptive controller's write side).
+        ``batch_cap`` clamps to [1, max_batch] — the compiled
+        capacity ladder's roof — and the flusher re-evaluates
+        immediately (a shortened linger must fire now, not at the old
+        expiry)."""
+        statics = tuple(statics)
+        with self._lock:
+            cur = list(self._bucket_targets_locked(statics))
+            if linger_s is not None:
+                cur[0] = max(float(linger_s), 0.0)
+            if batch_cap is not None:
+                cur[1] = max(1, min(int(batch_cap), self.max_batch))
+            self._qos_targets[statics] = cur
+            self._work_cv.notify_all()
 
     def _pop_cohort_locked(self, key) -> Optional[tuple]:
         b = self._buckets.get(key)
         if b is None or not b.reqs:
             return None
-        cohort = b.reqs[: self.max_batch]
-        b.reqs = b.reqs[self.max_batch:]
+        cap = self._bucket_cap_locked(b.statics)
+        cohort = b.reqs[:cap]
+        b.reqs = b.reqs[cap:]
         if not b.reqs:
             del self._buckets[key]
         self._pending -= len(cohort)
+        self._class_pending[b.qos_class] -= len(cohort)
+        _QOS_QUEUE_DEPTH.set(
+            float(max(self._class_pending[b.qos_class], 0)),
+            **{"class": b.qos_class, "replica": self.name})
         self._inflight += 1
         self._space_cv.notify_all()
         return (b, cohort)
@@ -1224,6 +1762,14 @@ class MicrobatchExecutor:
             self._idle_cv.notify_all()
 
     def _flusher_loop(self) -> None:
+        """Linger expiry + weighted-fair dispatch (docs/qos): ready
+        cohorts (full, lingered out, or flushed by drain/stop) are
+        grouped by priority class and the deficit scheduler picks
+        which class dispatches next — the replacement for the pre-QoS
+        dict-order drain. Within a class, the oldest bucket goes
+        first (FIFO per class). Linger and cohort caps are
+        per-bucket: the adaptive controller's targets, falling back
+        to the static config."""
         while True:
             work = None
             with self._lock:
@@ -1231,15 +1777,35 @@ class MicrobatchExecutor:
                     break
                 now = time.monotonic()
                 wait = None
+                ready: dict = {}          # class -> oldest ready key
                 for key in list(self._buckets):
                     b = self._buckets[key]
-                    full = len(b.reqs) >= self.max_batch
-                    expired = now - b.oldest >= self.linger
+                    linger, cap = self._bucket_targets_locked(b.statics)
+                    full = len(b.reqs) >= cap
+                    expired = now - b.oldest >= linger
                     if full or expired or self._stop or self._draining:
-                        work = self._pop_cohort_locked(key)
-                        break
-                    w = b.oldest + self.linger - now
-                    wait = w if wait is None else min(wait, w)
+                        prev = ready.get(b.qos_class)
+                        if (prev is None or b.oldest
+                                < self._buckets[prev].oldest):
+                            ready[b.qos_class] = key
+                    else:
+                        w = b.oldest + linger - now
+                        wait = w if wait is None else min(wait, w)
+                if ready:
+                    backlog = {
+                        c: self._class_pending.get(c, 0)
+                        for c in ready}
+
+                    def cost(c):
+                        b0 = self._buckets[ready[c]]
+                        return min(len(b0.reqs),
+                                   self._bucket_cap_locked(b0.statics))
+
+                    cls = self._sched.next_class(backlog, cost)
+                    if cls is not None:
+                        work = self._pop_cohort_locked(ready[cls])
+                        if work is not None:
+                            self._sched.charge(cls, len(work[1]))
                 if work is None:
                     if self._stop:
                         continue
@@ -1816,6 +2382,122 @@ class MicrobatchExecutor:
                 batched_solve, name="serve.solve_l2_sketched",
                 donate_argnums=(0, 1, 2, 3),
                 key_fn=lambda *a: statics)
+        if endpoint == "graph_ase":
+            from libskylark_tpu.ml.graph import ase_serve_apply
+
+            k_dim, g_iters = ctx["k"], ctx["iters"]
+            g_padded = ctx["padded"]
+
+            def one_ga(kd, data, indices, indptr):
+                return ase_serve_apply(kd, data, indices, indptr,
+                                       k=k_dim, iters=g_iters,
+                                       shape=g_padded)
+
+            inner_ga = jax.vmap(one_ga)
+
+            # capacity-1 flushes run the PLAIN single-lane program
+            # (shape is static at trace time): the vmapped batch-1
+            # lowering of a deep linalg chain can differ from the
+            # unbatched program by an f32 ulp, and the capacity-1
+            # dispatch is the bit-equality reference the other
+            # capacities (whose lanes XLA lowers like the plain
+            # program) are pinned against
+            def batched_graph_ase(kd, data, indices, indptr):
+                if kd.shape[0] == 1:
+                    return one_ga(kd[0], data[0], indices[0],
+                                  indptr[0])[None]
+                return inner_ga(kd, data, indices, indptr)
+
+            return engine_compile(
+                batched_graph_ase, name="serve.graph_ase",
+                donate_argnums=(0, 1, 2, 3),
+                key_fn=lambda *a: statics)
+        if endpoint == "graph_ppr":
+            from libskylark_tpu.ml.graph import ppr_serve_apply
+
+            p_alpha, p_iters = ctx["alpha"], ctx["iters"]
+            p_padded = ctx["padded"]
+
+            def one_pp(data, indices, indptr, s):
+                return ppr_serve_apply(data, indices, indptr, s,
+                                       alpha=p_alpha, iters=p_iters,
+                                       shape=p_padded)
+
+            inner_pp = jax.vmap(one_pp)
+
+            def batched_graph_ppr(data, indices, indptr, s):
+                if data.shape[0] == 1:   # see batched_graph_ase
+                    return one_pp(data[0], indices[0], indptr[0],
+                                  s[0])[None]
+                return inner_pp(data, indices, indptr, s)
+
+            return engine_compile(
+                batched_graph_ppr, name="serve.graph_ppr",
+                donate_argnums=(0, 1, 2, 3),
+                key_fn=lambda *a: statics)
+        if endpoint == "condest":
+            from libskylark_tpu.nla.condest import condest_serve_apply
+
+            c_steps = ctx["steps"]
+
+            def one_ce(kd, A):
+                return condest_serve_apply(kd, A, steps=c_steps)
+
+            # statically unrolled lanes, NOT vmap: the deep Golub-
+            # Kahan recurrence (dot-reorthogonalization chain) is not
+            # lane-bitwise under XLA's batched lowering, and the
+            # capacity-1 bit-equality contract outranks trace size
+            # for this tiny program (k+1 short vectors per lane)
+            def batched_condest(kd, A):
+                return jax.numpy.stack(
+                    [one_ce(kd[i], A[i]) for i in range(A.shape[0])])
+
+            return engine_compile(
+                batched_condest, name="serve.condest",
+                donate_argnums=(0, 1),
+                key_fn=lambda *a: statics)
+        if endpoint == "lowrank":
+            from libskylark_tpu.nla.lowrank import lowrank_serve_apply
+
+            lr_dist, lr_k = ctx["dist"], ctx["k"]
+            lr_s, lr_t = ctx["s_dim"], ctx["t_dim"]
+
+            def one_lr(kd_s, sc_s, kd_t, sc_t, A):
+                return lowrank_serve_apply(kd_s, sc_s, kd_t, sc_t, A,
+                                           dist=lr_dist, s=lr_s,
+                                           t=lr_t, k=lr_k)
+
+            inner_lr = jax.vmap(one_lr)
+
+            def batched_lowrank(kd_s, sc_s, kd_t, sc_t, A):
+                if A.shape[0] == 1:      # see batched_graph_ase
+                    return one_lr(kd_s[0], sc_s[0], kd_t[0],
+                                  sc_t[0], A[0])[None]
+                return inner_lr(kd_s, sc_s, kd_t, sc_t, A)
+
+            return engine_compile(
+                batched_lowrank, name="serve.lowrank",
+                donate_argnums=(0, 1, 2, 3, 4),
+                key_fn=lambda *a: statics)
+        if endpoint == "rlsc_predict":
+            # classification twin of krr_predict: model operands
+            # broadcast, never donated
+            from libskylark_tpu.ml.rlsc import rlsc_predict_kernel
+
+            r_kernel = ctx["kernel"]
+
+            def one_rl(Xq, X_train, coef):
+                return rlsc_predict_kernel(r_kernel, Xq, X_train, coef)
+
+            inner_rl = jax.vmap(one_rl, in_axes=(0, None, None))
+
+            def batched_rlsc(Xq, X_train, coef):
+                return inner_rl(Xq, X_train, coef)
+
+            return engine_compile(
+                batched_rlsc, name="serve.rlsc_predict",
+                donate_argnums=(0,),
+                key_fn=lambda *a: statics)
         # krr_predict: model operands broadcast, never donated (they
         # are bucket-lived and re-read by every flush)
         from libskylark_tpu.ml.krr import krr_predict_kernel
@@ -1927,6 +2609,59 @@ class MicrobatchExecutor:
                     cohort[0].meta["padded_B"], capacity, dtype)))
             args = tuple(args)
             primary = "data"
+        elif endpoint in ("graph_ase", "graph_ppr"):
+            # CSR adjacency lanes (the r18 packing): uniform within
+            # the bucket (nnz class is a static); graph_ase leads
+            # with the key lanes, graph_ppr trails with the
+            # personalization vectors
+            nnz_pad = cohort[0].arrays["data"].shape[0]
+            padded = (nnz_pad,)
+            dtype = cohort[0].arrays["data"].dtype
+            ptr_len = cohort[0].arrays["indptr"].shape[0]
+            args = []
+            if endpoint == "graph_ase":
+                args.append(self._device_put_batch(bucketing.stack_pad(
+                    [r.arrays["kd"] for r in cohort], (2,), capacity,
+                    np.uint32)))
+            args += [
+                self._device_put_batch(bucketing.stack_pad(
+                    [r.arrays["data"] for r in cohort], (nnz_pad,),
+                    capacity, dtype)),
+                self._device_put_batch(bucketing.stack_pad(
+                    [r.arrays["indices"] for r in cohort], (nnz_pad,),
+                    capacity, np.int32)),
+                self._device_put_batch(bucketing.stack_pad(
+                    [r.arrays["indptr"] for r in cohort], (ptr_len,),
+                    capacity, np.int32)),
+            ]
+            if endpoint == "graph_ppr":
+                args.append(self._device_put_batch(bucketing.stack_pad(
+                    [r.arrays["s"] for r in cohort],
+                    (b.ctx["padded"][0],), capacity, dtype)))
+            args = tuple(args)
+            primary = "data"
+        elif endpoint == "condest":
+            padded = cohort[0].meta["padded"]
+            dtype = cohort[0].arrays["A"].dtype
+            kd = bucketing.stack_pad([r.arrays["kd"] for r in cohort],
+                                     (2,), capacity, np.uint32)
+            Astk = bucketing.stack_pad([r.arrays["A"] for r in cohort],
+                                       padded, capacity, dtype)
+            args = (self._device_put_batch(kd),
+                    self._device_put_batch(Astk))
+            primary = "A"
+        elif endpoint == "lowrank":
+            padded = cohort[0].meta["padded"]
+            dtype = cohort[0].arrays["A"].dtype
+            args = tuple(
+                self._device_put_batch(bucketing.stack_pad(
+                    [r.arrays[nm] for r in cohort], shp, capacity, dt))
+                for nm, shp, dt in (("kd_s", (2,), np.uint32),
+                                    ("scale_s", (), dtype),
+                                    ("kd_t", (2,), np.uint32),
+                                    ("scale_t", (), dtype),
+                                    ("A", padded, dtype)))
+            primary = "A"
         else:
             padded = cohort[0].meta["padded"]
             Xq = bucketing.stack_pad(
@@ -2022,11 +2757,35 @@ class MicrobatchExecutor:
                         backend=kernel_backend)
             self._batch_hist[capacity] += 1
             self._cohort_hist[k] += 1
-            self._pad_total += bucketing.padded_elements(padded, capacity)
-            self._pad_real += bucketing.real_elements(
+            pad_total = bucketing.padded_elements(padded, capacity)
+            pad_real = bucketing.real_elements(
                 [r.true_shapes[primary] for r in cohort])
+            self._pad_total += pad_total
+            self._pad_real += pad_real
+            # per-bucket adaptive-controller observations (docs/qos):
+            # the latency window, the warm capacity set (the rungs the
+            # controller may move the batch target along — already
+            # compiled, so moving there can never compile), padding
+            # waste and the classes whose traffic this bucket carried
+            obs = self._bucket_obs.get(b.statics)
+            if obs is None:
+                obs = self._bucket_obs[b.statics] = {
+                    "lat": collections.deque(maxlen=512),
+                    "caps": set(), "classes": set(),
+                    "pad_real": 0, "pad_total": 0, "n": 0}
+            obs["caps"].add(int(capacity))
+            obs["classes"].add(b.qos_class)
+            obs["pad_total"] += pad_total
+            obs["pad_real"] += pad_real
+            obs["n"] += k
             for r in cohort:
-                self._latency.append(now - r.t_submit)
+                lat = now - r.t_submit
+                self._latency.append(lat)
+                self._latency_by_class[r.qos_class].append(lat)
+                obs["lat"].append(lat)
+        for r in cohort:
+            _QOS_LATENCY.observe(now - r.t_submit,
+                                 **{"class": r.qos_class})
 
     def _stack_common(self, cohort, padded, capacity, *, with_b,
                       padded_b=None) -> tuple:
@@ -2066,6 +2825,20 @@ class MicrobatchExecutor:
         if endpoint == "sparse_solve_l2_sketched":
             x = out[lane]
             return x[:, 0] if r.meta["squeeze"] else x
+        if endpoint == "graph_ase":
+            return out[lane, : r.meta["n"], :]
+        if endpoint == "graph_ppr":
+            return out[lane, : r.meta["n"]]
+        if endpoint == "condest":
+            return out[lane]
+        if endpoint == "lowrank":
+            return out[lane, : r.meta["m"], :]
+        if endpoint == "rlsc_predict":
+            p = out[lane, : r.meta["q"]]
+            coding = r.meta.get("coding")
+            if coding is not None:
+                p = np.asarray([coding[int(i)] for i in p])
+            return p[0] if r.meta["squeeze_q"] else p
         p = out[lane, : r.meta["q"], :]
         if r.meta["squeeze_t"]:
             p = p[:, 0]
@@ -2115,6 +2888,96 @@ class MicrobatchExecutor:
         with self._stats_lock:
             lat = sorted(self._latency)
         return _percentile(lat, q)
+
+    def qos_bucket_obs(self) -> dict:
+        """Per-bucket adaptive-controller observations: ``statics ->
+        {p99, padding_waste, caps, classes, n}`` (docs/qos). The
+        controller's read side — cheap (one stats-lock snapshot), no
+        contention with the flush path beyond that lock."""
+        with self._stats_lock:
+            snap = {
+                statics: {
+                    "lat": sorted(o["lat"]),
+                    "caps": frozenset(o["caps"]),
+                    "classes": frozenset(o["classes"]),
+                    "pad_real": o["pad_real"],
+                    "pad_total": o["pad_total"],
+                    "n": o["n"],
+                }
+                for statics, o in self._bucket_obs.items()
+            }
+        return {
+            statics: {
+                "p99": _percentile(o["lat"], 0.99),
+                "padding_waste": (
+                    round(1.0 - o["pad_real"] / o["pad_total"], 4)
+                    if o["pad_total"] else None),
+                "caps": o["caps"],
+                "classes": o["classes"],
+                "n": o["n"],
+            }
+            for statics, o in snap.items()
+        }
+
+    def qos_reset_bucket_obs(self, statics) -> None:
+        """Drop one bucket's latency window and padding-waste counts
+        (the warm capacity set and class set persist — the
+        zero-recompile rungs must survive a reset). The adaptive
+        controller calls this after acting on a bucket so the next
+        decision scores post-change evidence: without it, the burst
+        that triggered a step keeps dominating the rolling window and
+        drives repeated same-direction steps long after the live
+        latency recovered."""
+        with self._stats_lock:
+            o = self._bucket_obs.get(tuple(statics))
+            if o is not None:
+                o["lat"].clear()
+                o["pad_real"] = 0
+                o["pad_total"] = 0
+
+    def _qos_stats_block(self) -> dict:
+        """The ``stats()["qos"]`` block: per-class admission/shed/
+        rate-limit counters, queue depths, latency percentiles, the
+        scheduler's deficit state, the live adaptive targets and the
+        controller rollup — rendered on the Prometheus surface by
+        the ``qos`` collector (``skylark_qos_*``)."""
+        with self._stats_lock:
+            qc = dict(self._qos_counts)
+            lat_cls = {c: sorted(d)
+                       for c, d in self._latency_by_class.items()}
+        with self._lock:
+            depth = {c: int(self._class_pending.get(c, 0))
+                     for c in _qtenants.CLASSES}
+            targets = {
+                str(statics[0]): {"linger_s": round(float(t[0]), 6),
+                                  "batch": int(t[1])}
+                for statics, t in self._qos_targets.items()}
+        by_class: dict = {
+            c: {"admitted": 0, "shed": 0, "rate_limited": 0,
+                "queue_depth": depth[c]}
+            for c in _qtenants.CLASSES}
+        by_tenant: dict = {}
+        for (kind, cls, tenant), n in qc.items():
+            by_class[cls][kind] += n
+            if tenant:
+                t = by_tenant.setdefault(
+                    tenant, {"admitted": 0, "shed": 0,
+                             "rate_limited": 0})
+                t[kind] += n
+        for c, lat in lat_cls.items():
+            by_class[c]["latency_s"] = {
+                "p50": _percentile(lat, 0.50),
+                "p99": _percentile(lat, 0.99),
+                "n": len(lat),
+            }
+        return {
+            "by_class": by_class,
+            "by_tenant": dict(sorted(by_tenant.items())),
+            "scheduler": self._sched.stats(),
+            "targets": targets,
+            "controller": (self._controller.stats()
+                           if self._controller is not None else None),
+        }
 
     def _maybe_publish_state(self) -> None:
         """Publish a health-state transition to the resilience hub
@@ -2252,6 +3115,11 @@ class MicrobatchExecutor:
                 "mean": (sum(lat) / len(lat)) if lat else None,
                 "n": len(lat),
             },
+            # the multi-tenant QoS block (docs/qos): per-class
+            # admission/shed/latency, scheduler deficits, adaptive
+            # targets — the "qos" telemetry collector aggregates it
+            # across executors
+            "qos": self._qos_stats_block(),
             # the stateful-session block (None until the first session
             # verb; the cross-registry rollup is the "sessions"
             # telemetry collector)
@@ -2269,6 +3137,8 @@ class MicrobatchExecutor:
             self._work_cv.notify_all()
             self._space_cv.notify_all()
         self._maybe_publish_state()
+        if self._controller is not None:
+            self._controller.close()
         if wait:
             self._flusher.join()
             for t in self._workers:
@@ -2290,6 +3160,32 @@ class MicrobatchExecutor:
 
 
 _EXECUTORS: "weakref.WeakSet[MicrobatchExecutor]" = weakref.WeakSet()
+
+
+def _merge_qos_blocks(blocks) -> dict:
+    """Cross-executor merge of per-executor ``stats()["qos"]`` blocks
+    — shared by :func:`serve_stats` and the ``qos`` collector so the
+    aggregation semantics (counters sum, queue depths sum, served
+    counts sum, tenants union) cannot drift apart."""
+    qos_class: dict = {
+        c: collections.Counter() for c in _qtenants.CLASSES}
+    qos_tenant: dict = {}
+    qos_served: "collections.Counter" = collections.Counter()
+    for q in blocks:
+        for cc, blk in q["by_class"].items():
+            for kk in ("admitted", "shed", "rate_limited",
+                       "queue_depth"):
+                qos_class[cc][kk] += blk.get(kk, 0)
+        for tname, blk in q["by_tenant"].items():
+            t = qos_tenant.setdefault(tname, collections.Counter())
+            t.update(blk)
+        qos_served.update(q["scheduler"]["served"])
+    return {
+        "by_class": {c: dict(qos_class[c]) for c in _qtenants.CLASSES},
+        "by_tenant": {t: dict(v)
+                      for t, v in sorted(qos_tenant.items())},
+        "served": dict(qos_served),
+    }
 
 
 def serve_stats() -> dict:
@@ -2328,6 +3224,7 @@ def serve_stats() -> dict:
         {"submits": 0, "densified": 0})
     sparse_sel: "collections.Counter" = collections.Counter()
     sparse_nnz: "collections.Counter" = collections.Counter()
+    qos_blocks: list = []
     by_replica: dict = {}
     lat_all: list = []
     waste_real = waste_total = 0
@@ -2349,6 +3246,7 @@ def serve_stats() -> dict:
         for kk, vv in s["sparse"]["by_backend"].items():
             sparse_sel[kk] += vv["kernel_flushes"]
         sparse_nnz.update(s["sparse"]["nnz_class_hist"])
+        qos_blocks.append(s["qos"])
         states[s["state"]] += 1
         if s["padding_waste_ratio"] is not None:
             with ex._stats_lock:
@@ -2377,6 +3275,7 @@ def serve_stats() -> dict:
                        for k, v in sorted(sparse_sel.items())},
         "nnz_class_hist": dict(sorted(sparse_nnz.items())),
     }
+    agg["qos"] = _merge_qos_blocks(qos_blocks)
     agg["states"] = dict(sorted(states.items()))
     agg["padding_waste_ratio"] = (
         round(1.0 - waste_real / waste_total, 4) if waste_total else None)
@@ -2393,3 +3292,23 @@ def serve_stats() -> dict:
 # (including the live ``queued`` queue-depth gauge) instead of double-
 # counting on the submit/flush hot paths.
 _telemetry.register_collector("serve", serve_stats)
+
+
+def qos_stats() -> dict:
+    """Cross-executor multi-tenant QoS aggregate (the ``qos``
+    collector block in ``telemetry.snapshot()``; renders as
+    ``skylark_qos_*`` on the Prometheus surface — the ``by_class`` /
+    ``by_tenant`` sub-blocks become label sets). Aggregates the
+    per-executor qos blocks DIRECTLY (not via :func:`serve_stats` —
+    a snapshot already runs the ``serve`` collector, and re-running
+    the full cross-executor aggregation would double every scrape's
+    latency-sort cost). Folds in the process-global tenant registry
+    so a scrape shows the registered tenants and their live token
+    balances."""
+    agg = _merge_qos_blocks(
+        [ex._qos_stats_block() for ex in list(_EXECUTORS)])
+    agg["registry"] = _qtenants.get_registry().stats()
+    return agg
+
+
+_telemetry.register_collector("qos", qos_stats)

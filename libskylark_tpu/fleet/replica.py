@@ -39,6 +39,13 @@ child's transitions with the :class:`ProcessReplica` as the source.
 Both directions additionally carry ``("shmfree", None, [slots])``
 acks for the shared-memory transport below.
 
+**QoS propagation** (docs/qos): ``submit`` kwargs carry the router-
+resolved ``tenant=``/``qos_class=`` pair verbatim — over the pickle
+pipe for process replicas — so every replica's executor schedules a
+request under the same priority class the front door admitted it in;
+a replica never re-charges the tenant's token bucket (the buckets
+live with the router's registry).
+
 **Shared-memory transport** (:mod:`libskylark_tpu.fleet.shm`, default
 on — ``SKYLARK_FLEET_SHM=0`` disables): large ndarrays inside
 ``submit`` kwargs and results do NOT ride the pickle pipe. The sender

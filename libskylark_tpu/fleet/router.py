@@ -74,6 +74,7 @@ from typing import Iterable, Optional
 
 import numpy as np
 
+from libskylark_tpu import qos as _qos
 from libskylark_tpu import telemetry as _telemetry
 from libskylark_tpu.base import env as _env
 from libskylark_tpu.base import errors as _errors
@@ -380,13 +381,16 @@ class Router:
 
     # -- routing -------------------------------------------------------
 
-    def _candidates(self, statics: tuple) -> tuple:
+    def _candidates(self, statics: tuple,
+                    allow_spill: bool = True) -> tuple:
         """(ordered candidate names, affinity owner, spilled?). The
         bounded-load owner leads; the rest follow in ring preference
         order with DEGRADED members demoted to the tail (still
         candidates — a degraded replica beats a refused request);
         under owner saturation the least-loaded healthy peer is
-        promoted to the front (a counted spill)."""
+        promoted to the front (a counted spill). ``allow_spill=False``
+        (best_effort traffic) keeps the owner in front regardless of
+        its depth — spill headroom is a latency-SLO resource."""
         owner = self._affinity_owner(statics)
         if owner is None:
             return (), None, False
@@ -397,7 +401,8 @@ class Router:
         healthy = [n for n in pref if n not in degraded]
         order = healthy + [n for n in pref if n in degraded]
         spilled = False
-        if len(healthy) > 1 and order and order[0] == owner:
+        if allow_spill and len(healthy) > 1 and order \
+                and order[0] == owner:
             try:
                 depth = self._pool.get(owner).queue_depth()
             except KeyError:           # removed by a scale-down race
@@ -421,7 +426,45 @@ class Router:
     def submit(self, endpoint: str, /, **kwargs) -> Future:
         """Route one request; returns the chosen replica's future.
         Accepts exactly the executor ``submit`` kwargs (operands plus
-        ``timeout`` / ``deadline`` / ``request_id``)."""
+        ``timeout`` / ``deadline`` / ``request_id`` / ``tenant``).
+
+        QoS (docs/qos): the router IS the front door — it resolves
+        ``tenant=`` against the parent-process registry, charges the
+        token bucket (:class:`~libskylark_tpu.base.errors
+        .TenantQuotaError` propagates to the caller; an over-quota
+        request never reaches a replica), and forwards the resolved
+        class as ``qos_class=`` so thread AND process replicas
+        schedule it identically without re-billing. Class shapes the
+        routing too: best_effort requests neither spill nor hedge —
+        load-balancing headroom and mirror capacity are reserved for
+        the classes with latency SLOs."""
+        tenant = kwargs.pop("tenant", None)
+        qos_class = kwargs.get("qos_class")
+        if qos_class is not None:
+            # normalize here too: the class steers ROUTING (spill and
+            # hedge eligibility below) before any executor coerces it
+            qos_class = kwargs["qos_class"] = _qos.coerce_class(
+                qos_class)
+        if qos_class is None:
+            # admission at the front door; the registry's buckets
+            # live in THIS process, so a process replica never needs
+            # the tenant table. A refusal is counted HERE — the
+            # executor-side rate_limited counting never sees a
+            # request the router refused
+            try:
+                tenant, qos_class = _qos.get_registry().admit(tenant)
+            except _errors.TenantQuotaError as e:
+                _cls = _qos.get_registry().resolve(tenant)[1]
+                with self._lock:
+                    self._counts["rate_limited"] += 1
+                _serve._QOS_RATE_LIMITED.inc(
+                    **{"class": _cls, "tenant": e.tenant})
+                raise
+            kwargs["qos_class"] = qos_class
+            # cardinality bound (see TenantRegistry.accounting_name):
+            # the label forwarded to replicas is vetted HERE
+            tenant = _qos.get_registry().accounting_name(tenant)
+        kwargs["tenant"] = tenant or ""
         derived = _serve.derive_request(
             endpoint, pad_floor=self._pool.pad_floor,
             **{k: v for k, v in kwargs.items()
@@ -454,7 +497,8 @@ class Router:
                 except KeyError:
                     owner_depth = None
                 if (owner_depth is not None
-                        and owner_depth < self.spill_threshold):
+                        and (owner_depth < self.spill_threshold
+                             or qos_class == _qos.BEST_EFFORT)):
                     try:
                         faults.check("fleet.route", tags=tags,
                                      detail=f"{endpoint} -> {owner}")
@@ -503,7 +547,9 @@ class Router:
         """The full candidate walk: failover order, degraded demotion,
         load spill (see :meth:`_candidates`). ``skip`` is a candidate
         the fast path already tried (and counted as a failover)."""
-        order, owner, spilled = self._candidates(statics)
+        order, owner, spilled = self._candidates(
+            statics,
+            allow_spill=kwargs.get("qos_class") != _qos.BEST_EFFORT)
         for name in order:
             if name == skip:
                 continue
@@ -546,8 +592,11 @@ class Router:
         reuses the exact same kwargs (including the predigested
         ``_derived`` statics), so taking either result is sound.
         No-op (the replica future passes straight through) when
-        hedging is off."""
-        if not self._hedge_on:
+        hedging is off — or when the request is best_effort: mirror
+        capacity is a tail-latency resource the batch class has no
+        SLO claim on (docs/qos)."""
+        if (not self._hedge_on
+                or kwargs.get("qos_class") == _qos.BEST_EFFORT):
             return fut
         if self._hedger is None:
             with self._hedge_lock:
@@ -755,6 +804,32 @@ class Router:
                            **kw) -> Future:
         return self.submit("krr_predict", kernel=kernel, X_new=X_new,
                            X_train=X_train, coef=coef, **kw)
+
+    def submit_graph_ase(self, A, k: int, *, seed: int = 0,
+                         iters: int = 2, **kw) -> Future:
+        return self.submit("graph_ase", A=A, k=k, seed=seed,
+                           iters=iters, **kw)
+
+    def submit_graph_ppr(self, A, s, *, alpha: float = 0.85,
+                         iters: int = 16, **kw) -> Future:
+        return self.submit("graph_ppr", A=A, s=s, alpha=alpha,
+                           iters=iters, **kw)
+
+    def submit_condest(self, A, *, steps: int = 8, seed: int = 0,
+                       **kw) -> Future:
+        return self.submit("condest", A=A, steps=steps, seed=seed,
+                           **kw)
+
+    def submit_lowrank(self, transform_s, transform_t, A, k: int,
+                       **kw) -> Future:
+        return self.submit("lowrank", transform_s=transform_s,
+                           transform_t=transform_t, A=A, k=k, **kw)
+
+    def submit_rlsc_predict(self, kernel, X_new, X_train, coef,
+                            coding=None, **kw) -> Future:
+        return self.submit("rlsc_predict", kernel=kernel, X_new=X_new,
+                           X_train=X_train, coef=coef, coding=coding,
+                           **kw)
 
     # -- stateful sessions (docs/sessions) -----------------------------
 
@@ -992,6 +1067,7 @@ class Router:
             "hedged": c.get("hedged", 0),
             "hedge_wins": c.get("hedge_wins", 0),
             "hedge_mismatches": c.get("hedge_mismatches", 0),
+            "rate_limited": c.get("rate_limited", 0),
             "session_handoffs": c.get("session_handoffs", 0),
             "sessions_assigned": len(self._sessions),
             "session_epoch": self._epoch,
@@ -1027,7 +1103,8 @@ def fleet_stats() -> dict:
     :class:`~libskylark_tpu.fleet.autoscale.Autoscaler`."""
     agg = collections.Counter(routed=0, affinity_hit=0, failover=0,
                               spilled=0, hedged=0, hedge_wins=0,
-                              hedge_mismatches=0, session_handoffs=0)
+                              hedge_mismatches=0, rate_limited=0,
+                              session_handoffs=0)
     by_replica = collections.Counter()
     routers = 0
     for router in list(_ROUTERS):
@@ -1035,7 +1112,7 @@ def fleet_stats() -> dict:
         routers += 1
         for k in ("routed", "affinity_hit", "failover", "spilled",
                   "hedged", "hedge_wins", "hedge_mismatches",
-                  "session_handoffs"):
+                  "rate_limited", "session_handoffs"):
             agg[k] += s[k]
         by_replica.update(s["by_replica"])
     out = dict(agg)

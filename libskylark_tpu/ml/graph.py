@@ -266,6 +266,166 @@ def time_dependent_ppr(
     return y, x
 
 
+# ---------------------------------------------------------------------------
+# Pure, vmap-batchable serve endpoints (docs/qos "Heterogeneous serve
+# endpoints"; served by engine/serve.py submit_graph_ase /
+# submit_graph_ppr over the r18 sparse CSR lanes — adjacency matrices
+# are exactly the sparse regime those lanes optimize).
+# ---------------------------------------------------------------------------
+
+
+def ase_serve_apply(key_data, data, indices, indptr, *, k: int,
+                    iters: int, shape) -> jnp.ndarray:
+    """One request's adjacency spectral embedding X = V.sqrt(|w|) as a
+    pure function of a raw PRNG key and the padded CSR adjacency
+    lanes: in-executable densify (the exact integer scatter), ``iters``
+    rounds of QR subspace iteration from a key-derived Gaussian block,
+    then the k x k Rayleigh-Ritz eigendecomposition. Every knob is
+    static, rows past the true ``n`` are exact zero rows (zero-padded
+    adjacency has zero rows/columns there, so the embedding's padded
+    rows are exact zeros the executor slices off). Fixed iteration
+    count — the convergence-adaptive diagnostic stays
+    :func:`approximate_ase`; this is its serving-shaped twin."""
+    import jax.random as jr
+
+    from libskylark_tpu.sketch.sparse_serve import scatter_dense
+
+    A = scatter_dense(data, indices, indptr, shape=tuple(shape))
+    key = jr.wrap_key_data(jnp.asarray(key_data))
+    Omega = jr.normal(key, (A.shape[1], k), A.dtype)
+    Q, _ = jnp.linalg.qr(A @ Omega)
+    for _ in range(max(int(iters), 1) - 1):
+        Q, _ = jnp.linalg.qr(A @ Q)
+    B = Q.T @ (A @ Q)
+    B = 0.5 * (B + B.T)                # symmetrize roundoff
+    w, U = jnp.linalg.eigh(B)
+    order = jnp.argsort(-jnp.abs(w))   # dominant-|eigenvalue| first
+    w = w[order]
+    V = Q @ U[:, order]
+    return V * jnp.sqrt(jnp.abs(w))[None, :]
+
+
+def graph_ase_serve(A, k: int, *, seed: int = 0, iters: int = 2,
+                    dtype=np.float32):
+    """Eager twin of the ``graph_ase`` serve endpoint: pads the
+    adjacency to its pow2 class and runs :func:`ase_serve_apply` on
+    the identical operand bits — what a capacity-1 serve dispatch
+    computes, as a plain call (the bit-equality reference the qos
+    tests pin). ``A`` is a :class:`Graph`, a
+    :class:`~libskylark_tpu.base.sparse.SparseMatrix`, or anything
+    scipy-sparse-coercible. Returns the (n, k) embedding as a host
+    array (plus the index map when ``A`` is a :class:`Graph`)."""
+    S, indexmap = coerce_adjacency(A, dtype)
+    X = _eager_csr_endpoint(
+        S, dtype,
+        lambda kd, lanes, shape: ase_serve_apply(
+            kd, *lanes, k=int(k), iters=int(iters), shape=shape),
+        seed=seed)[: S.height, :]
+    return (X, indexmap) if indexmap is not None else X
+
+
+def ppr_serve_apply(data, indices, indptr, s, *, alpha: float,
+                    iters: int, shape) -> jnp.ndarray:
+    """One request's personalized-PageRank vector by ``iters`` fixed
+    power-iteration steps over the CSR adjacency:
+    ``p <- (1-alpha) s + alpha W p`` with ``W`` the degree-normalized
+    walk matrix. Deterministic, vmap-safe, zero-padding-exact (padded
+    coordinates have zero degree — their normalizer clamps to 1 and
+    their score stays the exact 0.0 the seed vector carries). The
+    queue-driven time-dependent push solver
+    (:func:`time_dependent_ppr`) remains the host-side diagnostic;
+    this is the bulk serving-shaped variant."""
+    from libskylark_tpu.sketch.sparse_serve import scatter_dense
+
+    A = scatter_dense(data, indices, indptr, shape=tuple(shape))
+    deg = jnp.sum(A, axis=0)
+    inv_deg = jnp.where(deg > 0, 1.0 / jnp.maximum(deg, 1e-30), 0.0)
+    total = jnp.maximum(jnp.sum(s), 1e-30)
+    s = s / total
+    p = s
+    for _ in range(max(int(iters), 1)):
+        p = (1.0 - alpha) * s + alpha * (A @ (p * inv_deg))
+    return p
+
+
+def graph_ppr_serve(A, s, *, alpha: float = 0.85, iters: int = 16,
+                    dtype=np.float32):
+    """Eager twin of the ``graph_ppr`` serve endpoint (same contract
+    as :func:`graph_ase_serve`). ``s`` is the (n,) personalization
+    vector in adjacency row order (build it from a seed dict with the
+    :class:`Graph` index map)."""
+    S, indexmap = coerce_adjacency(A, dtype)
+    s = np.asarray(s, dtype=dtype)
+    if s.shape != (S.height,):
+        raise errors.InvalidParametersError(
+            f"personalization vector shape {s.shape} != "
+            f"({S.height},)")
+    p = _eager_csr_endpoint(
+        S, dtype,
+        lambda kd, lanes, shape: ppr_serve_apply(
+            *lanes, jnp.asarray(np.pad(s, (0, shape[0] - S.height))),
+            alpha=float(alpha), iters=int(iters), shape=shape),
+        seed=0)[: S.height]
+    return (p, indexmap) if indexmap is not None else p
+
+
+def coerce_adjacency(A, dtype=np.float32):
+    """``(SparseMatrix adjacency, indexmap-or-None)`` from a
+    :class:`Graph`, a SparseMatrix, scipy sparse, or a dense square
+    array — the shared intake of the graph serve endpoints."""
+    from libskylark_tpu.base.sparse import SparseMatrix
+
+    if isinstance(A, Graph):
+        S, indexmap = A.adjacency_sparse(dtype)
+        return S, indexmap
+    if isinstance(A, SparseMatrix):
+        S = A
+    else:
+        try:
+            import scipy.sparse as sp
+
+            if sp.issparse(A):
+                S = SparseMatrix.from_scipy(A)
+            else:
+                S = SparseMatrix.from_scipy(
+                    sp.csr_matrix(np.asarray(A, dtype=dtype)))
+        except ImportError:  # pragma: no cover - scipy is a hard dep
+            raise errors.InvalidParametersError(
+                "graph endpoints need a Graph/SparseMatrix/scipy "
+                "operand") from None
+    if S.height != S.width:
+        raise errors.InvalidParametersError(
+            f"adjacency must be square, got {S.shape}")
+    return S, None
+
+
+def _eager_csr_endpoint(S, dtype, fn, *, seed: int):
+    """Shared eager-twin driver: pack ``S`` exactly as the serve
+    layer's CSR lanes (pow2-padded dims, pow2 nnz class, monotone
+    indptr padding) and run ``fn(key_data, (data, indices, indptr),
+    shape)`` on the identical bits — under ``jax.jit``, so the twin
+    executes the same compiled XLA program shape the serve flush does
+    (eager op-by-op dispatch fuses differently at the last ulp)."""
+    import jax
+    import jax.random as jr
+
+    from libskylark_tpu.base import env as _env
+    from libskylark_tpu.engine import bucket as bucketing
+    from libskylark_tpu.engine.serve import MicrobatchExecutor
+
+    shape = bucketing.pad_shape(S.shape, (0, 1))
+    nnz_cls = bucketing.nnz_class(S.nnz, _env.SPARSE_NNZ_FLOOR.get())
+    # the serve layer's own packing — the bit-equality contract
+    # depends on the twin's lanes being byte-identical to a serve
+    # request's, so there must be exactly one implementation
+    d, idx, ptr = MicrobatchExecutor._pack_csr(
+        S, shape[0], nnz_cls, np.dtype(dtype))
+    kd = np.asarray(jr.key_data(jr.key(int(seed))), dtype=np.uint32)
+    run = jax.jit(lambda kd_, lanes: fn(kd_, lanes, shape))
+    return np.asarray(run(kd, (jnp.asarray(d), jnp.asarray(idx),
+                               jnp.asarray(ptr))))
+
+
 def find_local_cluster(
     G: Graph,
     seeds: Iterable[Hashable],

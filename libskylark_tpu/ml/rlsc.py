@@ -12,6 +12,7 @@ import dataclasses
 from typing import Optional
 
 import jax.numpy as jnp
+import numpy as np
 
 from libskylark_tpu.base.context import Context
 from libskylark_tpu.base.params import Params
@@ -116,6 +117,45 @@ def faster_kernel_rlsc(
     Y, coding = dummy_coding(labels, dtype=jnp.asarray(X).dtype)
     A = krr.faster_kernel_ridge(k, X, Y, lam, s, context, _krr_params(params))
     return A, coding
+
+
+# ---------------------------------------------------------------------------
+# Pure, vmap-batchable serve endpoint (docs/qos "Heterogeneous serve
+# endpoints"; served by engine/serve.py submit_rlsc_predict).
+# ---------------------------------------------------------------------------
+
+
+def rlsc_predict_kernel(k: Kernel, X_new, X_train, A) -> jnp.ndarray:
+    """The RLSC predict program — argmax over the one-vs-all KRR
+    scores — as one pure traceable function: the classification twin
+    of :func:`libskylark_tpu.ml.krr.krr_predict_kernel`. Rows of
+    ``X_new`` are independent, so the serving layer vmaps THIS over a
+    padded query batch with the model (``X_train``, ``A``) broadcast;
+    padded query rows produce garbage class indices the caller slices
+    off. Returns int32 class indices into the dummy coding."""
+    from libskylark_tpu.ml.krr import krr_predict_kernel
+
+    scores = krr_predict_kernel(k, X_new, X_train, A)
+    return jnp.argmax(scores, axis=1).astype(jnp.int32)
+
+
+def rlsc_predict(k: Kernel, X_new, X_train, A, coding=None):
+    """Eager RLSC prediction (ref: the ``dummy_decode(gram @ A)``
+    recipe in :func:`kernel_rlsc`'s docstring, as a first-class call):
+    argmax class indices, decoded to labels when ``coding`` (the label
+    order :func:`~libskylark_tpu.ml.coding.dummy_coding` returned) is
+    given. The serve endpoint's bit-equality reference."""
+    X_new = jnp.asarray(X_new)
+    squeeze = X_new.ndim == 1
+    if squeeze:
+        X_new = X_new[None, :]
+    idx = np.asarray(rlsc_predict_kernel(
+        k, X_new, jnp.asarray(X_train), jnp.asarray(A)))
+    if coding is not None:
+        out = np.asarray([coding[i] for i in idx])
+    else:
+        out = idx
+    return out[0] if squeeze else out
 
 
 def large_scale_kernel_rlsc(

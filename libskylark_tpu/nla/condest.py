@@ -173,6 +173,106 @@ def _golub_kahan(
     return (smax / max(smin, np.finfo(np.float64).tiny), smax, smin)
 
 
+# ---------------------------------------------------------------------------
+# Pure, vmap-batchable serve endpoint (docs/qos "Heterogeneous serve
+# endpoints"; served by engine/serve.py submit_condest).
+# ---------------------------------------------------------------------------
+
+
+def condest_serve_apply(key_data, A, *, steps: int) -> jnp.ndarray:
+    """One request's ``(cond, sigma_max, sigma_min)`` — as a (3,)
+    vector — by a FIXED number of Golub-Kahan steps with full
+    two-sided reorthogonalization, all on device: the serving-shaped
+    twin of :func:`condest`. The step count is static (a bucket
+    component), the start vector comes from the raw PRNG key, and the
+    small ``(steps+1) x steps`` bidiagonal's SVD runs inside the same
+    executable — pure in (key bits, operand bits), so a vmapped
+    flush is bit-equal per lane to its capacity-1 dispatch. Zero
+    padding is benign: padded rows/columns of ``A`` are zero, the
+    Krylov vectors stay inside the true row/column spaces, and the
+    rectangular bidiagonal's singular values still interlace
+    ``[sigma_min, sigma_max]`` of the true operand. Adaptive
+    convergence (and the f64 reorthogonalization grade) stays with
+    the host-side :func:`condest` diagnostic."""
+    import jax.random as jr
+
+    tiny = jnp.asarray(np.finfo(np.float32).tiny, A.dtype)
+
+    def _nrm(x):
+        return jnp.maximum(jnp.linalg.norm(x), tiny)
+
+    key = jr.wrap_key_data(jnp.asarray(key_data))
+    b = jr.normal(key, (A.shape[0],), A.dtype)
+    beta = _nrm(b)
+    u = b / beta
+    v = A.T @ u
+    alpha = _nrm(v)
+    v = v / alpha
+
+    Us = [u]
+    Vs = [v]
+    alphas = [alpha]
+    betas = []
+    for _ in range(max(int(steps), 1)):
+        u = A @ v - alpha * u
+        for up in Us:
+            u = u - (up @ u) * up
+        beta = _nrm(u)
+        u = u / beta
+        Us.append(u)
+        v = A.T @ u - beta * v
+        for vp in Vs:
+            v = v - (vp @ v) * vp
+        alpha = _nrm(v)
+        v = v / alpha
+        Vs.append(v)
+        betas.append(beta)
+        alphas.append(alpha)
+
+    # the trailing-beta rectangular bidiagonal (see _bidiag_svals)
+    u_t = A @ Vs[-1] - alphas[-1] * Us[-1]
+    for up in Us:
+        u_t = u_t - (up @ u_t) * up
+    k = len(alphas)
+    B = jnp.zeros((k + 1, k), A.dtype)
+    B = B.at[jnp.arange(k), jnp.arange(k)].set(jnp.stack(alphas))
+    if k > 1:
+        B = B.at[jnp.arange(1, k), jnp.arange(k - 1)].set(
+            jnp.stack(betas[: k - 1]))
+    B = B.at[k, k - 1].set(_nrm(u_t))
+    sv = jnp.linalg.svd(B, compute_uv=False)
+    smax = sv[0]
+    smin = jnp.maximum(sv[-1], tiny)
+    return jnp.stack([smax / smin, smax, sv[-1]])
+
+
+def condest_serve(A, *, steps: int = 8, seed: int = 0,
+                  dtype=np.float32):
+    """Eager twin of the ``condest`` serve endpoint: pads ``A`` to the
+    serve layer's pow2 class and runs :func:`condest_serve_apply` on
+    the identical bits (the qos tests' bit-equality reference).
+    Returns the ``(cond, sigma_max, sigma_min)`` triple as floats."""
+    import jax.random as jr
+
+    from libskylark_tpu.engine import bucket as bucketing
+
+    A = np.asarray(A, dtype=np.dtype(dtype))
+    if A.ndim != 2:
+        raise ValueError(f"condest expects a matrix, got {A.shape}")
+    padded = bucketing.pad_shape(A.shape, (0, 1))
+    Ap = np.zeros(padded, dtype=A.dtype)
+    Ap[: A.shape[0], : A.shape[1]] = A
+    kd = np.asarray(jr.key_data(jr.key(int(seed))), dtype=np.uint32)
+    # the twin runs the literal capacity-1 serve program shape (one
+    # lane-indexed stack): XLA fuses the recurrence differently when
+    # the lane indexing is absent, and the bit-equality contract is
+    # against the serve dispatch, not against eager op-by-op order
+    run = jax.jit(lambda kds, As: jnp.stack(
+        [condest_serve_apply(kds[0], As[0], steps=int(steps))]))
+    out = np.asarray(run(kd[None], jnp.asarray(Ap)[None])[0])
+    return float(out[0]), float(out[1]), float(out[2])
+
+
 def _bidiag_svals(matvec, Us, Vs, alphas, betas, dot, norm) -> np.ndarray:
     """Singular values of the *rectangular* (k+1)×k Golub-Kahan bidiagonal
     (host-side LAPACK, the ``dbdsqr`` analog — ref: nla/CondEst.hpp:12-16).

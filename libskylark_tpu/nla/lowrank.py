@@ -45,3 +45,59 @@ def approximate_dominant_subspace_basis(
     V = M[:, :k]
     Z = U @ V
     return Z, S, R, V
+
+
+# ---------------------------------------------------------------------------
+# Pure, vmap-batchable serve endpoint (docs/qos "Heterogeneous serve
+# endpoints"; served by engine/serve.py submit_lowrank).
+# ---------------------------------------------------------------------------
+
+
+def lowrank_serve_apply(kd_s, scale_s, kd_t, scale_t, A, *, dist,
+                        s: int, t: int, k: int) -> jnp.ndarray:
+    """One request's dominant-subspace basis Z as a pure function of
+    the two sketch keys and the operand: the two rowwise dense-family
+    sketches through the positional serve streams
+    (:func:`libskylark_tpu.sketch.dense.serve_apply` — the exact bits
+    the transforms' own ``apply`` produces), then QR / cross-product
+    SVD / truncate, identical to
+    :func:`approximate_dominant_subspace_basis` with a linear kernel.
+    Zero-padded rows of ``A`` sketch to exact zero rows, QR carries
+    them as zero rows of U, and Z's padded rows are exact zeros the
+    executor slices off."""
+    from libskylark_tpu.sketch.dense import serve_apply
+
+    X = serve_apply(kd_s, scale_s, A, dist=dist, s_dim=int(s),
+                    rowwise=True)
+    Y = serve_apply(kd_t, scale_t, A, dist=dist, s_dim=int(t),
+                    rowwise=True)
+    U, _ = jnp.linalg.qr(X)
+    M, _, _ = jnp.linalg.svd(U.T @ Y, full_matrices=False)
+    return U @ M[:, : int(k)]
+
+
+def lowrank_serve(transform_s, transform_t, A, k: int):
+    """Eager twin of the ``lowrank`` serve endpoint: the identical
+    computation from the two caller-held dense transforms (e.g.
+    ``Linear(d).create_rft(s, ctx)`` JLTs — the
+    :func:`approximate_dominant_subspace_basis` construction), at the
+    serve layer's pow2 row class (the qos tests' bit-equality
+    reference). Returns the (n, k) basis as a host array."""
+    import numpy as np
+
+    from libskylark_tpu.engine import bucket as bucketing
+    from libskylark_tpu.engine.serve import (_lowrank_key_data,
+                                             _lowrank_statics)
+
+    _statics, info = _lowrank_statics(transform_s, transform_t, A, k,
+                                      bucketing.PAD_FLOOR)
+    A = info["A"]
+    Ap = np.zeros(info["padded"], dtype=A.dtype)
+    Ap[: A.shape[0], :] = A
+    kd_s, sc_s = _lowrank_key_data(transform_s, A.dtype)
+    kd_t, sc_t = _lowrank_key_data(transform_t, A.dtype)
+    Z = lowrank_serve_apply(
+        jnp.asarray(kd_s), jnp.asarray(sc_s), jnp.asarray(kd_t),
+        jnp.asarray(sc_t), jnp.asarray(Ap), dist=info["dist"],
+        s=transform_s.sketch_dim, t=transform_t.sketch_dim, k=int(k))
+    return np.asarray(Z)[: A.shape[0], :]

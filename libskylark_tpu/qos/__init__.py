@@ -1,0 +1,51 @@
+"""Multi-tenant QoS: SLO-aware scheduling, adaptive batching, and
+admission control for the serve tier (docs/qos).
+
+Three pieces, wired through :class:`~libskylark_tpu.engine.serve
+.MicrobatchExecutor` and the fleet :class:`~libskylark_tpu.fleet
+.Router`:
+
+- :mod:`~libskylark_tpu.qos.tenants` — the tenant model: priority
+  classes (``interactive`` / ``standard`` / ``best_effort``) with
+  weights, shed fractions and p99 SLOs; named tenants with
+  deterministic token-bucket rate limits
+  (:class:`~libskylark_tpu.base.errors.TenantQuotaError` at
+  admission); the process-global :func:`get_registry`.
+- :mod:`~libskylark_tpu.qos.scheduler` — weighted-fair deficit
+  scheduling (DRR) across per-class queues, replacing the executor's
+  single FIFO drain order; class-ordered shedding (best_effort before
+  standard before interactive, sessions below interactive).
+- :mod:`~libskylark_tpu.qos.controller` — the adaptive batching
+  controller retuning per-bucket ``linger``/``max_batch`` targets
+  from the r10 latency/padding histograms against the class SLOs,
+  moving only along already-warm pow2 capacity classes so adaptation
+  causes **zero recompiles**; frozen by ``SKYLARK_QOS_ADAPT=0``.
+
+Usage::
+
+    from libskylark_tpu import qos
+
+    qos.get_registry().register("search-ui", qos.INTERACTIVE)
+    qos.get_registry().register("bulk-etl", qos.BEST_EFFORT,
+                                rate=200.0)
+    fut = ex.submit_sketch(T, A, tenant="search-ui")
+    router.submit_solve(A, b, transform=T, tenant="bulk-etl")
+"""
+
+from libskylark_tpu.qos.controller import AdaptiveController
+from libskylark_tpu.qos.scheduler import DeficitScheduler, drain_order
+from libskylark_tpu.qos.tenants import (BEST_EFFORT, CLASSES,
+                                        DEFAULT_WEIGHTS, INTERACTIVE,
+                                        STANDARD, ClassPolicy, Tenant,
+                                        TenantRegistry, TokenBucket,
+                                        class_policy, coerce_class,
+                                        default_class, get_registry,
+                                        shed_fraction, slo_seconds)
+
+__all__ = [
+    "AdaptiveController", "BEST_EFFORT", "CLASSES", "ClassPolicy",
+    "DEFAULT_WEIGHTS", "DeficitScheduler", "INTERACTIVE", "STANDARD",
+    "Tenant", "TenantRegistry", "TokenBucket", "class_policy",
+    "coerce_class", "default_class", "drain_order", "get_registry",
+    "shed_fraction", "slo_seconds",
+]

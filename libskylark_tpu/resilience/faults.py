@@ -47,6 +47,12 @@ Sites planted today:
                       resume-at-consumed-offset path
 ``dist.merge``        partial-sketch merge entry
                       (:func:`libskylark_tpu.dist.plan.merge_partials`)
+``qos.admit``         the QoS admission point, once per submit after
+                      tenant resolution (:mod:`libskylark_tpu.engine
+                      .serve` — a fired fault refuses one admission
+                      without touching the queue, so chaos plans can
+                      prove class-ordered shedding stays intact under
+                      admission failures)
 ====================  ====================================================
 
 A plan is a JSON document (or the equivalent dict)::

@@ -43,6 +43,13 @@ dense path's O(N·m) segment-sum — the committed
 scatter-free Pallas sparse kernel (:mod:`libskylark_tpu.sketch
 .pallas_sparse`) replaces this scatter per the serve ladder's
 autotuned selection.
+
+The CSR lane format (and :func:`scatter_dense`) is also the intake of
+the **graph serve endpoints** (docs/qos): ``submit_graph_ase`` /
+``submit_graph_ppr`` pack adjacency matrices — the sparse regime this
+module optimizes for — as the same padded (data, indices, indptr)
+lanes with a pow2 nnz class, densifying in-executable through the
+identical integer scatter (:mod:`libskylark_tpu.ml.graph`).
 """
 
 from __future__ import annotations

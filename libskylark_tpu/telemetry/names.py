@@ -67,6 +67,15 @@ METRICS: Dict[str, str] = {
     "dist.shards_abandoned": "counter",
     "dist.merges": "counter",
     "dist.coverage": "gauge",
+    # multi-tenant QoS (qos/tenants.py, qos/controller.py,
+    # engine/serve.py — docs/qos)
+    "qos.admitted": "counter",
+    "qos.shed": "counter",
+    "qos.rate_limited": "counter",
+    "qos.queue_depth": "gauge",
+    "qos.request_latency": "histogram",
+    "qos.linger_target": "gauge",
+    "qos.batch_target": "gauge",
     # fleet (fleet/router.py)
     "fleet.session_handoffs": "counter",
     "fleet.routed": "counter",

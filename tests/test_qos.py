@@ -1,0 +1,1141 @@
+"""Multi-tenant QoS subsystem (libskylark_tpu/qos/, docs/qos).
+
+Oracles:
+
+- *weighted fairness*: deficit round robin drains sustained all-class
+  backlog in the 8:4:1 class-weight ratio, never starves a class, and
+  is a deterministic pure function of the visible backlog;
+- *shed ordering*: best_effort sheds before standard before
+  interactive — under DEGRADED (class-ordered bounds) AND under plain
+  queue pressure (a best_effort storm can never shed a concurrent
+  interactive request — the global-shed unfairness regression);
+- *admission*: token buckets are deterministic in the observation
+  clock; an over-quota request raises ``TenantQuotaError`` at submit
+  and never occupies queue space;
+- *adaptive batching*: the controller moves per-bucket linger/batch
+  targets toward the class SLO in bounded, hysteretic steps, only
+  along already-warm capacity rungs (zero recompiles), and
+  ``SKYLARK_QOS_ADAPT=0`` freezes it;
+- *heterogeneous endpoints*: graph_ase / graph_ppr / condest /
+  lowrank / rlsc_predict are each bit-equal to their capacity-1
+  dispatch AND to their eager library twins;
+- *tenant propagation*: ``tenant=`` resolves at the router front door
+  and the class rides to thread and process replicas;
+- *chaos*: a tag-pinned serve.flush fault cannot break class ordering,
+  and the qos.* lock sites stay acyclic under the runtime witness.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from libskylark_tpu import Context, engine, fleet, qos, telemetry
+from libskylark_tpu import sketch as sk
+from libskylark_tpu.base import errors as sk_errors
+from libskylark_tpu.base import locks as sk_locks
+from libskylark_tpu.engine import bucket as bucketing
+from libskylark_tpu.ml import graph as mgraph
+from libskylark_tpu.ml import rlsc as mrlsc
+from libskylark_tpu.ml.kernels import Gaussian, Linear
+from libskylark_tpu.nla import condest as ncondest
+from libskylark_tpu.nla import lowrank as nlowrank
+from libskylark_tpu.qos.controller import AdaptiveController
+from libskylark_tpu.resilience import faults
+
+
+@pytest.fixture()
+def fresh_engine():
+    engine.reset()
+    yield
+    engine.reset()
+
+
+def _executor(**kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("linger_us", 1000)
+    return engine.MicrobatchExecutor(**kw)
+
+
+def _sketch_reqs(n_reqs=8, seed=0, n=48, s_dim=16):
+    rng = np.random.default_rng(seed)
+    ctx = Context(seed=seed)
+    T = sk.CWT(n, s_dim, ctx)
+    ops = [rng.standard_normal((n, 3 + i % 3)).astype(np.float32)
+           for i in range(n_reqs)]
+    return T, ops
+
+
+def _graph(n=20, seed=0):
+    rng = np.random.default_rng(seed)
+    G = mgraph.Graph()
+    for _ in range(4 * n):
+        u, v = rng.integers(0, n, 2)
+        G.add_edge(int(u), int(v))
+    return G
+
+
+# ---------------------------------------------------------------------------
+# tenant registry + token buckets
+# ---------------------------------------------------------------------------
+
+
+class TestTenantRegistry:
+    def test_resolve_known_unknown_and_anonymous(self):
+        reg = qos.TenantRegistry()
+        reg.register("ui", qos.INTERACTIVE)
+        reg.register("etl", qos.BEST_EFFORT)
+        assert reg.resolve("ui") == ("ui", "interactive")
+        assert reg.resolve("etl") == ("etl", "best_effort")
+        # unknown tenants and tenant-less requests land in the default
+        # class — QoS is opt-in, never a prerequisite
+        assert reg.resolve("stranger") == ("stranger", "standard")
+        assert reg.resolve(None) == ("", "standard")
+
+    def test_default_class_env_knob(self, monkeypatch):
+        monkeypatch.setenv("SKYLARK_QOS_DEFAULT_CLASS", "best_effort")
+        reg = qos.TenantRegistry()
+        assert reg.resolve(None)[1] == "best_effort"
+        monkeypatch.setenv("SKYLARK_QOS_DEFAULT_CLASS", "bogus")
+        assert reg.resolve(None)[1] == "standard"   # typo degrades
+
+    def test_token_bucket_determinism(self):
+        """Same arrival schedule, same admitted subset — twice."""
+        schedule = [0.0, 0.01, 0.02, 0.15, 0.16, 0.3, 1.0, 1.01, 1.02]
+
+        def run():
+            tb = qos.TokenBucket(rate=10.0, burst=2)
+            return [tb.try_acquire(t)[0] for t in schedule]
+
+        a, b = run(), run()
+        assert a == b
+        # burst of 2 admits the first two, refills at 10/s thereafter
+        assert a[:3] == [True, True, False]
+        assert sum(a) < len(a)
+
+    def test_token_bucket_retry_after_is_exact(self):
+        tb = qos.TokenBucket(rate=4.0, burst=1)
+        assert tb.try_acquire(0.0) == (True, 0.0)
+        ok, retry = tb.try_acquire(0.0)
+        assert not ok and retry == pytest.approx(0.25)
+
+    def test_admit_raises_quota_error(self):
+        reg = qos.TenantRegistry()
+        reg.register("bulk", qos.BEST_EFFORT, rate=5.0, burst=1)
+        reg.admit("bulk", now=0.0)
+        with pytest.raises(sk_errors.TenantQuotaError) as ei:
+            reg.admit("bulk", now=0.0)
+        assert ei.value.tenant == "bulk"
+        assert ei.value.retry_after_s > 0
+        assert ei.value.code == 115
+        # refilled after the advertised wait
+        reg.admit("bulk", now=0.0 + ei.value.retry_after_s + 1e-6)
+
+    def test_rate_default_env_knob(self, monkeypatch):
+        monkeypatch.setenv("SKYLARK_QOS_RATE_DEFAULT", "2.0")
+        monkeypatch.setenv("SKYLARK_QOS_BURST_DEFAULT", "1")
+        reg = qos.TenantRegistry()
+        t = reg.register("limited", qos.STANDARD)
+        assert t.bucket is not None and t.bucket.rate == 2.0
+        reg.admit("limited", now=0.0)
+        with pytest.raises(sk_errors.TenantQuotaError):
+            reg.admit("limited", now=0.0)
+
+    def test_unlimited_without_rate(self):
+        reg = qos.TenantRegistry()
+        reg.register("free", qos.INTERACTIVE)
+        for _ in range(100):
+            reg.admit("free", now=0.0)
+
+    def test_explicit_zero_rate_is_an_error_not_unlimited(
+            self, monkeypatch):
+        """rate=0 must never silently grant unlimited quota; only a
+        non-positive env DEFAULT degrades to unlimited (the typo
+        convention)."""
+        reg = qos.TenantRegistry()
+        with pytest.raises(sk_errors.InvalidParametersError):
+            reg.register("abuser", qos.BEST_EFFORT, rate=0.0)
+        monkeypatch.setenv("SKYLARK_QOS_RATE_DEFAULT", "0")
+        t = reg.register("envzero", qos.STANDARD)
+        assert t.bucket is None          # env zero = no default limit
+        # explicit burst=0 clamps to the 1-token floor, not to the
+        # 2x-rate default a falsy-zero check would silently pick
+        tb = qos.TokenBucket(rate=10.0, burst=0.0)
+        assert tb.burst == 1.0
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair deficit scheduling (property battery)
+# ---------------------------------------------------------------------------
+
+
+class TestDeficitScheduler:
+    def _drain(self, sched, backlog, cost, rounds):
+        served = {c: 0 for c in qos.CLASSES}
+        for _ in range(rounds):
+            c = sched.next_class(backlog, lambda cc: cost)
+            assert c is not None
+            sched.charge(c, cost)
+            served[c] += cost
+        return served
+
+    def test_weighted_ratio_under_sustained_backlog(self):
+        sched = qos.DeficitScheduler(quantum=4)
+        backlog = {c: 10**9 for c in qos.CLASSES}
+        served = self._drain(sched, backlog, 4, 13 * 20)
+        # 8:4:1 — exact over whole credit rounds, near-exact mid-round
+        assert served["interactive"] / served["best_effort"] == \
+            pytest.approx(8.0, rel=0.15)
+        assert served["standard"] / served["best_effort"] == \
+            pytest.approx(4.0, rel=0.15)
+
+    def test_starvation_freedom(self):
+        sched = qos.DeficitScheduler(quantum=1)
+        backlog = {c: 10**9 for c in qos.CLASSES}
+        served = self._drain(sched, backlog, 1, 200)
+        assert all(served[c] > 0 for c in qos.CLASSES)
+
+    def test_single_class_work_conservation(self):
+        sched = qos.DeficitScheduler()
+        assert sched.next_class({"best_effort": 5},
+                                lambda c: 5) == "best_effort"
+
+    def test_idle_class_banks_no_credit(self):
+        sched = qos.DeficitScheduler(quantum=4)
+        both = {"interactive": 10**9, "best_effort": 10**9}
+        self._drain(sched, both, 4, 50)
+        # best_effort goes idle for many rounds...
+        self._drain(sched, {"interactive": 10**9}, 4, 50)
+        # ...and must NOT burst past its weight when it returns
+        served = self._drain(qos.DeficitScheduler(quantum=4), both, 4,
+                             26)
+        resumed = self._drain(sched, both, 4, 26)
+        assert resumed["best_effort"] <= served["best_effort"] + 4
+
+    def test_determinism(self):
+        def run():
+            sched = qos.DeficitScheduler(quantum=2)
+            out = []
+            backlog = {"interactive": 7, "standard": 9,
+                       "best_effort": 30}
+            while any(v > 0 for v in backlog.values()):
+                c = sched.next_class(
+                    backlog, lambda cc: min(2, backlog[cc]))
+                n = min(2, backlog[c])
+                sched.charge(c, n)
+                backlog[c] -= n
+                out.append((c, n))
+            return out
+
+        assert run() == run()
+
+    def test_nothing_ready(self):
+        sched = qos.DeficitScheduler()
+        assert sched.next_class({}, lambda c: 1) is None
+
+    def test_drain_order_least_protected_first(self):
+        assert qos.drain_order(list(qos.CLASSES)) == [
+            "best_effort", "standard", "interactive"]
+
+
+# ---------------------------------------------------------------------------
+# class-ordered shedding (the global-shed unfairness fix)
+# ---------------------------------------------------------------------------
+
+
+class TestShedOrdering:
+    def test_best_effort_storm_never_sheds_interactive(self,
+                                                       fresh_engine):
+        """The regression the satellite pins: a best_effort storm
+        saturates ITS pressure bound (half the queue) and sheds —
+        while concurrent interactive requests keep being admitted and
+        completing with zero failures."""
+        T, ops = _sketch_reqs(12)
+        ex = _executor(max_batch=16, linger_us=10_000_000, max_queue=8)
+        try:
+            be_shed = 0
+            be_futs = []
+            for i in range(8):          # storm past the 0.5 bound
+                try:
+                    be_futs.append(ex.submit_sketch(
+                        T, ops[i % len(ops)],
+                        qos_class="best_effort"))
+                except engine.ServeOverloadedError:
+                    be_shed += 1
+            assert be_shed >= 4          # pressure bound = 4 of 8
+            # concurrent interactive traffic is untouched
+            int_futs = [ex.submit_sketch(T, ops[i],
+                                         qos_class="interactive")
+                        for i in range(3)]
+            ex.flush()
+            for f in int_futs + be_futs:
+                assert np.asarray(f.result(timeout=60)).size
+            s = ex.stats()["qos"]["by_class"]
+            assert s["interactive"]["shed"] == 0
+            assert s["best_effort"]["shed"] == be_shed
+        finally:
+            ex.shutdown()
+
+    def test_degraded_sheds_in_class_order(self, fresh_engine):
+        """Under DEGRADED the bounds are interactive 0.5 > standard
+        0.25 > best_effort 0.1 of max_queue: with the queue between
+        the bounds, best_effort and standard shed while interactive
+        still admits."""
+        T, ops = _sketch_reqs(14, n=48)
+        plan = {"seed": 0, "faults": [
+            {"site": "serve.flush", "error": "IOError_",
+             "tag": "bad"}]}
+        ex = engine.MicrobatchExecutor(
+            max_batch=1, linger_us=10_000_000, max_queue=16,
+            failure_window=8, degraded_threshold=0.5)
+        try:
+            with faults.fault_plan(plan):
+                with faults.tag("bad"):
+                    futs = [ex.submit_sketch(T, ops[i])
+                            for i in range(6)]
+                ex.flush()
+                [f.exception(timeout=60) for f in futs]
+            assert ex.state == engine.DEGRADED
+            # queue 4 interactive (bounds: be=1 std=4 int=8) so the
+            # exposure sits between the standard and interactive
+            # bounds
+            held = [ex.submit_sketch(T, ops[6 + i],
+                                     qos_class="interactive")
+                    for i in range(4)]
+            with pytest.raises(engine.ServeOverloadedError,
+                               match="shed"):
+                ex.submit_sketch(T, ops[9], qos_class="best_effort")
+            with pytest.raises(engine.ServeOverloadedError,
+                               match="shed"):
+                ex.submit_sketch(T, ops[10], qos_class="standard")
+            # interactive still admits below ITS bound
+            held.append(ex.submit_sketch(T, ops[11],
+                                         qos_class="interactive"))
+            s = ex.stats()["qos"]["by_class"]
+            assert s["best_effort"]["shed"] == 1
+            assert s["standard"]["shed"] == 1
+            assert s["interactive"]["shed"] == 0
+            ex.flush()
+            for f in held:
+                f.result(timeout=60)
+        finally:
+            ex.shutdown()
+
+    def test_session_appends_shed_below_interactive(self, fresh_engine,
+                                                    tmp_path,
+                                                    monkeypatch):
+        """r16's session_shed routed through the policy: a DEGRADED
+        executor sheds session appends while interactive one-shot
+        traffic still serves."""
+        monkeypatch.setenv("SKYLARK_SESSION_DIR", str(tmp_path))
+        T, ops = _sketch_reqs(10)
+        plan = {"seed": 0, "faults": [
+            {"site": "serve.flush", "error": "IOError_",
+             "tag": "bad"}]}
+        ex = engine.MicrobatchExecutor(
+            max_batch=1, linger_us=10_000_000, max_queue=16,
+            failure_window=8, degraded_threshold=0.5)
+        try:
+            sid = ex.open_sketch_session("cwt", n=48, s_dim=16, d=3)
+            with faults.fault_plan(plan):
+                with faults.tag("bad"):
+                    futs = [ex.submit_sketch(T, ops[i])
+                            for i in range(6)]
+                ex.flush()
+                [f.exception(timeout=60) for f in futs]
+            assert ex.state == engine.DEGRADED
+            f = ex.session_append(
+                sid, np.ones((2, 3), np.float32), seq=0)
+            assert isinstance(f.exception(timeout=10),
+                              engine.ServeOverloadedError)
+            assert ex.stats()["session_shed"] == 1
+            ok = ex.submit_sketch(T, ops[7], qos_class="interactive")
+            ex.flush()
+            ok.result(timeout=60)
+        finally:
+            ex.shutdown()
+
+    def test_shed_env_knobs_move_their_own_class(self, fresh_engine,
+                                                 monkeypatch):
+        """Each SKYLARK_QOS_SHED_* knob moves exactly its own class's
+        DEGRADED bound (the ctor scale divides by the standard
+        class's DEFAULT, not the live env value — the regression
+        where raising the standard knob was a no-op that also shrank
+        the other classes' bounds)."""
+        ex = _executor(max_queue=100)
+        try:
+            base = {c: ex._class_shed_bound(c) for c in qos.CLASSES}
+            assert base == {"interactive": 50, "standard": 25,
+                            "best_effort": 10}
+            monkeypatch.setenv("SKYLARK_QOS_SHED_STANDARD", "0.5")
+            assert ex._class_shed_bound("standard") == 50
+            assert ex._class_shed_bound("interactive") == 50
+            assert ex._class_shed_bound("best_effort") == 10
+        finally:
+            ex.shutdown()
+
+    def test_shed_counters_carry_tenant(self, fresh_engine):
+        reg = qos.TenantRegistry()
+        reg.register("batchy", qos.BEST_EFFORT)
+        T, ops = _sketch_reqs(10)
+        ex = _executor(max_batch=16, linger_us=10_000_000, max_queue=4,
+                       tenants=reg)
+        try:
+            shed = 0
+            for i in range(6):
+                try:
+                    ex.submit_sketch(T, ops[i % 4], tenant="batchy")
+                except engine.ServeOverloadedError:
+                    shed += 1
+            assert shed
+            s = ex.stats()["qos"]
+            assert s["by_tenant"]["batchy"]["shed"] == shed
+            assert s["by_tenant"]["batchy"]["admitted"] >= 1
+            ex.flush()
+        finally:
+            ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# admission rate limiting through the executor
+# ---------------------------------------------------------------------------
+
+
+class TestExecutorAdmission:
+    def test_rate_limited_submit_raises_and_counts(self, fresh_engine):
+        reg = qos.TenantRegistry()
+        reg.register("bulk", qos.BEST_EFFORT, rate=0.001, burst=1)
+        T, ops = _sketch_reqs(4)
+        ex = _executor(tenants=reg)
+        try:
+            ex.submit_sketch(T, ops[0], tenant="bulk")
+            with pytest.raises(sk_errors.TenantQuotaError):
+                ex.submit_sketch(T, ops[1], tenant="bulk")
+            s = ex.stats()["qos"]
+            assert s["by_tenant"]["bulk"]["rate_limited"] == 1
+            ex.flush()
+        finally:
+            ex.shutdown()
+
+    def test_preresolved_class_skips_admission(self, fresh_engine):
+        """qos_class= marks a front-door-admitted request: the token
+        bucket must not be charged twice."""
+        reg = qos.TenantRegistry()
+        reg.register("bulk", qos.BEST_EFFORT, rate=0.001, burst=1)
+        T, ops = _sketch_reqs(4)
+        ex = _executor(tenants=reg)
+        try:
+            for i in range(4):          # would be over quota if billed
+                ex.submit_sketch(T, ops[i], tenant="bulk",
+                                 qos_class="best_effort")
+            ex.flush()
+            s = ex.stats()["qos"]["by_class"]["best_effort"]
+            assert s["admitted"] == 4 and s["rate_limited"] == 0
+        finally:
+            ex.shutdown()
+
+    def test_unregistered_tenant_accounts_anonymously(self,
+                                                      fresh_engine):
+        """Cardinality bound: arbitrary caller tenant strings must
+        not grow the per-tenant accounting — unknown tenants land in
+        the anonymous bucket, registered ones keep their label."""
+        reg = qos.TenantRegistry()
+        reg.register("known", qos.INTERACTIVE)
+        T, ops = _sketch_reqs(4)
+        ex = _executor(tenants=reg)
+        try:
+            for i in range(3):
+                ex.submit_sketch(T, ops[i], tenant=f"user-{i}")
+            ex.submit_sketch(T, ops[3], tenant="known")
+            ex.flush()
+            by_tenant = ex.stats()["qos"]["by_tenant"]
+            assert set(by_tenant) == {"known"}
+            assert ex.stats()["qos"]["by_class"]["standard"][
+                "admitted"] == 3
+        finally:
+            ex.shutdown()
+
+    def test_unknown_class_degrades_to_default(self, fresh_engine):
+        T, ops = _sketch_reqs(2)
+        ex = _executor()
+        try:
+            f = ex.submit_sketch(T, ops[0], qos_class="platinum")
+            ex.flush()
+            f.result(timeout=60)
+            assert ex.stats()["qos"]["by_class"]["standard"][
+                "admitted"] == 1
+        finally:
+            ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair serving under overload (integration)
+# ---------------------------------------------------------------------------
+
+
+class TestWeightedFairServing:
+    def test_interactive_drains_ahead_under_backlog(self, fresh_engine):
+        """Both classes backlogged and ready: the flusher's DRR
+        dispatches interactive cohorts first (weight 8 vs 1), so
+        interactive completions finish ahead of best_effort ones."""
+        T, ops = _sketch_reqs(8, n=64)
+        done: dict = {}
+
+        def stamp(cls):
+            def cb(_f):
+                done.setdefault(cls, []).append(time.monotonic())
+            return cb
+
+        ex = _executor(max_batch=4, linger_us=60_000, workers=1,
+                       max_queue=1024)
+        try:
+            futs = []
+            # interactive first so best_effort full cohorts cannot
+            # take the fast path (higher class pending)
+            for i in range(8):
+                f = ex.submit_sketch(T, ops[i % 8],
+                                     qos_class="interactive")
+                f.add_done_callback(stamp("interactive"))
+                futs.append(f)
+            for i in range(8):
+                f = ex.submit_sketch(T, ops[i % 8],
+                                     qos_class="best_effort")
+                f.add_done_callback(stamp("best_effort"))
+                futs.append(f)
+            for f in futs:
+                f.result(timeout=120)
+            assert max(done["interactive"]) <= min(
+                done["best_effort"]) + 1e-4
+            served = ex.stats()["qos"]["scheduler"]["served"]
+            assert served["interactive"] >= 8
+        finally:
+            ex.shutdown()
+
+    def test_starvation_freedom_under_sustained_overload(
+            self, fresh_engine):
+        """A continuous interactive stream never starves best_effort:
+        its weight is >= 1, so queued best_effort work still drains."""
+        T, ops = _sketch_reqs(8, n=64)
+        ex = _executor(max_batch=2, linger_us=500, workers=1,
+                       max_queue=4096)
+        try:
+            be = [ex.submit_sketch(T, ops[i % 8],
+                                   qos_class="best_effort")
+                  for i in range(6)]
+            futs = []
+            for i in range(60):         # sustained interactive load
+                futs.append(ex.submit_sketch(
+                    T, ops[i % 8], qos_class="interactive"))
+            for f in be:                # best_effort still completes
+                f.result(timeout=120)
+            ex.flush()
+            for f in futs:
+                f.result(timeout=120)
+            served = ex.stats()["qos"]["scheduler"]["served"]
+            assert served["best_effort"] >= 1
+        finally:
+            ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# adaptive batching controller
+# ---------------------------------------------------------------------------
+
+
+def _warm_bucket(ex, T, ops, n_batches=6, **kw):
+    for _ in range(n_batches):
+        futs = [ex.submit_sketch(T, A, **kw) for A in ops]
+        ex.flush()
+        [f.result(timeout=60) for f in futs]
+
+
+class TestAdaptiveController:
+    def test_converges_down_when_over_slo(self, fresh_engine,
+                                          monkeypatch):
+        """p99 over the class SLO: linger halves and the batch target
+        steps one warm rung down — after the 2-tick hysteresis."""
+        monkeypatch.setenv("SKYLARK_QOS_SLO_STANDARD_MS", "0.0001")
+        T, ops = _sketch_reqs(8, n=48)
+        ex = _executor(max_batch=8, linger_us=2000)
+        ctrl = AdaptiveController(ex, start=False)
+        try:
+            _warm_bucket(ex, T, ops[:8])
+            statics = engine.request_statics(
+                "sketch_apply", transform=T, A=ops[0])
+            linger0, cap0 = ex.bucket_targets(statics)
+            assert ctrl.tick() == 0      # hysteresis: first tick arms
+            _warm_bucket(ex, T, ops[:8], n_batches=2)
+            assert ctrl.tick() >= 1      # second tick acts
+            linger1, cap1 = ex.bucket_targets(statics)
+            assert linger1 < linger0
+            # batch target stepped down along the WARM ladder only
+            obs = ex.qos_bucket_obs()[statics]
+            assert cap1 in obs["caps"] or cap1 == cap0
+            s = ctrl.stats()
+            assert s["adjustments"] >= 1 and not s["frozen"]
+        finally:
+            ctrl.close()
+            ex.shutdown()
+
+    def test_converges_up_on_waste_with_headroom(self, fresh_engine,
+                                                 monkeypatch):
+        """Far under SLO with high padding waste: linger grows
+        (bounded, capped) and the batch target climbs one warm rung."""
+        monkeypatch.setenv("SKYLARK_QOS_SLO_STANDARD_MS", "60000")
+        T, ops = _sketch_reqs(8, n=33)   # heavy padding at class 64
+        ex = _executor(max_batch=8, linger_us=1000)
+        ctrl = AdaptiveController(ex, start=False)
+        try:
+            # warm capacities 2 and 8 so an up-rung exists
+            for batch in (ops[:2], ops[:8], ops[:2], ops[:8]):
+                futs = [ex.submit_sketch(T, A) for A in batch]
+                ex.flush()
+                [f.result(timeout=60) for f in futs]
+            statics = engine.request_statics(
+                "sketch_apply", transform=T, A=ops[0])
+            ex.set_bucket_targets(statics, batch_cap=2)
+            linger0, _ = ex.bucket_targets(statics)
+            ctrl.tick()
+            _warm_bucket(ex, T, ops[:2], n_batches=3)
+            changed = ctrl.tick()
+            if not changed:              # hysteresis may need one more
+                _warm_bucket(ex, T, ops[:2], n_batches=3)
+                changed = ctrl.tick()
+            assert changed >= 1
+            linger1, cap1 = ex.bucket_targets(statics)
+            assert linger1 > linger0
+            assert cap1 in (8, 2)        # warm rung only
+            assert linger1 <= ex.linger * 8.0 + 1e-9
+        finally:
+            ctrl.close()
+            ex.shutdown()
+
+    def test_acting_resets_the_evidence_window(self, fresh_engine,
+                                               monkeypatch):
+        """A step drops the bucket's latency/waste window (warm caps
+        persist): the burst that triggered the step cannot keep
+        driving same-direction steps from stale samples."""
+        monkeypatch.setenv("SKYLARK_QOS_SLO_STANDARD_MS", "0.0001")
+        T, ops = _sketch_reqs(8, n=48)
+        ex = _executor(max_batch=8, linger_us=2000)
+        ctrl = AdaptiveController(ex, start=False)
+        try:
+            _warm_bucket(ex, T, ops[:8])
+            statics = engine.request_statics(
+                "sketch_apply", transform=T, A=ops[0])
+            ctrl.tick()
+            _warm_bucket(ex, T, ops[:8], n_batches=2)
+            assert ctrl.tick() >= 1
+            obs = ex.qos_bucket_obs()[statics]
+            assert obs["p99"] is None        # window dropped
+            assert obs["caps"]               # warm rungs persist
+            # with no fresh post-change samples, further ticks are
+            # no-ops instead of re-scoring the old burst
+            assert ctrl.tick() == 0
+            assert ctrl.tick() == 0
+        finally:
+            ctrl.close()
+            ex.shutdown()
+
+    def test_freeze_knob(self, fresh_engine, monkeypatch):
+        monkeypatch.setenv("SKYLARK_QOS_SLO_STANDARD_MS", "0.0001")
+        monkeypatch.setenv("SKYLARK_QOS_ADAPT", "0")
+        T, ops = _sketch_reqs(8)
+        ex = _executor(max_batch=8, linger_us=2000)
+        ctrl = AdaptiveController(ex, start=False)
+        try:
+            _warm_bucket(ex, T, ops[:8])
+            statics = engine.request_statics(
+                "sketch_apply", transform=T, A=ops[0])
+            before = ex.bucket_targets(statics)
+            for _ in range(4):
+                assert ctrl.tick() == 0
+            assert ex.bucket_targets(statics) == before
+            s = ctrl.stats()
+            assert s["frozen"] and s["frozen_ticks"] == 4
+        finally:
+            ctrl.close()
+            ex.shutdown()
+
+    def test_zero_recompile_invariant(self, fresh_engine, monkeypatch):
+        """Retuning changes targets but compiles nothing: the batch
+        target moves only along warm rungs and linger is not a key
+        component."""
+        monkeypatch.setenv("SKYLARK_QOS_SLO_STANDARD_MS", "0.0001")
+        T, ops = _sketch_reqs(8, n=48)
+        ex = _executor(max_batch=8, linger_us=2000)
+        ctrl = AdaptiveController(ex, start=False)
+        try:
+            # warm rungs 4 and 8
+            for batch in (ops[:4], ops[:8], ops[:4], ops[:8]):
+                futs = [ex.submit_sketch(T, A) for A in batch]
+                ex.flush()
+                [f.result(timeout=60) for f in futs]
+            base = engine.stats().to_dict()
+            ctrl.tick()
+            _warm_bucket(ex, T, ops[:8], n_batches=2)
+            assert ctrl.tick() >= 1      # targets moved
+            # traffic at the retuned targets: cohorts now cap at the
+            # lower rung, which is already compiled
+            _warm_bucket(ex, T, ops[:8], n_batches=3)
+            after = engine.stats().to_dict()
+            assert after["recompiles"] == base["recompiles"]
+            assert after["misses"] == base["misses"]
+        finally:
+            ctrl.close()
+            ex.shutdown()
+
+    def test_executor_adaptive_flag_starts_controller(self,
+                                                      fresh_engine):
+        ex = _executor(adaptive=True)
+        try:
+            assert ex.stats()["qos"]["controller"] is not None
+        finally:
+            ex.shutdown()
+
+    def test_capacity_ladder_helper(self):
+        assert bucketing.capacity_ladder(8) == (1, 2, 4, 8)
+        assert bucketing.capacity_ladder(8, multiple=4) == (4, 8)
+        assert bucketing.capacity_ladder(1) == (1,)
+        # a non-pow2 max_batch's full-cohort rung (the most common
+        # capacity under load) must be on the ladder
+        assert bucketing.capacity_ladder(12) == (1, 2, 4, 8, 12)
+
+
+# ---------------------------------------------------------------------------
+# heterogeneous serve endpoints: bit-equality battery
+# ---------------------------------------------------------------------------
+
+
+class TestNewEndpoints:
+    def _capacity1(self, submits):
+        ex1 = _executor(max_batch=1, linger_us=100)
+        try:
+            return [np.asarray(s(ex1).result(timeout=120))
+                    for s in submits]
+        finally:
+            ex1.shutdown()
+
+    def test_graph_ase_bit_equality(self, fresh_engine):
+        G = _graph(20, seed=1)
+        ex = _executor(max_batch=4, linger_us=2000)
+        try:
+            futs = [ex.submit_graph_ase(G, 3, seed=s)
+                    for s in (0, 1, 2, 0)]
+            batched = [np.asarray(f.result(timeout=120)) for f in futs]
+            cap1 = self._capacity1(
+                [lambda e, s=s: e.submit_graph_ase(G, 3, seed=s)
+                 for s in (0, 1, 2, 0)])
+            for b, c in zip(batched, cap1):
+                assert np.array_equal(b, c)
+            Xe, indexmap = mgraph.graph_ase_serve(G, 3, seed=0)
+            assert np.array_equal(batched[0], Xe)
+            assert batched[0].shape == (G.num_vertices(), 3)
+            assert len(indexmap) == G.num_vertices()
+            # same-seed requests are bit-identical
+            assert np.array_equal(batched[0], batched[3])
+        finally:
+            ex.shutdown()
+
+    def test_graph_ase_embedding_quality(self, fresh_engine):
+        """Two dense blocks joined by one edge: the embedding's top
+        dimension separates the blocks (a sanity anchor, not a bit
+        oracle)."""
+        G = mgraph.Graph()
+        for blk in (range(0, 8), range(8, 16)):
+            blk = list(blk)
+            for i in blk:
+                for j in blk:
+                    if i < j:
+                        G.add_edge(i, j)
+        G.add_edge(0, 8)
+        ex = _executor(max_batch=1, linger_us=100)
+        try:
+            X, im = ex.submit_graph_ase(G, 2, seed=0,
+                                        iters=4).result(timeout=120), \
+                G.vertices
+            X = np.asarray(X)
+            # dominant eigenvector magnitude similar within blocks
+            a = np.abs(X[:8, 0]).mean()
+            b = np.abs(X[8:, 0]).mean()
+            assert a > 0 and b > 0
+        finally:
+            ex.shutdown()
+
+    def test_graph_ppr_bit_equality_and_mass(self, fresh_engine):
+        G = _graph(24, seed=2)
+        n = G.num_vertices()
+        s0 = np.zeros(n, np.float32)
+        s0[0] = 1.0
+        s1 = np.zeros(n, np.float32)
+        s1[1] = 1.0
+        ex = _executor(max_batch=4, linger_us=2000)
+        try:
+            futs = [ex.submit_graph_ppr(G, s, alpha=0.85, iters=8)
+                    for s in (s0, s1, s0)]
+            batched = [np.asarray(f.result(timeout=120)) for f in futs]
+            cap1 = self._capacity1(
+                [lambda e, s=s: e.submit_graph_ppr(G, s, alpha=0.85,
+                                                   iters=8)
+                 for s in (s0, s1, s0)])
+            for b, c in zip(batched, cap1):
+                assert np.array_equal(b, c)
+            pe, _ = mgraph.graph_ppr_serve(G, s0, alpha=0.85, iters=8)
+            assert np.array_equal(batched[0], pe)
+            # diffusion sanity: non-negative, seed keeps the largest
+            # score, total mass below 1 (teleport absorbs the rest)
+            p = batched[0]
+            assert (p >= 0).all() and p.argmax() == 0
+            assert 0.1 < p.sum() <= 1.0 + 1e-5
+        finally:
+            ex.shutdown()
+
+    def test_condest_bit_equality_and_accuracy(self, fresh_engine):
+        rng = np.random.default_rng(3)
+        mats = [rng.standard_normal((24, 10)).astype(np.float32)
+                for _ in range(3)]
+        ex = _executor(max_batch=4, linger_us=2000)
+        try:
+            futs = [ex.submit_condest(A, steps=6, seed=1)
+                    for A in mats]
+            batched = [np.asarray(f.result(timeout=120)) for f in futs]
+            cap1 = self._capacity1(
+                [lambda e, A=A: e.submit_condest(A, steps=6, seed=1)
+                 for A in mats])
+            for b, c in zip(batched, cap1):
+                assert np.array_equal(b, c)
+            et = ncondest.condest_serve(mats[0], steps=6, seed=1)
+            assert np.array_equal(batched[0],
+                                  np.asarray(et, np.float32))
+            # against the f64 host oracle: the fixed-step estimate
+            # brackets within the documented estimator tolerance
+            ref_cond, ref_max, _ = ncondest.condest(mats[0],
+                                                    Context(9))
+            assert batched[0][1] == pytest.approx(ref_max, rel=0.2)
+            assert 1.0 <= batched[0][0] <= 3.0 * ref_cond
+        finally:
+            ex.shutdown()
+
+    def test_condest_rejects_excess_steps(self, fresh_engine):
+        ex = _executor()
+        try:
+            with pytest.raises(ValueError, match="steps"):
+                ex.submit_condest(np.eye(4, dtype=np.float32),
+                                  steps=10)
+        finally:
+            ex.shutdown()
+
+    def test_lowrank_bit_equality_and_span(self, fresh_engine):
+        rng = np.random.default_rng(4)
+        ctx = Context(11)
+        kern = Linear(10)
+        Ts = kern.create_rft(8, ctx)
+        Tt = kern.create_rft(12, ctx)
+        # low-rank + noise operand at a pow2 row class (bitwise regime)
+        U0 = rng.standard_normal((16, 3)).astype(np.float32)
+        V0 = rng.standard_normal((3, 10)).astype(np.float32)
+        mats = [(U0 @ V0 + 0.01 * rng.standard_normal((16, 10))
+                 ).astype(np.float32) for _ in range(3)]
+        ex = _executor(max_batch=4, linger_us=2000)
+        try:
+            futs = [ex.submit_lowrank(Ts, Tt, A, 3) for A in mats]
+            batched = [np.asarray(f.result(timeout=120)) for f in futs]
+            cap1 = self._capacity1(
+                [lambda e, A=A: e.submit_lowrank(Ts, Tt, A, 3)
+                 for A in mats])
+            for b, c in zip(batched, cap1):
+                assert np.array_equal(b, c)
+            Ze = nlowrank.lowrank_serve(Ts, Tt, mats[0], 3)
+            assert np.array_equal(batched[0], Ze)
+            # the basis captures the dominant subspace: projection
+            # residual well under the noise-free norm
+            Z = batched[0]
+            A = mats[0]
+            resid = np.linalg.norm(A - Z @ (Z.T @ A))
+            assert resid < 0.35 * np.linalg.norm(A)
+        finally:
+            ex.shutdown()
+
+    def test_rlsc_predict_bit_equality_and_decode(self, fresh_engine):
+        rng = np.random.default_rng(5)
+        gk = Gaussian(4, 1.0)
+        Xtr = rng.standard_normal((12, 4)).astype(np.float32)
+        coef = rng.standard_normal((12, 3)).astype(np.float32)
+        queries = [rng.standard_normal((5, 4)).astype(np.float32)
+                   for _ in range(3)]
+        coding = ["cat", "dog", "bird"]
+        ex = _executor(max_batch=4, linger_us=2000)
+        try:
+            futs = [ex.submit_rlsc_predict(gk, Xq, Xtr, coef)
+                    for Xq in queries]
+            batched = [np.asarray(f.result(timeout=120)) for f in futs]
+            cap1 = self._capacity1(
+                [lambda e, Xq=Xq: e.submit_rlsc_predict(gk, Xq, Xtr,
+                                                        coef)
+                 for Xq in queries])
+            for b, c in zip(batched, cap1):
+                assert np.array_equal(b, c)
+                assert b.dtype == np.int32
+            et = mrlsc.rlsc_predict(gk, queries[0], Xtr, coef)
+            assert np.array_equal(batched[0], et)
+            # decoded labels
+            fd = ex.submit_rlsc_predict(gk, queries[0], Xtr, coef,
+                                        coding=coding)
+            labels = fd.result(timeout=120)
+            assert list(labels) == [coding[i] for i in batched[0]]
+        finally:
+            ex.shutdown()
+
+    def test_endpoints_are_distinct_bucket_families(self, fresh_engine):
+        G = _graph(16, seed=6)
+        s = np.ones(G.num_vertices(), np.float32)
+        rng = np.random.default_rng(6)
+        A = rng.standard_normal((16, 8)).astype(np.float32)
+        st1 = engine.request_statics("graph_ase", A=G, k=2)
+        st2 = engine.request_statics("graph_ppr", A=G, s=s)
+        st3 = engine.request_statics("condest", A=A, steps=4)
+        fams = {st1[0], st2[0], st3[0]}
+        assert fams == {"graph_ase", "graph_ppr", "condest"}
+
+    def test_graph_endpoints_accept_scipy(self, fresh_engine):
+        import scipy.sparse as sp
+
+        rng = np.random.default_rng(7)
+        n = 12
+        M = (rng.random((n, n)) < 0.2).astype(np.float32)
+        M = np.triu(M, 1)
+        M = M + M.T
+        S = sp.csr_matrix(M)
+        ex = _executor(max_batch=1, linger_us=100)
+        try:
+            out = np.asarray(
+                ex.submit_graph_ase(S, 2, seed=0).result(timeout=120))
+            assert out.shape == (n, 2)
+        finally:
+            ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tenant propagation through the fleet
+# ---------------------------------------------------------------------------
+
+
+class TestFleetPropagation:
+    def test_thread_fleet_propagates_class(self, fresh_engine):
+        reg = qos.get_registry()
+        reg.register("ui-fleet-test", qos.INTERACTIVE)
+        reg.register("etl-fleet-test", qos.BEST_EFFORT)
+        T, ops = _sketch_reqs(8)
+        pool = fleet.ReplicaPool(2, max_batch=4, linger_us=500)
+        router = fleet.Router(pool)
+        try:
+            futs = [router.submit_sketch(T, ops[i % 8],
+                                         tenant="ui-fleet-test")
+                    for i in range(4)]
+            futs += [router.submit_sketch(T, ops[i % 8],
+                                          tenant="etl-fleet-test")
+                     for i in range(4)]
+            for f in futs:
+                f.result(timeout=120)
+            agg = engine.serve_stats()["qos"]
+            assert agg["by_class"]["interactive"]["admitted"] >= 4
+            assert agg["by_class"]["best_effort"]["admitted"] >= 4
+            assert agg["by_tenant"]["ui-fleet-test"]["admitted"] == 4
+        finally:
+            router.close()
+            pool.shutdown()
+            reg.unregister("ui-fleet-test")
+            reg.unregister("etl-fleet-test")
+
+    def test_router_front_door_rate_limit(self, fresh_engine):
+        reg = qos.get_registry()
+        reg.register("throttled-fleet", qos.STANDARD, rate=0.001,
+                     burst=1)
+        T, ops = _sketch_reqs(4)
+        pool = fleet.ReplicaPool(2, max_batch=4, linger_us=500)
+        router = fleet.Router(pool)
+        try:
+            router.submit_sketch(T, ops[0],
+                                 tenant="throttled-fleet").result(
+                                     timeout=120)
+            with pytest.raises(sk_errors.TenantQuotaError):
+                router.submit_sketch(T, ops[1],
+                                     tenant="throttled-fleet")
+            # the refusal is COUNTED at the front door — the
+            # executor-side counting never saw this request
+            assert router.stats()["rate_limited"] == 1
+            assert fleet.fleet_stats()["rate_limited"] >= 1
+        finally:
+            router.close()
+            pool.shutdown()
+            reg.unregister("throttled-fleet")
+
+    def test_best_effort_never_hedges(self, fresh_engine):
+        T, ops = _sketch_reqs(4)
+        pool = fleet.ReplicaPool(2, max_batch=4, linger_us=500)
+        router = fleet.Router(pool, hedge=True, hedge_delay_ms=0.0)
+        try:
+            futs = [router.submit_sketch(T, ops[i],
+                                         qos_class="best_effort")
+                    for i in range(4)]
+            for f in futs:
+                f.result(timeout=120)
+            time.sleep(0.2)              # give a hedge time to fire
+            assert router.stats()["hedged"] == 0
+        finally:
+            router.close()
+            pool.shutdown()
+
+    @pytest.mark.slow
+    def test_process_replica_propagates_class(self, fresh_engine):
+        T, ops = _sketch_reqs(4)
+        pool = fleet.ReplicaPool(1, backend="process", max_batch=4,
+                                 linger_us=500)
+        router = fleet.Router(pool)
+        try:
+            futs = [router.submit_sketch(T, ops[i],
+                                         qos_class="interactive",
+                                         tenant="remote-ui")
+                    for i in range(3)]
+            for f in futs:
+                f.result(timeout=120)
+            child = pool.get(pool.names()[0]).stats()["qos"]
+            assert child["by_class"]["interactive"]["admitted"] == 3
+            assert child["by_tenant"]["remote-ui"]["admitted"] == 3
+        finally:
+            router.close()
+            pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos: class ordering survives injected faults, lock sites acyclic
+# ---------------------------------------------------------------------------
+
+
+class TestChaos:
+    def test_flush_fault_does_not_break_class_ordering(self,
+                                                       fresh_engine):
+        """A tag-pinned serve.flush fault poisons ONE best_effort
+        request; every interactive request still completes, bit-equal
+        to a fault-free run — and the qos.* lock sites recorded by
+        the runtime witness stay acyclic."""
+        sk_locks.reset_witness()
+        sk_locks.enable_witness(True)
+        try:
+            reg = qos.TenantRegistry()   # fresh locks: witnessed
+            reg.register("chaos-ui", qos.INTERACTIVE)
+            reg.register("chaos-etl", qos.BEST_EFFORT, rate=1000.0)
+            T, ops = _sketch_reqs(8, n=48)
+            plan = {"seed": 13, "faults": [
+                {"site": "serve.flush", "error": "SketchError",
+                 "tag": "poison"}]}
+            ex = engine.MicrobatchExecutor(
+                max_batch=4, linger_us=2000, tenants=reg,
+                adaptive=True)
+            try:
+                with faults.fault_plan(plan):
+                    good = [ex.submit_sketch(T, ops[i],
+                                             tenant="chaos-ui")
+                            for i in range(4)]
+                    with faults.tag("poison"):
+                        bad = ex.submit_sketch(T, ops[4],
+                                               tenant="chaos-etl")
+                    more = [ex.submit_sketch(T, ops[i],
+                                             tenant="chaos-etl")
+                            for i in range(5, 8)]
+                    ex.flush()
+                    assert isinstance(bad.exception(timeout=60),
+                                      sk_errors.SketchError)
+                    results = [np.asarray(f.result(timeout=60))
+                               for f in good + more]
+                # fault-free reference run, same operands
+                ref_ex = _executor(max_batch=4, linger_us=2000)
+                refs = [np.asarray(
+                    ref_ex.submit_sketch(T, ops[i]).result(timeout=60))
+                    for i in list(range(4)) + list(range(5, 8))]
+                ref_ex.shutdown()
+                for got, ref in zip(results, refs):
+                    assert np.array_equal(got, ref)
+                s = ex.stats()["qos"]["by_class"]
+                assert s["interactive"]["shed"] == 0
+            finally:
+                ex.shutdown()
+            sk_locks.check_witness()     # qos.* sites acyclic
+        finally:
+            sk_locks.enable_witness(False)
+            sk_locks.reset_witness()
+
+    def test_qos_admit_fault_site(self, fresh_engine):
+        T, ops = _sketch_reqs(2)
+        plan = {"seed": 0, "faults": [
+            {"site": "qos.admit", "error": "IOError_",
+             "tag": "bad-admit"}]}
+        ex = _executor()
+        try:
+            with faults.fault_plan(plan):
+                with faults.tag("bad-admit"):
+                    with pytest.raises(sk_errors.IOError_):
+                        ex.submit_sketch(T, ops[0])
+                ok = ex.submit_sketch(T, ops[1])
+                ex.flush()
+                ok.result(timeout=60)
+        finally:
+            ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# telemetry surface
+# ---------------------------------------------------------------------------
+
+
+class TestTelemetry:
+    def test_prometheus_qos_rendering(self, fresh_engine):
+        T, ops = _sketch_reqs(4)
+        was_enabled = telemetry.enabled()
+        telemetry.set_enabled(True)   # exercise the LIVE instruments
+        ex = _executor()
+        try:
+            futs = [ex.submit_sketch(T, ops[i],
+                                     qos_class="interactive")
+                    for i in range(3)]
+            ex.flush()
+            [f.result(timeout=60) for f in futs]
+            text = telemetry.prometheus_text()
+            # the qos collector aggregates every live executor in the
+            # process, so assert presence + a floor, not an exact count
+            import re
+
+            m = re.search(
+                r'skylark_qos_admitted\{class="interactive"\} (\d+)',
+                text)
+            assert m and int(m.group(1)) >= 3
+            # the live gauge carries a replica label so N executors
+            # publish N series instead of clobbering one label key
+            assert re.search(
+                r'skylark_qos_queue_depth\{class="interactive",'
+                r'replica="' + re.escape(ex.name) + r'"\}', text)
+            assert "skylark_qos_shed" in text
+        finally:
+            ex.shutdown()
+            telemetry.set_enabled(was_enabled)
+
+    def test_stats_and_collector_shape(self, fresh_engine):
+        T, ops = _sketch_reqs(2)
+        ex = _executor(adaptive=True)
+        try:
+            f = ex.submit_sketch(T, ops[0], qos_class="interactive")
+            ex.flush()
+            f.result(timeout=60)
+            q = ex.stats()["qos"]
+            assert set(q["by_class"]) == set(qos.CLASSES)
+            assert "latency_s" in q["by_class"]["interactive"]
+            assert q["scheduler"]["weights"]["interactive"] == 8
+            assert q["controller"]["ticks"] >= 0
+            agg = engine.serve_stats()["qos"]
+            assert agg["by_class"]["interactive"]["admitted"] >= 1
+            snap = telemetry.snapshot()
+            assert "registry" in snap["collectors"]["qos"]
+        finally:
+            ex.shutdown()
