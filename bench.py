@@ -725,6 +725,40 @@ def _qos(rounds: int = 6, per_round: int = 16) -> None:
 
 
 # ---------------------------------------------------------------------------
+# the measurement ledger: best-for-host-class ratchet input
+# ---------------------------------------------------------------------------
+
+
+def _ledger_append(metric: str, value) -> None:
+    """Append one line to ``benchmarks/ledger.json`` (JSON lines): the
+    cross-run measurement ledger the CI ratchet reads. Each entry
+    carries the metric, its value, the ``host_class`` the number is
+    comparable within (platform + core count — an rps from a 4-core
+    runner must never ratchet an 8-core one), and the probe-health
+    block for provenance. The ledger is telemetry, not a gate:
+    appending never fails a bench run."""
+    try:
+        try:
+            import jax
+
+            plat = jax.default_backend()
+        except Exception:  # noqa: BLE001 — provenance, not a gate
+            plat = "unknown"
+        rec = {
+            "metric": str(metric),
+            "value": value,
+            "host_class": f"{plat}-{os.cpu_count()}c",
+            "probe_health": probe_health_block(),
+        }
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "benchmarks", "ledger.json")
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    except Exception:  # noqa: BLE001 — never fail the bench for it
+        pass
+
+
+# ---------------------------------------------------------------------------
 # serve-level measurement: microbatch coalescing vs sequential dispatch
 # ---------------------------------------------------------------------------
 
@@ -1125,6 +1159,176 @@ def _serve(n_requests: int = 64, max_batch: int = 16,
         "telemetry": _telemetry_snapshot(),
     }
     print(json.dumps(rec), flush=True)
+    _ledger_append("serve_microbatch_rps_batched", rec["rps_batched"])
+
+
+# ---------------------------------------------------------------------------
+# cache-level measurement: content-addressed hot-operand storm A/B
+# ---------------------------------------------------------------------------
+
+
+def _cache(n_requests: int = 240, n_unique: int = 4,
+           max_batch: int = 8, rounds: int = 5) -> None:
+    """Content-addressed result-cache A/B (``python bench.py --cache``;
+    backend-agnostic — run with JAX_PLATFORMS=cpu for the hardware-free
+    record; docs/caching).
+
+    Workload: a **hot-operand storm** — ``n_requests`` submits cycling
+    ``n_unique`` distinct (transform, operand) requests, each unique
+    request under its own Context seed (same bucket class, different
+    content address). *Uncached* runs the storm through a plain
+    microbatch executor: every duplicate re-flushes. *Cached* runs the
+    identical storm with ``cache=True``: the uniques compute once at
+    warmup and the measured window is pure digest→result hits — zero
+    flushes, zero compiles, bit-equal results. A single-flight leg
+    storms one digest concurrently and proves one miss + N-1 coalesced
+    futures off ONE flush. Prints exactly one JSON line and appends
+    the headline to ``benchmarks/ledger.json``."""
+    import jax
+    import numpy as np
+
+    from libskylark_tpu import Context, engine
+    from libskylark_tpu import sketch as sk
+
+    engine.reset()
+    rng = np.random.default_rng(0)
+    s_dim = 64
+    uniq = []
+    for i in range(n_unique):
+        T = sk.JLT(256, s_dim, Context(seed=i))
+        A = rng.standard_normal((256, 24)).astype(np.float32)
+        uniq.append((T, A))
+
+    def storm(ex):
+        futs = [ex.submit_sketch(*uniq[i % n_unique],
+                                 dimension=sk.COLUMNWISE)
+                for i in range(n_requests)]
+        outs = [f.result(timeout=60) for f in futs]
+        jax.block_until_ready(outs)
+        return outs
+
+    def measure(ex):
+        best = float("inf")
+        outs = None
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            outs = storm(ex)
+            best = min(best, time.perf_counter() - t0)
+        return n_requests / best, outs
+
+    # -- uncached control: every duplicate re-flushes -------------------
+    ex0 = engine.MicrobatchExecutor(
+        max_batch=max_batch, linger_us=2000,
+        max_queue=4 * n_requests, workers=2, cache=False)
+    # warm every pow2 capacity class so the measured window is
+    # provably compile-free however linger fragments the cohorts
+    cap = 1
+    while cap <= max_batch:
+        futs = [ex0.submit_sketch(*uniq[i % n_unique],
+                                  dimension=sk.COLUMNWISE)
+                for i in range(cap)]
+        ex0.flush()
+        jax.block_until_ready([f.result(timeout=120) for f in futs])
+        cap *= 2
+    storm(ex0)
+    st = engine.stats()
+    warm0 = (st.misses, st.recompiles)
+    rps_uncached, out_uncached = measure(ex0)
+    u_misses = engine.stats().misses - warm0[0]
+    u_recompiles = engine.stats().recompiles - warm0[1]
+    flushes_uncached = ex0.stats()["flushes"]
+    ex0.shutdown()
+
+    # -- cached: uniques compute once, the storm is pure hits -----------
+    ex1 = engine.MicrobatchExecutor(
+        max_batch=max_batch, linger_us=2000,
+        max_queue=4 * n_requests, workers=2, cache=True)
+    for T, A in uniq:                     # one flush per unique
+        ex1.submit_sketch(T, A, dimension=sk.COLUMNWISE)\
+            .result(timeout=120)
+    # the settle callback inserts from the flush worker AFTER the
+    # future resolves — barrier on the entry count so the measured
+    # storm cannot race the last warm insert into a spurious miss
+    deadline = time.monotonic() + 30
+    while (ex1.stats()["cache"]["entries"] < n_unique
+           and time.monotonic() < deadline):
+        time.sleep(0.001)
+    flushes_warm = ex1.stats()["flushes"]
+    st = engine.stats()
+    warm1 = (st.misses, st.recompiles)
+    rps_cached, out_cached = measure(ex1)
+    c_misses = engine.stats().misses - warm1[0]
+    c_recompiles = engine.stats().recompiles - warm1[1]
+    cache_blk = ex1.stats()["cache"]
+    flushes_measured = ex1.stats()["flushes"] - flushes_warm
+
+    bit_equal = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(out_cached, out_uncached))
+
+    # -- single-flight leg: one digest stormed concurrently -------------
+    ex2 = engine.MicrobatchExecutor(max_batch=max_batch,
+                                    linger_us=500_000,
+                                    max_queue=4 * n_requests,
+                                    cache=True)
+    sf_n = 64
+    futs = [ex2.submit_sketch(*uniq[0], dimension=sk.COLUMNWISE)
+            for _ in range(sf_n)]
+    ex2.flush()
+    sf_outs = [np.asarray(f.result(timeout=120)) for f in futs]
+    sf_blk = ex2.stats()["cache"]
+    single_flight = {
+        "concurrent_submits": sf_n,
+        "flushes": ex2.stats()["flushes"],
+        "misses": sf_blk["misses"],
+        "coalesced": sf_blk["single_flight_coalesced"],
+        "fan_bit_equal": all(np.array_equal(o, sf_outs[0])
+                             for o in sf_outs[1:]),
+    }
+    ex1.shutdown()
+    ex2.shutdown()
+
+    rec = {
+        "metric": "cache_hot_operand_storm",
+        "platform": jax.default_backend(),
+        "n_requests": n_requests,
+        "unique_requests": n_unique,
+        "max_batch": max_batch,
+        "rps_cached": round(rps_cached, 1),
+        "rps_uncached": round(rps_uncached, 1),
+        "speedup": round(rps_cached / rps_uncached, 2),
+        "bit_equal_to_uncached": bit_equal,
+        "cached_flushes_measured": flushes_measured,
+        "uncached_flushes": flushes_uncached,
+        # compiles across both measured windows: zero proves the A/B
+        # compares dispatch paths, not compilation luck
+        "misses_after_warmup": {"cached": c_misses,
+                                "uncached": u_misses},
+        "recompiles_after_warmup": {"cached": c_recompiles,
+                                    "uncached": u_recompiles},
+        "cache": {
+            "hit_rate": cache_blk["hit_rate"],
+            "hits": cache_blk["hits"],
+            "misses": cache_blk["misses"],
+            "bytes_saved": cache_blk["bytes_saved"],
+            "entries": cache_blk["entries"],
+        },
+        "single_flight": single_flight,
+        "host_cores": os.cpu_count(),
+        "telemetry": _telemetry_snapshot(),
+    }
+    print(json.dumps(rec), flush=True)
+    _ledger_append("cache_hot_storm_speedup", rec["speedup"])
+    ok = (rec["speedup"] >= 3.0
+          and bit_equal
+          and flushes_measured == 0
+          and not (c_misses or c_recompiles
+                   or u_misses or u_recompiles)
+          and single_flight["misses"] == 1
+          and single_flight["coalesced"] == sf_n - 1
+          and single_flight["fan_bit_equal"])
+    if not ok:
+        sys.exit(1)
 
 
 # ---------------------------------------------------------------------------
@@ -2426,6 +2630,11 @@ if __name__ == "__main__":
         # sparse-operand serve A/B: CSR lanes vs densify-then-sketch
         # (bit-equality + zero-recompile proof); backend-agnostic
         _sparse()
+    elif "--cache" in sys.argv:
+        # content-addressed result-cache A/B: hot-operand storm,
+        # cached vs uncached (bit-equality + zero-flush + single-
+        # flight proof); backend-agnostic, in-process like --serve
+        _cache()
     elif "--certify-kernels" in sys.argv:
         # one-shot serve-ladder certification: measure pallas-vs-XLA
         # per serve bucket and upgrade ranked plan-cache entries to

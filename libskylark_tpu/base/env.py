@@ -573,6 +573,54 @@ QOS_SLO_BEST_EFFORT_MS = declare(
         "class (throughput-oriented: the controller optimizes padding "
         "waste, not latency, while this holds).")
 
+# -- content-addressed result cache (docs/caching) --------------------------
+
+CACHE = declare(
+    "SKYLARK_CACHE", default=False, parser=parse_flag, kind="flag",
+    propagate=True,
+    doc="Content-addressed result cache + single-flight dedupe on the "
+        "serve path (docs/caching). Opt-in (``1``): executors "
+        "constructed without an explicit ``cache=`` argument consult "
+        "this flag. Propagated so process replicas inherit the "
+        "fleet's caching decision.")
+
+CACHE_MAX_BYTES = declare(
+    "SKYLARK_CACHE_MAX_BYTES", default=256 * 1024 * 1024,
+    parser=parse_positive_int, kind="bytes", propagate=True,
+    doc="Per-executor byte budget of the digest->result cache; the "
+        "per-class quota fractions partition it. 0-or-invalid "
+        "degrades to the default.")
+
+CACHE_QUOTA_INTERACTIVE = declare(
+    "SKYLARK_CACHE_QUOTA_INTERACTIVE", default=0.5, parser=parse_float,
+    kind="float", propagate=True,
+    doc="Fraction of ``SKYLARK_CACHE_MAX_BYTES`` reserved for the "
+        "interactive class's cached results. Quotas are hard class "
+        "partitions: insertion into one class can only evict that "
+        "class's own entries, so a best_effort storm can never evict "
+        "an interactive working set (docs/caching, \"Tenant "
+        "admission\").")
+
+CACHE_QUOTA_STANDARD = declare(
+    "SKYLARK_CACHE_QUOTA_STANDARD", default=0.35, parser=parse_float,
+    kind="float", propagate=True,
+    doc="Fraction of the cache byte budget reserved for the standard "
+        "class (see SKYLARK_CACHE_QUOTA_INTERACTIVE).")
+
+CACHE_QUOTA_BEST_EFFORT = declare(
+    "SKYLARK_CACHE_QUOTA_BEST_EFFORT", default=0.15,
+    parser=parse_float, kind="float", propagate=True,
+    doc="Fraction of the cache byte budget reserved for the "
+        "best_effort class (see SKYLARK_CACHE_QUOTA_INTERACTIVE).")
+
+CACHE_SINGLE_FLIGHT_TIMEOUT = declare(
+    "SKYLARK_CACHE_SINGLE_FLIGHT_TIMEOUT", default=30.0,
+    parser=parse_float, kind="float", propagate=True,
+    doc="Seconds an in-flight request stays coalescable: identical "
+        "requests arriving later than this behind a still-unresolved "
+        "leader start their own flight instead of waiting on a "
+        "possibly wedged one (docs/caching, \"Single-flight\").")
+
 # -- sketch kernels ---------------------------------------------------------
 
 PALLAS_MTILE = declare(

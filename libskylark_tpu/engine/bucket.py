@@ -132,3 +132,21 @@ def padded_elements(padded_shape: Sequence[int], capacity: int) -> int:
 
 def real_elements(shapes: Sequence[Sequence[int]]) -> int:
     return int(sum(int(np.prod([int(e) for e in s])) for s in shapes))
+
+
+def result_nbytes(value) -> int:
+    """Byte accounting of one serve result for the cache/residency
+    quotas (docs/caching): host arrays count their buffer, containers
+    sum their array members, anything else counts a conservative
+    64-byte overhead. This is the same element-accounting layer the
+    padding-waste counters use — quota arithmetic must agree across
+    every executor, so it lives here rather than per call site."""
+    if isinstance(value, np.ndarray):
+        return int(value.nbytes)
+    if isinstance(value, (tuple, list)):
+        return 64 + sum(result_nbytes(v) for v in value)
+    if isinstance(value, dict):
+        return 64 + sum(result_nbytes(v) for v in value.values())
+    if isinstance(value, (bytes, bytearray)):
+        return len(value)
+    return 64

@@ -1,0 +1,754 @@
+"""Content-addressed result caching, single-flight request dedupe and
+operand residency for the serve path (docs/caching).
+
+Every serve endpoint is a **pure function** of (operand bytes, key
+material, bucket statics) — the determinism discipline the serve layer
+enforces (zero-padding is bit-exact, filler lanes replicate real
+requests, seeds ride explicit key data). That purity makes results
+*content-addressable*: a blake2b digest over the request's operand
+bytes plus its statics names the result uniquely, so a hot operand
+storm — a million callers hitting the same matrix — can be served by
+ONE flush and a fan-out instead of a million recomputations. This is
+the serving analogue of libSkylark's sketch-reuse idiom (sketch once,
+solve many); see PAPER.md's nla layer and docs/caching.
+
+Three cooperating mechanisms, layered router → executor → engine:
+
+**Digests** (:func:`operand_digest`). blake2b-256 over a canonical
+walk of the request's operand arrays: per array a small header
+(name, dtype, shape) followed by the raw buffer. C-contiguous arrays
+— including the read-only zero-copy views the r15 SHM transport hands
+out, and the (data, indices, indptr) parts of r18 CSR operands — hash
+straight from their buffer with **no densify and no staging copy**;
+only a non-contiguous view pays a materialization. The digest of a
+request must cover everything that reaches the executable: operand
+bytes AND the transform's key data (the seed) AND any scale — same
+bytes with a different seed is a DIFFERENT request, and coalescing
+them would fan one seed's result to the other's caller (the
+miscoalesce regression the test battery pins).
+
+**Single-flight** (:meth:`ResultCache.join_flight` /
+:meth:`~ResultCache.lead_flight` / :meth:`~ResultCache.settle_flight`).
+Concurrent identical requests coalesce onto one in-flight *leader*;
+followers get their own futures, and the leader's resolution fans the
+one result (or the one exception — a poisoned flush fails every
+coalesced waiter identically, never strands a future) out to all of
+them. A flight older than ``SKYLARK_CACHE_SINGLE_FLIGHT_TIMEOUT``
+stops accepting followers, so a wedged leader cannot accrete waiters
+forever.
+
+**Bounded digest→result cache** (:class:`ResultCache`). Byte-budgeted
+(``SKYLARK_CACHE_MAX_BYTES``) and partitioned across the r19 QoS
+classes by the ``SKYLARK_CACHE_QUOTA_*`` fractions
+(:func:`libskylark_tpu.qos.tenants.cache_quota_fraction`). Quotas are
+**hard partitions**: inserting into one class evicts only that class's
+own oldest entries, so a best_effort tenant can never evict an
+interactive working set. Eviction is deterministic — strict insertion
+order (FIFO) within the class, no recency reordering — so two
+replicas fed the same request history hold bit-identical caches (the
+property that makes cross-replica affinity misses cheap). Cached
+values are stored as **read-only** host arrays and handed out without
+copying: a hit costs a dict lookup, and immutability is what makes
+the zero-copy fan-out sound.
+
+**Operand residency** (:class:`ResidencyTable`). ``register_operand``
+content-hashes an operand once and pins it (optionally with its
+precomputed sketch) under its digest; later submits reference the
+:class:`OperandRef` instead of re-shipping bytes, and a pinned sketch
+satisfies a matching sketch-apply without touching the flush path at
+all. Cross-replica, the fleet layer pushes pins over the SHM
+transport with the pickle pipe as fallback (fleet/replica.py).
+
+The cache deliberately does nothing under a DEGRADED executor: the
+executor checks its own health *before* touching any cache lock, so a
+shedding replica never blocks intake on cache bookkeeping
+(docs/caching, "DEGRADED bypass").
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, Optional
+
+import numpy as np
+
+from libskylark_tpu.base import env as _env
+from libskylark_tpu.base import locks as _locks
+from libskylark_tpu.engine import bucket as bucketing
+from libskylark_tpu.qos import tenants as _qtenants
+from libskylark_tpu.telemetry import metrics as _metrics
+
+# result-cache instruments (docs/caching) — created HERE once (the
+# metric-names one-creation-site contract); per-executor
+# disaggregation lives in ``MicrobatchExecutor.stats()["cache"]`` and
+# the cross-executor rollup rides the ``cache`` collector registered
+# in engine/serve.py.
+_HITS = _metrics.counter(
+    "cache.hits",
+    "Result-cache hits (request served from the digest->result "
+    "cache, no flush), by priority class")
+_MISSES = _metrics.counter(
+    "cache.misses",
+    "Result-cache misses (request went on to flush or coalesce), by "
+    "priority class")
+_BYTES_SAVED = _metrics.counter(
+    "cache.bytes_saved",
+    "Result bytes served without recomputation — cache hits plus "
+    "single-flight fan-outs — by priority class")
+_EVICTED = _metrics.counter(
+    "cache.evicted",
+    "Cache entries evicted by the per-class byte quotas (FIFO within "
+    "the inserting class — one class never evicts another's working "
+    "set), by priority class")
+_SF_COALESCED = _metrics.counter(
+    "cache.single_flight_coalesced",
+    "Requests coalesced onto an identical in-flight leader (one "
+    "flush, N futures), by priority class")
+_RESIDENT = _metrics.gauge(
+    "cache.resident_operands",
+    "Operands currently pinned by register_operand, by replica")
+
+
+# ---------------------------------------------------------------------------
+# digesting
+# ---------------------------------------------------------------------------
+
+
+def _hash_array(h, name: str, a) -> None:
+    """Fold one operand array into the digest: a type/shape header
+    (two arrays with the same bytes but different dtype or shape must
+    not collide) followed by the raw buffer. C-contiguous arrays —
+    the steady state: fresh host operands, SHM views, packed CSR
+    lanes — feed blake2b through a zero-copy memoryview; only a
+    strided view pays ``tobytes()``."""
+    a = np.asarray(a)
+    h.update(f"|{name}:{a.dtype.str}:{a.shape}|".encode())
+    if a.flags.c_contiguous:
+        h.update(a.data)
+    else:
+        h.update(a.tobytes())
+
+
+def operand_digest(parts, statics=()) -> str:
+    """The content address of one request: blake2b-256 over the
+    bucket ``statics`` (endpoint, family digest, dtype, shape class —
+    everything the executable is keyed on) and ``parts``, an ordered
+    sequence of ``(name, value)`` pairs where each value is an
+    ndarray-coercible operand, ``bytes``, or ``str``. The caller
+    chooses the parts; the serve layer's ``request_digest`` includes
+    the transform key data and scale next to the operand bytes so a
+    seed change always changes the digest (the miscoalesce
+    regression). Order is significant and part names are framed, so
+    two part lists cannot collide by concatenation."""
+    h = hashlib.blake2b(digest_size=32)
+    h.update(repr(tuple(statics)).encode())
+    for name, v in parts:
+        if isinstance(v, (bytes, bytearray)):
+            h.update(f"|{name}:bytes:{len(v)}|".encode())
+            h.update(v)
+        elif isinstance(v, str):
+            h.update(f"|{name}:str|".encode())
+            h.update(v.encode())
+        elif v is None:
+            h.update(f"|{name}:none|".encode())
+        else:
+            _hash_array(h, name, v)
+    return h.hexdigest()
+
+
+class OperandRef(str):
+    """A registered operand's handle: the digest string, typed so the
+    serve layer can tell a reference from a real operand at intake.
+    Subclassing ``str`` keeps it trivially picklable over the process
+    replica pipe (it arrives as the digest text either way — the
+    executor re-wraps)."""
+
+    __slots__ = ()
+
+    @property
+    def digest(self) -> str:
+        return str(self)
+
+
+def is_ref(x) -> bool:
+    """Whether an intake operand is a residency reference (an
+    :class:`OperandRef`, or its pickled/forwarded plain-string form
+    carrying the ``ref:`` prefix)."""
+    return isinstance(x, OperandRef) or (
+        isinstance(x, str) and x.startswith("ref:"))
+
+
+def as_ref(x) -> "OperandRef":
+    return x if isinstance(x, OperandRef) else OperandRef(
+        x[4:] if isinstance(x, str) and x.startswith("ref:") else x)
+
+
+# ---------------------------------------------------------------------------
+# value freezing + sizing
+# ---------------------------------------------------------------------------
+
+
+def freeze_result(value):
+    """An immutable private copy of one result: host arrays are copied
+    once and marked read-only; containers are frozen memberwise
+    (tuples stay tuples, lists become tuples). The copy detaches the
+    cache from the executor's shared batch buffer (``_unpad`` hands
+    out views into one donated-flush output), and the read-only flag
+    is what lets every later hit and fan-out share the SAME array
+    with zero copies — a caller cannot poison the cache through it."""
+    if isinstance(value, np.ndarray):
+        out = np.array(value, copy=True)
+        out.setflags(write=False)
+        return out
+    if isinstance(value, (tuple, list)):
+        return tuple(freeze_result(v) for v in value)
+    if isinstance(value, dict):
+        return {k: freeze_result(v) for k, v in value.items()}
+    return value
+
+
+class _Flight:
+    """One in-flight single-flight entry: the leader's future plus the
+    followers fanned from it. Mutated only under the cache lock; the
+    fan itself runs outside it (a follower's done-callbacks must not
+    execute under cache state)."""
+
+    __slots__ = ("key", "cls", "leader", "followers", "t0", "settled")
+
+    def __init__(self, key: str, cls: str, leader: Future):
+        self.key = key
+        self.cls = cls
+        self.leader = leader
+        self.followers: list = []
+        self.t0 = time.monotonic()
+        self.settled = False
+
+
+#: lookup's distinguished miss sentinel (``None`` is a legal result)
+MISS = object()
+
+
+class ResultCache:
+    """Bounded, class-partitioned digest→result cache with the
+    single-flight table (module docstring). One instance per
+    :class:`~libskylark_tpu.engine.serve.MicrobatchExecutor`; the
+    executor owns the DEGRADED bypass (it never calls in here while
+    degraded), this class owns determinism and the quota contract.
+
+    Thread-safety: one leaf lock (``cache.state``) guards the maps;
+    no method calls back into the executor or resolves a future while
+    holding it, so the lock-order witness stays acyclic by
+    construction."""
+
+    def __init__(self, name: str = "",
+                 max_bytes: Optional[int] = None,
+                 quota_fractions: Optional[Dict[str, float]] = None,
+                 single_flight_timeout: Optional[float] = None):
+        self.name = str(name)
+        self.max_bytes = int(max_bytes if max_bytes is not None
+                             else _env.CACHE_MAX_BYTES.get())
+        fr = {c: _qtenants.cache_quota_fraction(c)
+              for c in _qtenants.CLASSES}
+        if quota_fractions:
+            for c, f in quota_fractions.items():
+                fr[_qtenants.coerce_class(c)] = min(max(float(f), 0.0),
+                                                    1.0)
+        self.budgets = {c: int(self.max_bytes * fr[c])
+                        for c in _qtenants.CLASSES}
+        self.sf_timeout = float(
+            single_flight_timeout if single_flight_timeout is not None
+            else _env.CACHE_SINGLE_FLIGHT_TIMEOUT.get())
+        self._lock = _locks.make_lock("cache.state")
+        # per class, strict insertion order: FIFO eviction with no
+        # recency reordering is what makes two replicas' caches
+        # bit-identical under the same request history
+        self._entries: Dict[str, "collections.OrderedDict"] = {
+            c: collections.OrderedDict() for c in _qtenants.CLASSES}
+        self._bytes: Dict[str, int] = {c: 0 for c in _qtenants.CLASSES}
+        self._flights: Dict[str, _Flight] = {}
+        self._counts: "collections.Counter" = collections.Counter()
+
+    # -- lookup / insert ----------------------------------------------
+
+    def note_hit(self, cls: str, value) -> None:
+        """Account a request satisfied from a *pinned* result (an
+        operand registered with its transform — the residency table's
+        sketch-stage skip): same hit/bytes-saved ledger as a cache
+        hit, no entry touched (pins live outside the byte quotas)."""
+        cls = _qtenants.coerce_class(cls)
+        nbytes = bucketing.result_nbytes(value)
+        with self._lock:
+            self._counts[("hits", cls)] += 1
+            self._counts[("bytes_saved", cls)] += nbytes
+        _HITS.inc(**{"class": cls})
+        _BYTES_SAVED.inc(nbytes, **{"class": cls})
+
+    def lookup(self, key: str, cls: str):
+        """The cached result under ``key`` (a read-only shared value)
+        or :data:`MISS`. Counts the hit and the bytes it saved; a
+        MISS is counted by :meth:`lead_flight` instead — a request
+        that goes on to *coalesce* onto an in-flight leader never
+        flushed, so counting it as a miss would make a perfectly
+        deduped storm read as a 0% hit rate. The inserting class does
+        not gate the lookup — a result is a pure function of the
+        request, so serving an interactive hit from a best_effort
+        insertion is free sharing, not a quota violation (quotas
+        bound *retention*, not reads)."""
+        with self._lock:
+            for c in _qtenants.CLASSES:
+                ent = self._entries[c].get(key)
+                if ent is not None:
+                    value, nbytes = ent
+                    self._counts[("hits", cls)] += 1
+                    self._counts[("bytes_saved", cls)] += nbytes
+                    break
+            else:
+                return MISS
+        _HITS.inc(**{"class": cls})
+        _BYTES_SAVED.inc(nbytes, **{"class": cls})
+        return value
+
+    def put(self, key: str, cls: str, value) -> bool:
+        """Insert one frozen result under its digest, charged to
+        ``cls``'s byte quota; evicts the class's own oldest entries
+        (and only those) until the insertion fits. Returns whether
+        the value was admitted — one larger than the whole class
+        budget is refused (counted ``uncacheable``), never thrashes
+        the class clean for a value that cannot stay."""
+        cls = _qtenants.coerce_class(cls)
+        nbytes = bucketing.result_nbytes(value)
+        budget = self.budgets.get(cls, 0)
+        evicted = 0
+        with self._lock:
+            if nbytes > budget:
+                self._counts[("uncacheable", cls)] += 1
+                return False
+            d = self._entries[cls]
+            if key in d:            # leader raced a peer insert
+                return True
+            while self._bytes[cls] + nbytes > budget and d:
+                _, (_, old_nb) = d.popitem(last=False)
+                self._bytes[cls] -= old_nb
+                evicted += 1
+            d[key] = (value, nbytes)
+            self._bytes[cls] += nbytes
+            if evicted:
+                self._counts[("evicted", cls)] += evicted
+            self._counts[("insertions", cls)] += 1
+        if evicted:
+            _EVICTED.inc(evicted, **{"class": cls})
+        return True
+
+    def invalidate(self, key: str) -> bool:
+        """Drop one digest from every class partition (docs/caching,
+        "Invalidation"): the serve results themselves never go stale
+        — endpoints are pure — but an unpinned resident operand's
+        digest may be re-registered with different bytes, and tooling
+        that re-seeds a cache wants a surgical drop."""
+        dropped = False
+        with self._lock:
+            for c in _qtenants.CLASSES:
+                ent = self._entries[c].pop(key, None)
+                if ent is not None:
+                    self._bytes[c] -= ent[1]
+                    dropped = True
+        return dropped
+
+    def clear(self) -> None:
+        with self._lock:
+            for c in _qtenants.CLASSES:
+                self._entries[c].clear()
+                self._bytes[c] = 0
+
+    # -- single-flight -------------------------------------------------
+
+    def join_flight(self, key: str, cls: str) -> Optional[Future]:
+        """Attach to an identical in-flight request, if one exists and
+        is still fresh: returns the follower's future (resolved by
+        the leader's settle) or ``None`` (the caller becomes — or
+        races to become — the leader). A flight past the
+        single-flight timeout no longer accepts followers; it still
+        settles the ones it has."""
+        with self._lock:
+            fl = self._flights.get(key)
+            if (fl is None or fl.settled
+                    or time.monotonic() - fl.t0 > self.sf_timeout):
+                return None
+            f: Future = Future()
+            fl.followers.append(f)
+            self._counts[("single_flight_coalesced", cls)] += 1
+            self._counts[("bypassed", cls)] += 1
+        _SF_COALESCED.inc(**{"class": cls})
+        return f
+
+    def lead_flight(self, key: str, cls: str, leader: Future) -> _Flight:
+        """Register ``leader`` as the flight for ``key``; this is also
+        where the MISS is counted — the leader is the one request of
+        its digest that actually flushes. An existing stale flight is
+        displaced (it keeps — and will settle — its own followers; it
+        simply stops being joinable)."""
+        cls = _qtenants.coerce_class(cls)
+        fl = _Flight(key, cls, leader)
+        with self._lock:
+            self._flights[key] = fl
+            self._counts[("misses", cls)] += 1
+        _MISSES.inc(**{"class": cls})
+        return fl
+
+    def settle_flight(self, flight: _Flight, fut: Future,
+                      insert: bool = True) -> None:
+        """The leader's done-callback target: detach the flight, cache
+        the result (a frozen copy; skipped when the executor is
+        DEGRADED — ``insert=False`` — or the leader failed), and fan
+        the outcome to every follower. Futures are resolved OUTSIDE
+        the cache lock: a follower's own done-callbacks run at
+        arbitrary client code, which must never execute under cache
+        state. Every follower settles exactly once — a poisoned flush
+        fans its exception to all coalesced waiters, orphaning none."""
+        with self._lock:
+            if flight.settled:
+                return
+            flight.settled = True
+            if self._flights.get(flight.key) is flight:
+                del self._flights[flight.key]
+            followers = list(flight.followers)
+        exc = fut.exception()
+        if exc is not None:
+            for f in followers:
+                f.set_exception(exc)
+            return
+        value = fut.result()
+        frozen = freeze_result(value)
+        nbytes = bucketing.result_nbytes(frozen)
+        if insert:
+            self.put(flight.key, flight.cls, frozen)
+        if followers:
+            with self._lock:
+                self._counts[("bytes_saved", flight.cls)] += (
+                    nbytes * len(followers))
+            _BYTES_SAVED.inc(nbytes * len(followers),
+                             **{"class": flight.cls})
+            for f in followers:
+                f.set_result(frozen)
+
+    def abort_flight(self, flight: _Flight, exc: BaseException) -> None:
+        """Fail a flight whose leader never reached execution (its
+        submit raised synchronously — a shed, an expired deadline):
+        the followers coalesced onto a request that no longer exists,
+        so they fail with the leader's exception, orphan-free."""
+        with self._lock:
+            if flight.settled:
+                return
+            flight.settled = True
+            if self._flights.get(flight.key) is flight:
+                del self._flights[flight.key]
+            followers = list(flight.followers)
+        for f in followers:
+            f.set_exception(exc)
+
+    # -- introspection -------------------------------------------------
+
+    def stats(self) -> dict:
+        """The ``stats()["cache"]`` block (docs/caching): hit/miss/
+        eviction counters and byte budgets per class, live entry
+        counts, single-flight accounting. Aggregated across executors
+        by :func:`libskylark_tpu.engine.serve.cache_stats` (the
+        ``cache`` collector)."""
+        with self._lock:
+            c = dict(self._counts)
+            entries = {cls: len(self._entries[cls])
+                       for cls in _qtenants.CLASSES}
+            nbytes = dict(self._bytes)
+            flights = len(self._flights)
+
+        def total(kind):
+            return sum(n for (k, _cls), n in c.items() if k == kind)
+
+        by_class = {}
+        for cls in _qtenants.CLASSES:
+            by_class[cls] = {
+                "hits": c.get(("hits", cls), 0),
+                "misses": c.get(("misses", cls), 0),
+                "bytes_saved": c.get(("bytes_saved", cls), 0),
+                "evicted": c.get(("evicted", cls), 0),
+                "single_flight_coalesced": c.get(
+                    ("single_flight_coalesced", cls), 0),
+                "insertions": c.get(("insertions", cls), 0),
+                "uncacheable": c.get(("uncacheable", cls), 0),
+                "entries": entries[cls],
+                "bytes": nbytes[cls],
+                "budget_bytes": self.budgets[cls],
+            }
+        hits, misses = total("hits"), total("misses")
+        return {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": (round(hits / (hits + misses), 4)
+                         if hits + misses else None),
+            "bytes_saved": total("bytes_saved"),
+            "evicted": total("evicted"),
+            "single_flight_coalesced": total("single_flight_coalesced"),
+            "insertions": total("insertions"),
+            "uncacheable": total("uncacheable"),
+            "entries": sum(entries.values()),
+            "bytes": sum(nbytes.values()),
+            "max_bytes": self.max_bytes,
+            "in_flight": flights,
+            "by_class": by_class,
+        }
+
+
+def merge_cache_blocks(blocks) -> dict:
+    """Cross-executor merge of per-executor ``stats()["cache"]``
+    blocks — counters and byte gauges sum, budgets sum (the process's
+    total retention capacity), hit rate re-derives from the pooled
+    counts (a mean of per-replica ratios would weight an idle replica
+    equally with a loaded one). Shared by ``serve_stats()`` and the
+    ``cache`` telemetry collector so the semantics cannot drift."""
+    agg: "collections.Counter" = collections.Counter()
+    res: "collections.Counter" = collections.Counter()
+    by_class: dict = {c: collections.Counter()
+                      for c in _qtenants.CLASSES}
+    n = 0
+    for b in blocks:
+        if not b:
+            continue
+        n += 1
+        for k in ("hits", "misses", "bytes_saved", "evicted",
+                  "single_flight_coalesced", "insertions",
+                  "uncacheable", "entries", "bytes", "max_bytes",
+                  "in_flight"):
+            agg[k] += b.get(k, 0)
+        for cls, blk in b.get("by_class", {}).items():
+            by_class[cls].update(blk)
+        res.update(b.get("residency") or {})
+    out = dict(agg)
+    out["caches"] = n
+    out["residency"] = dict(res)
+    out["hit_rate"] = (
+        round(agg["hits"] / (agg["hits"] + agg["misses"]), 4)
+        if agg["hits"] + agg["misses"] else None)
+    out["by_class"] = {c: dict(by_class[c]) for c in _qtenants.CLASSES}
+    return out
+
+
+class SingleFlight:
+    """A standalone flight table — request coalescing WITHOUT the
+    result cache. The fleet router uses one per router (docs/caching,
+    "Single-flight at the front door"): concurrent identical submits
+    coalesce onto one dispatched leader, its result fans to every
+    follower, and nothing is retained afterward — replica-side caching
+    (and its quota arithmetic, including MISS accounting) stays with
+    the executor's :class:`ResultCache`. A coalesced follower here is
+    counted on the shared ``cache.single_flight_coalesced`` /
+    ``cache.bytes_saved`` instruments; misses are NOT counted (a
+    leader that dispatches is an ordinary routed request).
+
+    Same locking discipline as the cache: one leaf lock, futures
+    resolved outside it."""
+
+    def __init__(self, name: str = "",
+                 timeout: Optional[float] = None):
+        self.name = str(name)
+        self.timeout = float(
+            timeout if timeout is not None
+            else _env.CACHE_SINGLE_FLIGHT_TIMEOUT.get())
+        self._lock = _locks.make_lock("cache.router_flights")
+        self._flights: Dict[str, _Flight] = {}
+        self._counts: "collections.Counter" = collections.Counter()
+
+    def join(self, key: str, cls: str) -> Optional[Future]:
+        """A follower future for an in-flight ``key``, or ``None``
+        (the caller leads). Semantics match
+        :meth:`ResultCache.join_flight`: settled or timed-out flights
+        no longer accept followers."""
+        cls = _qtenants.coerce_class(cls)
+        with self._lock:
+            fl = self._flights.get(key)
+            if (fl is None or fl.settled
+                    or time.monotonic() - fl.t0 > self.timeout):
+                return None
+            f: Future = Future()
+            fl.followers.append(f)
+            self._counts[("coalesced", cls)] += 1
+        _SF_COALESCED.inc(**{"class": cls})
+        return f
+
+    def lead(self, key: str, cls: str) -> _Flight:
+        """Register the caller as ``key``'s leader (displacing a stale
+        flight, which keeps its own followers)."""
+        cls = _qtenants.coerce_class(cls)
+        fl = _Flight(key, cls, None)
+        with self._lock:
+            self._flights[key] = fl
+            self._counts[("led", cls)] += 1
+        return fl
+
+    def settle(self, flight: _Flight, fut: Future) -> None:
+        """The leader future's done-callback target: fan the outcome
+        (a frozen copy on success — followers at the front door may be
+        different tenants and must not share a writable buffer with
+        the leader) to every follower. Nothing is cached."""
+        with self._lock:
+            if flight.settled:
+                return
+            flight.settled = True
+            if self._flights.get(flight.key) is flight:
+                del self._flights[flight.key]
+            followers = list(flight.followers)
+        if not followers:
+            return
+        exc = fut.exception()
+        if exc is not None:
+            for f in followers:
+                f.set_exception(exc)
+            return
+        frozen = freeze_result(fut.result())
+        nbytes = bucketing.result_nbytes(frozen)
+        with self._lock:
+            self._counts[("bytes_saved", flight.cls)] += (
+                nbytes * len(followers))
+        _BYTES_SAVED.inc(nbytes * len(followers),
+                         **{"class": flight.cls})
+        for f in followers:
+            f.set_result(frozen)
+
+    def abort(self, flight: _Flight, exc: BaseException) -> None:
+        """Fail a flight whose leader's dispatch raised synchronously
+        (no healthy replica, quota refusal): followers fail with the
+        leader's exception, orphan-free."""
+        with self._lock:
+            if flight.settled:
+                return
+            flight.settled = True
+            if self._flights.get(flight.key) is flight:
+                del self._flights[flight.key]
+            followers = list(flight.followers)
+        for f in followers:
+            f.set_exception(exc)
+
+    def stats(self) -> dict:
+        with self._lock:
+            c = dict(self._counts)
+            flights = len(self._flights)
+
+        def total(kind):
+            return sum(n for (k, _cls), n in c.items() if k == kind)
+
+        return {
+            "coalesced": total("coalesced"),
+            "led": total("led"),
+            "bytes_saved": total("bytes_saved"),
+            "in_flight": flights,
+            "by_class": {
+                cls: {"coalesced": c.get(("coalesced", cls), 0),
+                      "led": c.get(("led", cls), 0),
+                      "bytes_saved": c.get(("bytes_saved", cls), 0)}
+                for cls in _qtenants.CLASSES},
+        }
+
+
+# ---------------------------------------------------------------------------
+# operand residency
+# ---------------------------------------------------------------------------
+
+
+class ResidencyTable:
+    """Digest→pinned-operand table behind ``register_operand``
+    (docs/caching, "Operand residency"). A pin holds the operand's
+    frozen host array — and, when registered with a transform, the
+    operand's precomputed sketch keyed by the transform's key data —
+    for as long as the caller keeps it registered: pins are explicit
+    API state, never evicted by the byte quotas (the cache bounds
+    *derived* results; a pin is the caller's declared working set).
+    ``unregister`` is the invalidation path; re-registering different
+    bytes under a forced digest is refused."""
+
+    def __init__(self, name: str = ""):
+        self.name = str(name)
+        self._lock = _locks.make_lock("cache.residency")
+        self._pins: Dict[str, np.ndarray] = {}
+        # request digest -> pinned result (a registered operand's
+        # precomputed sketch), plus operand digest -> the request
+        # digests it owns, so unregistering an operand drops its
+        # pinned results with it
+        self._results: Dict[str, np.ndarray] = {}
+        self._owned: Dict[str, list] = {}
+
+    def pin(self, digest: str, operand, replace: bool = False) -> str:
+        value = freeze_result(np.asarray(operand))
+        with self._lock:
+            held = self._pins.get(digest)
+            if held is not None and not replace:
+                if (held.shape != value.shape
+                        or held.dtype != value.dtype
+                        or not np.array_equal(held, value)):
+                    raise ValueError(
+                        f"operand digest {digest[:12]}… is already "
+                        f"pinned to different bytes")
+                return digest
+            self._pins[digest] = value
+            n = len(self._pins)
+        _RESIDENT.set(float(n), replica=self.name)
+        return digest
+
+    def pin_result(self, rdigest: str, value,
+                   owner: Optional[str] = None) -> None:
+        """Pin one precomputed result under its full *request* digest
+        — the sketch-stage skip: a later submit whose digest matches
+        resolves from here before the byte-bounded cache is even
+        consulted, and a pin is never evicted. ``owner`` ties the
+        result to a registered operand's digest so ``unpin(owner)``
+        drops it too."""
+        with self._lock:
+            self._results[rdigest] = freeze_result(np.asarray(value))
+            if owner is not None:
+                self._owned.setdefault(owner, []).append(rdigest)
+
+    def result(self, rdigest: str):
+        with self._lock:
+            return self._results.get(rdigest)
+
+    def resolve(self, digest: str) -> np.ndarray:
+        with self._lock:
+            v = self._pins.get(digest)
+        if v is None:
+            raise KeyError(
+                f"no resident operand for digest {digest[:12]}… on "
+                f"{self.name or 'this executor'} — register_operand "
+                f"it here (a fleet front door broadcasts pins to "
+                f"every replica)")
+        return v
+
+    def unpin(self, digest: str) -> bool:
+        with self._lock:
+            found = self._pins.pop(digest, None) is not None
+            for rd in self._owned.pop(digest, ()):
+                self._results.pop(rd, None)
+            n = len(self._pins)
+        _RESIDENT.set(float(n), replica=self.name)
+        return found
+
+    def digests(self) -> list:
+        with self._lock:
+            return sorted(self._pins)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "resident_operands": len(self._pins),
+                "pinned_results": len(self._results),
+                "resident_bytes": int(sum(
+                    v.nbytes for v in self._pins.values())),
+            }
+
+
+__all__ = [
+    "OperandRef", "ResidencyTable", "ResultCache", "SingleFlight",
+    "as_ref", "freeze_result", "is_ref", "MISS", "merge_cache_blocks",
+    "operand_digest",
+]

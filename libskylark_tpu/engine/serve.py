@@ -102,6 +102,19 @@ sparse CSR lanes), :meth:`~MicrobatchExecutor.submit_condest`,
 bucket family, each bit-equal to its capacity-1 dispatch and to its
 eager twin.
 
+Content-addressed caching (:mod:`libskylark_tpu.engine.resultcache`,
+docs/caching; opt-in via ``cache=True`` / ``SKYLARK_CACHE``): every
+endpoint is a pure function of (operand bytes, key data, statics), so
+requests carry a blake2b **digest** — computed once, at the fleet
+front door when one exists (``_digest=``) — behind three fast paths
+at intake: results pinned by :meth:`~MicrobatchExecutor
+.register_operand` (the sketch-once residency API), a byte-bounded
+QoS-partitioned digest→result cache, and **single-flight** coalescing
+of concurrent identical requests onto one flush (one leader, N
+futures, bit-equal fan-out; a poisoned flush fails all coalesced
+waiters with the leader's exception). All three are bypassed while
+DEGRADED — a shedding executor never blocks intake on cache locks.
+
 Resilience (r9, :mod:`libskylark_tpu.resilience`): a failed flush no
 longer fans its exception to the whole cohort — the executor retries
 **bisection-style**, splitting the cohort in half and re-executing each
@@ -145,6 +158,7 @@ from libskylark_tpu.qos import scheduler as _qsched
 from libskylark_tpu.qos import tenants as _qtenants
 from libskylark_tpu.telemetry import metrics as _metrics
 from libskylark_tpu.engine import bucket as bucketing
+from libskylark_tpu.engine import resultcache as _rcache
 from libskylark_tpu.engine.compiled import compiled as engine_compile
 from libskylark_tpu.engine.compiled import digest as engine_digest
 from libskylark_tpu.resilience import faults
@@ -695,7 +709,7 @@ def derive_request(endpoint: str, *,
     ``_derived=`` kwarg) so the derivation runs once per routed
     request, not once in the router and again in the executor."""
     for transport in ("timeout", "deadline", "request_id", "tenant",
-                      "qos_class"):
+                      "qos_class", "_digest"):
         kwargs.pop(transport, None)
     if endpoint == "sketch_apply":
         kwargs.setdefault("dimension", None)
@@ -743,6 +757,75 @@ def derive_request(endpoint: str, *,
                      f"expected one of {ENDPOINTS}")
 
 
+def request_digest(endpoint: str, derived: tuple, kwargs: dict) -> str:
+    """The request's content address (docs/caching, "Digest anatomy"):
+    blake2b-256 over the bucket statics plus everything else that
+    reaches the executable — the transform's raw key data (the seed;
+    same operand bytes under a different seed MUST digest differently,
+    the miscoalesce regression), any scale, the operand bytes (CSR
+    operands hash their (data, indices, indptr) parts — never
+    densified), and model/seed material per endpoint family.
+
+    ``derived`` is :func:`derive_request`'s ``(statics, info)`` and
+    ``kwargs`` the endpoint kwargs it was derived from, so the fleet
+    router — which has both in hand — computes the digest ONCE per
+    request and forwards it (``_digest=``); a standalone executor
+    derives it itself. The digest deliberately contains no object ids
+    and no transport state: two replicas handed the same request
+    bytes compute the same address, which is what makes the cache
+    deterministic across a fleet."""
+    statics, info = derived
+    kd = MicrobatchExecutor._key_data
+
+    def scale_of(t):
+        return np.float64(getattr(t, "scale", 1.0))
+
+    def csr(A, dtype):
+        data, indices, indptr = A.csr_parts(np.dtype(dtype))
+        return [("shape", repr(tuple(A.shape))), ("data", data),
+                ("indices", indices), ("indptr", indptr)]
+
+    if endpoint in ("sketch_apply", "fastfood_features"):
+        t = kwargs["transform"]
+        parts = [("kd", kd(t)), ("scale", scale_of(t)),
+                 ("A", info["A"])]
+    elif endpoint == "solve_l2_sketched":
+        t = kwargs["transform"]
+        parts = [("kd", kd(t)), ("scale", scale_of(t)),
+                 ("A", info["A"]), ("B", info["B"])]
+    elif endpoint in ("krr_predict", "rlsc_predict"):
+        # the model CONTENT is part of the address (the bucket key's
+        # id()-identity is a queueing concern — content addressing
+        # must survive a model round-trip through a new object)
+        parts = [("Xq", info["X_new"]),
+                 ("X_train", np.asarray(kwargs["X_train"])),
+                 ("coef", np.asarray(kwargs["coef"])),
+                 ("coding", repr(kwargs.get("coding")))]
+    elif endpoint in ("sparse_sketch_apply", "sparse_solve_l2_sketched"):
+        t = kwargs["transform"]
+        parts = [("kd", kd(t)), ("scale", scale_of(t))]
+        parts += csr(info["A"], info["dtype"])
+        if endpoint == "sparse_solve_l2_sketched":
+            parts.append(("B", info["B"]))
+    elif endpoint == "graph_ase":
+        parts = [("seed", repr(int(kwargs.get("seed", 0))))]
+        parts += csr(info["A"], info["dtype"])
+    elif endpoint == "graph_ppr":
+        parts = csr(info["A"], info["dtype"]) + [("s", info["s"])]
+    elif endpoint == "condest":
+        parts = [("seed", repr(int(kwargs.get("seed", 0)))),
+                 ("A", info["A"])]
+    elif endpoint == "lowrank":
+        ts, tt = kwargs["transform_s"], kwargs["transform_t"]
+        parts = [("kd_s", kd(ts)), ("scale_s", scale_of(ts)),
+                 ("kd_t", kd(tt)), ("scale_t", scale_of(tt)),
+                 ("A", info["A"])]
+    else:
+        raise ValueError(f"unknown serve endpoint {endpoint!r}; "
+                         f"expected one of {ENDPOINTS}")
+    return _rcache.operand_digest(parts, statics=statics)
+
+
 class MicrobatchExecutor:
     """Thread-safe microbatching executor over the serve endpoints.
 
@@ -784,7 +867,9 @@ class MicrobatchExecutor:
                  dispatch_queue=None,
                  kernel: Optional[str] = None,
                  tenants=None,
-                 adaptive: bool = False):
+                 adaptive: bool = False,
+                 cache: Optional[bool] = None,
+                 cache_bytes: Optional[int] = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if kernel is not None and kernel not in _KERNEL_BACKENDS:
@@ -882,6 +967,18 @@ class MicrobatchExecutor:
         # built lazily on the first session verb — one-shot serving
         # never pays the directory setup
         self._session_registry = None
+        # content-addressed result cache + single-flight dedupe
+        # (docs/caching): opt-in — the ctor argument wins, else the
+        # SKYLARK_CACHE flag. The residency table exists regardless:
+        # register_operand must pin on a cache-off replica too (the
+        # fleet broadcasts registrations to every replica, and an
+        # OperandRef must resolve wherever the request lands).
+        if cache is None:
+            cache = bool(_env.CACHE.get())
+        self._cache = (_rcache.ResultCache(name=self.name,
+                                           max_bytes=cache_bytes)
+                       if cache else None)
+        self._residency = _rcache.ResidencyTable(name=self.name)
 
         import queue as _queue
 
@@ -968,6 +1065,44 @@ class MicrobatchExecutor:
         # biggest cost; doing it twice per routed request would tax
         # every fleet submit)
         derived = kwargs.pop("_derived", None)
+        digest = kwargs.pop("_digest", None)
+        # operand residency (docs/caching): an ``A=`` that is an
+        # OperandRef resolves to the pinned bytes before derivation —
+        # the ref IS the content hash, so resolution cannot change
+        # what the request means, only skip re-shipping it
+        if _rcache.is_ref(kwargs.get("A")):
+            kwargs["A"] = self._residency.resolve(
+                _rcache.as_ref(kwargs["A"]).digest)
+        # content-addressed fast paths (docs/caching): pinned results
+        # → digest→result cache → single-flight coalescing. All three
+        # are skipped wholesale while DEGRADED: a shedding executor
+        # must never block intake on cache locks, and a degraded
+        # flush path must not populate the cache either (the settle
+        # callback re-checks). A front-door digest (``_digest=`` from
+        # a fleet router) is reused; otherwise it is derived here —
+        # at most once per request, with the derivation shared with
+        # the per-endpoint prep below.
+        flight = None
+        cache_key = None
+        if self._cache is not None and not self._is_degraded():
+            if digest is None:
+                if derived is None:
+                    derived = derive_request(
+                        endpoint, pad_floor=self.pad_floor, **kwargs)
+                digest = request_digest(endpoint, derived, kwargs)
+            cache_key = digest
+            pinned = self._residency.result(cache_key)
+            if pinned is not None:
+                self._cache.note_hit(qos_class, pinned)
+                return self._bypass_future(qos_class, pinned)
+            hit = self._cache.lookup(cache_key, qos_class)
+            if hit is not _rcache.MISS:
+                return self._bypass_future(qos_class, hit)
+            follower = self._cache.join_flight(cache_key, qos_class)
+            if follower is not None:
+                with self._lock:
+                    self._sched.note_bypass(qos_class)
+                return follower
         if rid is None and _telemetry.enabled():
             rid = _trace.new_request_id()
         # the submit span covers pack + enqueue; its context (trace id,
@@ -1020,7 +1155,25 @@ class MicrobatchExecutor:
             # can pin a fault to THIS request wherever its cohort
             # executes
             req.tags = faults.current_tags()
-            self._enqueue(key, statics, ctx, req, timeout)
+            # single-flight leadership spans the whole enqueue: the
+            # flight must be joinable BEFORE the request is queued
+            # (identical concurrent submits coalesce while the leader
+            # lingers), and a synchronous refusal — shed, drain,
+            # backpressure timeout — must fan its exception to every
+            # follower already attached (no orphaned futures)
+            if cache_key is not None:
+                flight = self._cache.lead_flight(
+                    cache_key, qos_class, req.future)
+            try:
+                self._enqueue(key, statics, ctx, req, timeout)
+            except BaseException as e:
+                if flight is not None:
+                    self._cache.abort_flight(flight, e)
+                raise
+            if flight is not None:
+                req.future.add_done_callback(
+                    lambda f, _fl=flight: self._cache.settle_flight(
+                        _fl, f, insert=not self._is_degraded()))
         return req.future
 
     def submit_sketch(self, transform, A, dimension=None, **kw) -> Future:
@@ -1268,6 +1421,81 @@ class MicrobatchExecutor:
         reg = self._session_registry
         if reg is not None:
             reg.checkpoint_all()
+
+    # -- result cache + operand residency (docs/caching) ---------------
+
+    def _bypass_future(self, cls: str, value) -> Future:
+        """A request satisfied without a dispatch (pinned result or
+        cache hit): an already-resolved future holding the shared
+        read-only value, noted in the scheduler's fairness ledger so
+        a hot cached class never LOOKS starved next to its goodput."""
+        with self._lock:
+            self._sched.note_bypass(cls)
+        f: Future = Future()
+        f.set_result(value)
+        return f
+
+    def register_operand(self, A, transform=None, dimension=None,
+                         **kw) -> "_rcache.OperandRef":
+        """Content-hash ``A``, pin it resident, and return its
+        :class:`~libskylark_tpu.engine.resultcache.OperandRef`. Later
+        submits may pass the ref as the ``A=`` operand of any dense
+        endpoint and the executor substitutes the pinned bytes — no
+        re-shipping, and (with the cache on) the request digest is
+        identical to submitting the raw bytes, so ref and raw callers
+        share one cache line. A fleet Router broadcasts registrations
+        so every replica resolves the ref locally (docs/fleet).
+
+        With ``transform=`` the operand is sketched ONCE — an
+        ordinary submit: admission, QoS and chaos all apply — and the
+        result pinned under the request digest, outside the byte
+        quotas: every later ``submit_sketch(transform, ref)`` (or the
+        same raw bytes) skips the sketch stage entirely, cache
+        evictions notwithstanding. Pins live until
+        :meth:`unregister_operand`; re-registering identical bytes is
+        a no-op (the digest IS the bytes)."""
+        A = np.asarray(A)
+        d = _rcache.operand_digest([("A", A)])
+        self._residency.pin(d, A)
+        ref = _rcache.OperandRef(d)
+        if transform is not None:
+            value = self.submit(
+                "sketch_apply", transform=transform, A=A,
+                dimension=dimension, **kw).result()
+            derived = derive_request(
+                "sketch_apply", pad_floor=self.pad_floor,
+                transform=transform, A=A, dimension=dimension)
+            rd = request_digest(
+                "sketch_apply", derived,
+                {"transform": transform, "A": A,
+                 "dimension": dimension})
+            self._residency.pin_result(rd, value, owner=d)
+        return ref
+
+    def unregister_operand(self, ref) -> bool:
+        """Unpin a registered operand — and every result pinned with
+        it. Returns whether it was resident. In-cache entries for the
+        operand's requests survive (they are ordinary quota-bounded
+        entries); only the pins go."""
+        return self._residency.unpin(_rcache.as_ref(ref).digest)
+
+    def resident_operands(self) -> list:
+        """Digests of the operands currently pinned here, sorted."""
+        return self._residency.digests()
+
+    def _cache_stats_block(self) -> Optional[dict]:
+        """The ``stats()["cache"]`` block: the cache's own counters
+        plus the residency sub-block; ``None`` on a cache-off executor
+        with nothing pinned (the common case must not grow every
+        stats dump)."""
+        res = self._residency.stats()
+        if self._cache is None:
+            if not res["resident_operands"] and not res["pinned_results"]:
+                return None
+            return {"residency": res}
+        blk = self._cache.stats()
+        blk["residency"] = res
+        return blk
 
     # -- per-endpoint packing -----------------------------------------
 
@@ -3126,6 +3354,10 @@ class MicrobatchExecutor:
             "sessions": (self._session_registry.stats()
                          if self._session_registry is not None
                          else None),
+            # the result-cache block (docs/caching): None until the
+            # cache is enabled or an operand is pinned; the "cache"
+            # telemetry collector aggregates it across executors
+            "cache": self._cache_stats_block(),
         }
 
     def shutdown(self, wait: bool = True) -> None:
@@ -3225,6 +3457,7 @@ def serve_stats() -> dict:
     sparse_sel: "collections.Counter" = collections.Counter()
     sparse_nnz: "collections.Counter" = collections.Counter()
     qos_blocks: list = []
+    cache_blocks: list = []
     by_replica: dict = {}
     lat_all: list = []
     waste_real = waste_total = 0
@@ -3247,6 +3480,7 @@ def serve_stats() -> dict:
             sparse_sel[kk] += vv["kernel_flushes"]
         sparse_nnz.update(s["sparse"]["nnz_class_hist"])
         qos_blocks.append(s["qos"])
+        cache_blocks.append(s.get("cache"))
         states[s["state"]] += 1
         if s["padding_waste_ratio"] is not None:
             with ex._stats_lock:
@@ -3276,6 +3510,7 @@ def serve_stats() -> dict:
         "nnz_class_hist": dict(sorted(sparse_nnz.items())),
     }
     agg["qos"] = _merge_qos_blocks(qos_blocks)
+    agg["cache"] = _rcache.merge_cache_blocks(cache_blocks)
     agg["states"] = dict(sorted(states.items()))
     agg["padding_waste_ratio"] = (
         round(1.0 - waste_real / waste_total, 4) if waste_total else None)
@@ -3312,3 +3547,17 @@ def qos_stats() -> dict:
 
 
 _telemetry.register_collector("qos", qos_stats)
+
+
+def cache_stats() -> dict:
+    """Cross-executor result-cache aggregate (the ``cache`` collector
+    block in ``telemetry.snapshot()``; renders as ``skylark_cache_*``
+    on the Prometheus surface — ``by_class`` becomes the class label
+    set). Aggregates the per-executor cache blocks DIRECTLY, not via
+    :func:`serve_stats` — same double-scrape rationale as
+    :func:`qos_stats`; cache-off executors contribute nothing."""
+    return _rcache.merge_cache_blocks(
+        [ex._cache_stats_block() for ex in list(_EXECUTORS)])
+
+
+_telemetry.register_collector("cache", cache_stats)

@@ -66,6 +66,8 @@ import warnings
 from concurrent.futures import Future
 from typing import Optional
 
+import numpy as np
+
 from libskylark_tpu.base import env as _env
 from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.engine.serve import ServeOverloadedError
@@ -139,6 +141,21 @@ class Replica:
         construction (the partial is a pure function of the plan), so
         the coordinator retries a failed/crashed future by simply
         re-invoking this on the next ring-preference replica."""
+        raise NotImplementedError
+
+    def register_operand(self, A, transform=None, dimension=None,
+                         **kwargs) -> Future:
+        """Operand-residency verb (docs/caching): content-hash ``A``
+        and pin it resident on this replica — precomputing and
+        pinning its sketch when ``transform`` is given — so later
+        submits can reference the operand by digest instead of
+        re-shipping (and re-sketching) it. Resolves to the operand's
+        ref string (``ref:<digest>``)."""
+        raise NotImplementedError
+
+    def unregister_operand(self, ref) -> Future:
+        """Drop a resident operand (and any sketches pinned with it);
+        resolves to whether this replica held it."""
         raise NotImplementedError
 
     def queue_depth(self) -> int:
@@ -226,6 +243,38 @@ class ThreadReplica(Replica):
 
         threading.Thread(target=_run, name=f"{self.name}-shard",
                          daemon=True).start()
+        return fut
+
+    def register_operand(self, A, transform=None, dimension=None,
+                         **kwargs) -> Future:
+        # a one-shot thread, not inline: with a transform the pin
+        # waits for the precompute flush, and the router broadcasts a
+        # registration to every replica — serial waits would make the
+        # broadcast O(replicas × flush) instead of one flush deep
+        fut: Future = Future()
+
+        def _run():
+            try:
+                fut.set_result(str(self.executor.register_operand(
+                    A, transform=transform, dimension=dimension,
+                    **kwargs)))
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — resolve
+                fut.set_exception(e)
+
+        threading.Thread(target=_run, name=f"{self.name}-register",
+                         daemon=True).start()
+        return fut
+
+    def unregister_operand(self, ref) -> Future:
+        fut: Future = Future()
+        try:
+            fut.set_result(self.executor.unregister_operand(ref))
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:  # noqa: BLE001 — resolve
+            fut.set_exception(e)
         return fut
 
     def queue_depth(self) -> int:
@@ -471,6 +520,37 @@ def _worker_main(conn, name: str, executor_kwargs: dict,
                 threading.Thread(target=_shard_reply,
                                  name=f"{name}-shard",
                                  daemon=True).start()
+            elif kind == "register":
+                # operand-residency verb (docs/caching): the operand
+                # rides the shm rings exactly like submit kwargs
+                # (pickle-pipe fallback when the transport is off).
+                # The executor's pin freezes a private COPY, so the
+                # ring slot releases as soon as the decoded view
+                # drops — a resident operand never holds shm capacity
+                kwargs = msg[2]
+                if transport is not None:
+                    try:
+                        kwargs = transport.decode(kwargs)
+                    except Exception:
+                        transport.recover(kwargs)
+                        flush_acks()
+                        raise
+
+                # one-shot thread (the session-open reasoning): with
+                # a transform the pin waits for its precompute flush,
+                # which must not stall the message loop
+                def _register_reply(rid=rid, kwargs=kwargs):
+                    try:
+                        send(("rpc", rid,
+                              str(ex.register_operand(**kwargs))))
+                    except Exception as e:  # noqa: BLE001
+                        _send_exception(send, rid, e)
+
+                threading.Thread(target=_register_reply,
+                                 name=f"{name}-register",
+                                 daemon=True).start()
+            elif kind == "unregister":
+                send(("rpc", rid, ex.unregister_operand(msg[2])))
             elif kind == "stats":
                 send(("rpc", rid, ex.stats()))
             elif kind == "env":
@@ -711,6 +791,26 @@ class ProcessReplica(Replica):
         # session operands ride the pickle pipe (see _worker_main's
         # "session" branch); the child re-validates against its spec
         return self._send("session", op, kwargs)
+
+    def register_operand(self, A, transform=None, dimension=None,
+                         **kwargs) -> Future:
+        # the operand crosses like submit kwargs: shm rings when the
+        # transport is up, pickle pipe otherwise (docs/caching)
+        kwargs = dict(kwargs, A=np.asarray(A), transform=transform,
+                      dimension=dimension)
+        if self._transport is None:
+            return self._send("register", kwargs)
+        self._flush_shm_acks()
+        payload, claimed = self._transport.encode(kwargs)
+        try:
+            return self._send("register", payload)
+        except BaseException:
+            # the header never left: the child will never ack these
+            self._transport.unclaim(claimed)
+            raise
+
+    def unregister_operand(self, ref) -> Future:
+        return self._send("unregister", str(ref))
 
     def shard(self, task: dict) -> Future:
         # shard payloads ride the pickle pipe: the task is a plan +

@@ -79,6 +79,7 @@ from libskylark_tpu import telemetry as _telemetry
 from libskylark_tpu.base import env as _env
 from libskylark_tpu.base import errors as _errors
 from libskylark_tpu.base import locks as _locks
+from libskylark_tpu.engine import resultcache as _rcache
 from libskylark_tpu.engine import serve as _serve
 from libskylark_tpu.fleet.pool import ReplicaPool
 from libskylark_tpu.fleet.ring import HashRing
@@ -231,7 +232,8 @@ class Router:
                  spill_threshold: Optional[int] = None,
                  hedge: Optional[bool] = None,
                  hedge_delay_ms: Optional[float] = None,
-                 hedge_verify: Optional[bool] = None):
+                 hedge_verify: Optional[bool] = None,
+                 cache: Optional[bool] = None):
         self._pool = pool
         self._ring = HashRing(pool.names(), vnodes=vnodes)
         self.spill_threshold = int(
@@ -251,6 +253,20 @@ class Router:
                                   else hedge_verify)
         self._hedge_lock = _locks.make_lock("fleet.hedge")
         self._hedger: Optional[_Hedger] = None
+        # front-door single-flight (docs/caching): concurrent
+        # identical submits coalesce onto ONE routed dispatch; the
+        # router also computes each request's content digest here,
+        # once, and forwards it as ``_digest`` so no replica ever
+        # re-hashes the operands. Follows the result-cache gate
+        # (``SKYLARK_CACHE``) unless pinned by the argument.
+        cache_on = bool(_env.CACHE.get() if cache is None else cache)
+        self._flights: Optional[_rcache.SingleFlight] = (
+            _rcache.SingleFlight(name="router") if cache_on else None)
+        # the router's own pin table mirrors every broadcast
+        # registration: a ref submit derives its statics (and its
+        # digest) from this local copy, while the tiny ref — not the
+        # operand bytes — is what crosses to the chosen replica
+        self._residency = _rcache.ResidencyTable(name="router")
         self._latency: "collections.deque" = collections.deque(
             maxlen=4096)
         self._hedge_delay_cache = (0.0, 0.05)   # (stamp, seconds)
@@ -465,10 +481,18 @@ class Router:
             # the label forwarded to replicas is vetted HERE
             tenant = _qos.get_registry().accounting_name(tenant)
         kwargs["tenant"] = tenant or ""
+        derive_kwargs = {k: v for k, v in kwargs.items()
+                         if k not in ("timeout",)}
+        if _rcache.is_ref(derive_kwargs.get("A")):
+            # resident-operand ref (docs/caching): statics and digest
+            # derive from the router's local pin; ``kwargs["A"]``
+            # keeps the ref — each replica resolves it against its
+            # own broadcast pin, so a process replica receives a
+            # 64-char string where the operand bytes would have been
+            derive_kwargs["A"] = self._residency.resolve(
+                _rcache.as_ref(derive_kwargs["A"]).digest)
         derived = _serve.derive_request(
-            endpoint, pad_floor=self._pool.pad_floor,
-            **{k: v for k, v in kwargs.items()
-               if k not in ("timeout",)})
+            endpoint, pad_floor=self._pool.pad_floor, **derive_kwargs)
         statics = derived[0]
         # the chosen replica reuses this derivation (one prep per
         # routed request); replicas with a different pad_floor would
@@ -477,6 +501,42 @@ class Router:
         rid = kwargs.get("request_id")
         if rid is None and _telemetry.enabled():
             rid = kwargs["request_id"] = _trace.new_request_id()
+        if self._flights is None:
+            return self._route(endpoint, kwargs, statics, rid)
+        # single-flight at the front door (docs/caching): the content
+        # digest is computed HERE, once, and forwarded (``_digest``)
+        # so the chosen replica — and its executor's result cache —
+        # reuses it without re-hashing the operands. A submit whose
+        # digest matches an in-flight leader returns a follower
+        # future without touching any replica; the leader's settle
+        # fans the one result to every follower, bit-equal.
+        digest = kwargs.get("_digest")
+        if digest is None:
+            digest = kwargs["_digest"] = _serve.request_digest(
+                endpoint, derived, kwargs)
+        cls = kwargs["qos_class"]
+        follower = self._flights.join(digest, cls)
+        if follower is not None:
+            with self._lock:
+                self._counts["coalesced"] += 1
+            return follower
+        flight = self._flights.lead(digest, cls)
+        try:
+            fut = self._route(endpoint, kwargs, statics, rid)
+        except BaseException as e:
+            # the leader never dispatched (quota refusal, empty
+            # ring): its coalesced followers fail with the same
+            # error, orphan-free
+            self._flights.abort(flight, e)
+            raise
+        fut.add_done_callback(
+            lambda f, _fl=flight: self._flights.settle(_fl, f))
+        return fut
+
+    def _route(self, endpoint: str, kwargs: dict, statics: tuple,
+               rid) -> Future:
+        """One routed dispatch (fast path, else the candidate walk) —
+        the body :meth:`submit` wraps in the single-flight tier."""
         # the route span is the request's ROOT: the executor's
         # serve.submit span opens inside it (same thread) and parents
         # under it with the same request id — docs/observability
@@ -498,7 +558,8 @@ class Router:
                     owner_depth = None
                 if (owner_depth is not None
                         and (owner_depth < self.spill_threshold
-                             or qos_class == _qos.BEST_EFFORT)):
+                             or kwargs["qos_class"]
+                             == _qos.BEST_EFFORT)):
                     try:
                         faults.check("fleet.route", tags=tags,
                                      detail=f"{endpoint} -> {owner}")
@@ -1032,6 +1093,49 @@ class Router:
         except NoHealthyReplicaError:
             return None
 
+    # -- operand residency (docs/caching) ------------------------------
+
+    def register_operand(self, A, transform=None, dimension=None,
+                         **kwargs) -> "_rcache.OperandRef":
+        """Pin one operand resident on EVERY replica in the pool: the
+        operand is content-hashed and broadcast, each replica pinning
+        the same bytes under the same digest (and precomputing the
+        transform's sketch when one is given) — so a later
+        ``submit(..., A=ref)`` routed *anywhere* in the fleet skips
+        the operand upload, and with a transform the sketch stage
+        itself. Blocking by design: registration is a rare
+        control-plane call, and returning only after every replica
+        pinned means the ref is immediately valid fleet-wide.
+        Replicas added later (autoscale-up) do not inherit pins —
+        re-register after scaling when residency matters."""
+        A = np.asarray(A)
+        futs = [(r.name, r.register_operand(
+                    A, transform=transform, dimension=dimension,
+                    **kwargs))
+                for r in self._pool.replicas()]
+        if not futs:
+            raise NoHealthyReplicaError(
+                "register_operand on an empty pool")
+        refs = {name: str(f.result()) for name, f in futs}
+        if len(set(refs.values())) != 1:
+            # content digests are transport-independent by
+            # construction; a disagreement means replica divergence
+            raise RuntimeError(
+                f"replicas disagree on operand digest: {refs}")
+        digest = next(iter(refs.values()))
+        # the local mirror the ref-submit derivation resolves against
+        self._residency.pin(digest, A)
+        return _rcache.OperandRef(digest)
+
+    def unregister_operand(self, ref) -> int:
+        """Drop a resident operand from every replica (its pinned
+        sketches go with it); returns how many replicas held it."""
+        ref = str(_rcache.as_ref(ref).digest)
+        futs = [r.unregister_operand(ref)
+                for r in self._pool.replicas()]
+        self._residency.unpin(ref)
+        return sum(1 for f in futs if f.result())
+
     # -- introspection -------------------------------------------------
 
     def owner_of(self, endpoint: str, **kwargs) -> Optional[str]:
@@ -1068,6 +1172,9 @@ class Router:
             "hedge_wins": c.get("hedge_wins", 0),
             "hedge_mismatches": c.get("hedge_mismatches", 0),
             "rate_limited": c.get("rate_limited", 0),
+            "coalesced": c.get("coalesced", 0),
+            "single_flight": (self._flights.stats()
+                              if self._flights is not None else None),
             "session_handoffs": c.get("session_handoffs", 0),
             "sessions_assigned": len(self._sessions),
             "session_epoch": self._epoch,
@@ -1104,7 +1211,7 @@ def fleet_stats() -> dict:
     agg = collections.Counter(routed=0, affinity_hit=0, failover=0,
                               spilled=0, hedged=0, hedge_wins=0,
                               hedge_mismatches=0, rate_limited=0,
-                              session_handoffs=0)
+                              coalesced=0, session_handoffs=0)
     by_replica = collections.Counter()
     routers = 0
     for router in list(_ROUTERS):
@@ -1112,7 +1219,7 @@ def fleet_stats() -> dict:
         routers += 1
         for k in ("routed", "affinity_hit", "failover", "spilled",
                   "hedged", "hedge_wins", "hedge_mismatches",
-                  "rate_limited", "session_handoffs"):
+                  "rate_limited", "coalesced", "session_handoffs"):
             agg[k] += s[k]
         by_replica.update(s["by_replica"])
     out = dict(agg)

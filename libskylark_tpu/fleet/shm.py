@@ -29,6 +29,13 @@ through ``multiprocessing.shared_memory`` instead:
   choice can never change a result — the fallback path is the r11 wire
   format, bit for bit.
 
+The operand-residency broadcast (``Router.register_operand``,
+docs/caching) rides the same rings: a registered operand crosses to
+each process replica exactly like submit kwargs, and because the
+child's pin freezes a private copy, the ring slot releases as soon as
+the decoded view drops — a resident operand never holds transport
+capacity, so residency cannot leak ``/dev/shm`` entries either.
+
 **Segment lifecycle (the no-leak contract).** The parent creates both
 segments; the child attaches them at entry; once the parent's boot
 liveness probe confirms the attach, the parent *immediately unlinks*

@@ -56,6 +56,12 @@ class DeficitScheduler:
         self._deficit: Dict[str, float] = {c: 0.0 for c in
                                            _tenants.CLASSES}
         self.served: Dict[str, int] = {c: 0 for c in _tenants.CLASSES}
+        # requests satisfied WITHOUT a dispatch (result-cache hits and
+        # single-flight followers, docs/caching): they never consume a
+        # flush slot, so they must not spend deficit — but the
+        # fairness ledger has to show them or a hot cached class would
+        # look starved next to its actual goodput
+        self.bypassed: Dict[str, int] = {c: 0 for c in _tenants.CLASSES}
 
     # -- the decision procedure ---------------------------------------
 
@@ -102,6 +108,16 @@ class DeficitScheduler:
         self._deficit[cls] = max(0.0, self._deficit[cls] - int(n))
         self.served[cls] = self.served.get(cls, 0) + int(n)
 
+    def note_bypass(self, cls: str, n: int = 1) -> None:
+        """Account ``n`` requests of ``cls`` satisfied without a
+        dispatch (a result-cache hit or a coalesced single-flight
+        follower): counted in the fairness ledger, charged to no
+        deficit — a bypassed request consumed no flush slot, so
+        spending credit for it would under-serve the class's actual
+        queue (docs/caching)."""
+        cls = _tenants.coerce_class(cls)
+        self.bypassed[cls] = self.bypassed.get(cls, 0) + int(n)
+
     # -- introspection -------------------------------------------------
 
     def stats(self) -> dict:
@@ -110,6 +126,7 @@ class DeficitScheduler:
             "deficit": {c: round(self._deficit[c], 3)
                         for c in _tenants.CLASSES},
             "served": dict(self.served),
+            "bypassed": dict(self.bypassed),
         }
 
 

@@ -81,6 +81,23 @@ def shed_fraction(cls: str) -> float:
     return float(_env.QOS_SHED_STANDARD.get())
 
 
+def cache_quota_fraction(cls: str) -> float:
+    """The class's share of the result-cache byte budget
+    (``SKYLARK_CACHE_QUOTA_*``; docs/caching, "Tenant admission").
+    Quotas are hard partitions — insertion into one class evicts only
+    that class's own entries — so the fractions ARE the isolation
+    contract: a best_effort storm can fill at most its own share and
+    never displaces an interactive working set. Values clamp to
+    [0, 1]; a non-positive fraction disables caching for the class."""
+    if cls == INTERACTIVE:
+        f = _env.CACHE_QUOTA_INTERACTIVE.get()
+    elif cls == BEST_EFFORT:
+        f = _env.CACHE_QUOTA_BEST_EFFORT.get()
+    else:
+        f = _env.CACHE_QUOTA_STANDARD.get()
+    return min(max(float(f), 0.0), 1.0)
+
+
 def slo_seconds(cls: str) -> float:
     """The class's p99 latency SLO in seconds (env-tunable)."""
     if cls == INTERACTIVE:
@@ -301,6 +318,7 @@ def get_registry() -> TenantRegistry:
 __all__ = [
     "BEST_EFFORT", "CLASSES", "ClassPolicy", "DEFAULT_WEIGHTS",
     "INTERACTIVE", "PRESSURE_FRACTIONS", "STANDARD", "Tenant",
-    "TenantRegistry", "TokenBucket", "class_policy", "coerce_class",
-    "default_class", "get_registry", "shed_fraction", "slo_seconds",
+    "TenantRegistry", "TokenBucket", "cache_quota_fraction",
+    "class_policy", "coerce_class", "default_class", "get_registry",
+    "shed_fraction", "slo_seconds",
 ]

@@ -76,6 +76,14 @@ METRICS: Dict[str, str] = {
     "qos.request_latency": "histogram",
     "qos.linger_target": "gauge",
     "qos.batch_target": "gauge",
+    # content-addressed result cache (engine/resultcache.py,
+    # docs/caching) — rendered as skylark_cache_* on Prometheus
+    "cache.hits": "counter",
+    "cache.misses": "counter",
+    "cache.bytes_saved": "counter",
+    "cache.evicted": "counter",
+    "cache.single_flight_coalesced": "counter",
+    "cache.resident_operands": "gauge",
     # fleet (fleet/router.py)
     "fleet.session_handoffs": "counter",
     "fleet.routed": "counter",

@@ -1,0 +1,690 @@
+"""Content-addressed result cache, single-flight dedupe, operand
+residency (libskylark_tpu/engine/resultcache.py, docs/caching).
+
+Oracles:
+
+- *digest stability*: a request's content address depends only on the
+  operand bytes + key material + statics — identical bytes digest
+  identically whether they arrive as a fresh array, a strided view, a
+  SharedMemory-backed zero-copy view (the r15 SHM intake shape), or a
+  re-constructed CSR operand; different dtype/shape/seed always digest
+  differently (the header frames them);
+- *single-flight*: a storm of identical concurrent submits runs ONE
+  flush — one miss, N-1 coalesced followers, every future resolving
+  bit-equal to the cold capacity-1 dispatch;
+- *miscoalesce regression*: the same operand bytes under a different
+  Context seed are a DIFFERENT request — distinct digests, no
+  coalescing, distinct results;
+- *tenant quotas*: eviction is strict FIFO within the inserting class,
+  one class can never evict another's working set, two caches fed the
+  same history hold identical entries, and an oversize value is
+  refused without thrashing;
+- *hit bit-equality*: for every cached endpoint family the warm hit
+  returns the bit-identical value of the cold compute;
+- *chaos*: a tag-pinned serve.flush fault on a coalesced storm fails
+  every waiter with the leader's exception — no orphaned futures, no
+  poisoned cache entry — and the cache.* lock sites stay acyclic
+  under the runtime witness.
+"""
+
+from __future__ import annotations
+
+import pickle
+from multiprocessing import shared_memory
+
+import numpy as np
+import pytest
+
+import scipy.sparse as sp
+
+from libskylark_tpu import Context, engine, fleet, ml
+from libskylark_tpu import sketch as sk
+from libskylark_tpu.base import errors as sk_errors
+from libskylark_tpu.base import locks as sk_locks
+from libskylark_tpu.base.sparse import SparseMatrix
+from libskylark_tpu.engine import resultcache as rc
+from libskylark_tpu.engine.serve import derive_request, request_digest
+from libskylark_tpu.resilience import faults
+
+
+@pytest.fixture()
+def fresh_engine():
+    engine.reset()
+    yield
+    engine.reset()
+
+
+def _executor(**kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("linger_us", 1000)
+    kw.setdefault("cache", True)
+    return engine.MicrobatchExecutor(**kw)
+
+
+def _sketch_req(seed=0, n=64, s_dim=16, m=8):
+    rng = np.random.default_rng(seed)
+    T = sk.JLT(n, s_dim, Context(seed=seed))
+    A = rng.standard_normal((n, m)).astype(np.float32)
+    return T, A
+
+
+def _sketch_digest(T, A, dimension=None):
+    derived = derive_request("sketch_apply", transform=T, A=A,
+                             dimension=dimension)
+    return request_digest("sketch_apply", derived,
+                          {"transform": T, "A": A,
+                           "dimension": dimension})
+
+
+def _wait_entries(ex, n, timeout=30.0):
+    """Barrier on the cache's entry count: the settle callback inserts
+    from the flush worker AFTER the leader's future resolves, so a
+    submit issued immediately after ``.result()`` could race the
+    insert into a spurious miss."""
+    import time
+    deadline = time.monotonic() + timeout
+    while (ex.stats()["cache"]["entries"] < n
+           and time.monotonic() < deadline):
+        time.sleep(0.001)
+    assert ex.stats()["cache"]["entries"] >= n
+
+
+def _bits_equal(a, b):
+    if isinstance(a, (tuple, list)):
+        return len(a) == len(b) and all(
+            _bits_equal(x, y) for x, y in zip(a, b))
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# digest stability across intake shapes
+# ---------------------------------------------------------------------------
+
+
+class TestDigestStability:
+    def test_strided_view_digests_like_contiguous(self):
+        """A non-contiguous view with the same logical bytes computes
+        the same address — the digest covers content, not layout."""
+        rng = np.random.default_rng(0)
+        A = rng.standard_normal((32, 16)).astype(np.float32)
+        big = np.zeros((32, 32), np.float32)
+        big[:, :16] = A
+        view = big[:, :16]
+        assert not view.flags.c_contiguous
+        assert (rc.operand_digest([("A", view)])
+                == rc.operand_digest([("A", A)]))
+        # fortran-order copy: same logical content, same address
+        assert (rc.operand_digest([("A", np.asfortranarray(A))])
+                == rc.operand_digest([("A", A)]))
+
+    def test_shm_view_digests_like_inline(self):
+        """The read-only zero-copy ndarray the SHM transport hands the
+        intake thread digests identically to the original host array
+        — no staging copy is ever needed to address a request."""
+        rng = np.random.default_rng(1)
+        A = rng.standard_normal((48, 8)).astype(np.float32)
+        seg = shared_memory.SharedMemory(create=True, size=A.nbytes)
+        try:
+            view = np.ndarray(A.shape, A.dtype, buffer=seg.buf)
+            view[...] = A
+            view.setflags(write=False)
+            assert (rc.operand_digest([("A", view)])
+                    == rc.operand_digest([("A", A)]))
+            del view
+        finally:
+            seg.close()
+            seg.unlink()
+
+    def test_dtype_and_shape_are_framed(self):
+        """Same raw buffer under a different dtype or shape is a
+        different address (the per-array header)."""
+        A = np.arange(64, dtype=np.float32)
+        base = rc.operand_digest([("A", A)])
+        assert rc.operand_digest([("A", A.view(np.int32))]) != base
+        assert rc.operand_digest([("A", A.reshape(8, 8))]) != base
+        assert rc.operand_digest([("B", A)]) != base
+        assert rc.operand_digest([("A", A)], statics=("x",)) != base
+
+    def test_csr_reconstruction_digests_identically(self, fresh_engine):
+        """Two independently constructed CSR operands with the same
+        (data, indices, indptr) content share one address; perturbing
+        one stored value changes it."""
+        rng = np.random.default_rng(2)
+        r = rng.integers(0, 64, 40)
+        c = rng.integers(0, 16, 40)
+        v = rng.standard_normal(40).astype(np.float32)
+        T = sk.CWT(64, 16, Context(seed=3))
+
+        def digest_of(vals):
+            A = SparseMatrix.from_scipy(
+                sp.coo_matrix((vals, (r, c)), shape=(64, 16)))
+            derived = derive_request("sparse_sketch_apply",
+                                     transform=T, A=A,
+                                     dimension=sk.COLUMNWISE)
+            return request_digest(
+                "sparse_sketch_apply", derived,
+                {"transform": T, "A": A, "dimension": sk.COLUMNWISE})
+
+        assert digest_of(v) == digest_of(v.copy())
+        v2 = v.copy()
+        v2[0] += 1.0
+        assert digest_of(v2) != digest_of(v)
+
+    def test_digest_survives_object_roundtrip(self):
+        """No object ids leak into the address: a transform rebuilt
+        from the same Context seed — the process-replica unpickle
+        shape — addresses identically, which is what makes the cache
+        deterministic across a fleet."""
+        _, A = _sketch_req(seed=5)
+        T1 = sk.JLT(64, 16, Context(seed=5))
+        T2 = sk.JLT(64, 16, Context(seed=5))
+        assert T1 is not T2
+        assert _sketch_digest(T1, A) == _sketch_digest(T2, A.copy())
+
+    def test_operand_ref_roundtrip(self):
+        d = rc.operand_digest([("A", np.ones(4, np.float32))])
+        ref = rc.OperandRef(d)
+        assert ref.digest == d
+        assert rc.is_ref(ref)
+        assert rc.is_ref("ref:" + d)
+        assert not rc.is_ref(d)            # bare strings are operands
+        assert rc.as_ref("ref:" + d).digest == d
+        back = pickle.loads(pickle.dumps(ref))
+        assert str(back) == d
+
+
+# ---------------------------------------------------------------------------
+# single-flight: one flush per unique request
+# ---------------------------------------------------------------------------
+
+
+class TestSingleFlightStorm:
+    def test_storm_one_flush_bit_equal(self, fresh_engine):
+        """N identical submits while the leader lingers: one miss, one
+        flush, N-1 coalesced followers, every result bit-equal to the
+        cold capacity-1 dispatch."""
+        T, A = _sketch_req(seed=7)
+        ex = _executor(max_batch=8, linger_us=500_000)
+        try:
+            futs = [ex.submit_sketch(T, A, dimension=sk.COLUMNWISE)
+                    for _ in range(8)]
+            ex.flush()
+            outs = [np.asarray(f.result(timeout=60)) for f in futs]
+            st = ex.stats()
+            assert st["flushes"] == 1
+            cb = st["cache"]
+            assert cb["misses"] == 1
+            assert cb["single_flight_coalesced"] == 7
+            assert cb["hits"] == 0
+        finally:
+            ex.shutdown()
+        ex1 = engine.MicrobatchExecutor(max_batch=1, linger_us=100,
+                                        cache=False)
+        ref = np.asarray(ex1.submit_sketch(
+            T, A, dimension=sk.COLUMNWISE).result(timeout=60))
+        ex1.shutdown()
+        for o in outs:
+            assert np.array_equal(o, ref)
+
+    def test_follower_values_are_read_only(self, fresh_engine):
+        """The fan-out shares ONE frozen array: followers cannot
+        poison the cache (or each other) through their result."""
+        T, A = _sketch_req(seed=8)
+        ex = _executor(max_batch=4, linger_us=500_000)
+        try:
+            futs = [ex.submit_sketch(T, A) for _ in range(3)]
+            ex.flush()
+            follower = np.asarray(futs[1].result(timeout=60))
+            assert not follower.flags.writeable
+            with pytest.raises((ValueError, RuntimeError)):
+                follower[0, 0] = 0.0
+        finally:
+            ex.shutdown()
+
+    def test_settled_request_becomes_cache_hit(self, fresh_engine):
+        """After the storm settles, the same request is a cache hit —
+        no second flush, bit-equal value, hit counted."""
+        T, A = _sketch_req(seed=9)
+        ex = _executor(max_batch=4, linger_us=1000)
+        try:
+            cold = np.asarray(
+                ex.submit_sketch(T, A).result(timeout=60))
+            _wait_entries(ex, 1)
+            warm = np.asarray(
+                ex.submit_sketch(T, A).result(timeout=60))
+            assert np.array_equal(cold, warm)
+            st = ex.stats()
+            assert st["flushes"] == 1
+            cb = st["cache"]
+            assert cb["hits"] == 1 and cb["misses"] == 1
+            assert cb["bytes_saved"] >= warm.nbytes
+            assert cb["hit_rate"] == 0.5
+        finally:
+            ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# miscoalesce regression: same bytes, different key material
+# ---------------------------------------------------------------------------
+
+
+class TestMiscoalesceRegression:
+    def test_seed_changes_digest(self):
+        _, A = _sketch_req(seed=0)
+        T1 = sk.JLT(64, 16, Context(seed=1))
+        T2 = sk.JLT(64, 16, Context(seed=2))
+        assert _sketch_digest(T1, A) != _sketch_digest(T2, A)
+
+    def test_dtype_changes_digest(self):
+        T, A = _sketch_req(seed=0)
+        assert (_sketch_digest(T, A)
+                != _sketch_digest(T, A.astype(np.float64)))
+
+    def test_concurrent_different_seeds_do_not_coalesce(
+            self, fresh_engine):
+        """Same operand bytes under two seeds submitted while both
+        linger: two misses, zero coalesced, distinct results — one
+        seed's result must never fan to the other's caller."""
+        _, A = _sketch_req(seed=0)
+        T1 = sk.JLT(64, 16, Context(seed=1))
+        T2 = sk.JLT(64, 16, Context(seed=2))
+        ex = _executor(max_batch=8, linger_us=500_000)
+        try:
+            f1 = ex.submit_sketch(T1, A)
+            f2 = ex.submit_sketch(T2, A)
+            ex.flush()
+            r1 = np.asarray(f1.result(timeout=60))
+            r2 = np.asarray(f2.result(timeout=60))
+            assert not np.array_equal(r1, r2)
+            cb = ex.stats()["cache"]
+            assert cb["misses"] == 2
+            assert cb["single_flight_coalesced"] == 0
+        finally:
+            ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tenant quotas: FIFO within a class, isolation across classes
+# ---------------------------------------------------------------------------
+
+
+def _val(i, floats=256):
+    v = np.full(floats, float(i), np.float32)   # floats*4 bytes
+    v.setflags(write=False)
+    return v
+
+
+def _quota_cache(max_bytes=4096):
+    return rc.ResultCache(
+        name="t", max_bytes=max_bytes,
+        quota_fractions={"interactive": 0.5, "standard": 0.35,
+                         "best_effort": 0.15})
+
+
+class TestTenantQuotas:
+    def test_fifo_eviction_within_class(self):
+        """interactive budget 2048B holds two 1024B entries; the third
+        insert evicts the OLDEST (strict insertion order, no recency
+        reordering)."""
+        c = _quota_cache()
+        for i in range(3):
+            assert c.put(f"k{i}", "interactive", _val(i))
+        assert c.lookup("k0", "interactive") is rc.MISS
+        assert np.array_equal(c.lookup("k1", "interactive"), _val(1))
+        assert np.array_equal(c.lookup("k2", "interactive"), _val(2))
+        blk = c.stats()["by_class"]["interactive"]
+        assert blk["evicted"] == 1
+        assert blk["entries"] == 2
+        assert blk["bytes"] == 2048
+
+    def test_best_effort_cannot_evict_interactive(self):
+        """Quotas are hard partitions: a best_effort storm churns only
+        its own 614B slice; the interactive working set survives."""
+        c = _quota_cache()
+        c.put("hot0", "interactive", _val(0))
+        c.put("hot1", "interactive", _val(1))
+        for i in range(8):
+            c.put(f"be{i}", "best_effort", _val(i, floats=128))
+        assert np.array_equal(c.lookup("hot0", "interactive"), _val(0))
+        assert np.array_equal(c.lookup("hot1", "interactive"), _val(1))
+        blk = c.stats()["by_class"]
+        assert blk["interactive"]["evicted"] == 0
+        assert blk["best_effort"]["evicted"] == 7
+        assert blk["best_effort"]["entries"] == 1
+
+    def test_eviction_is_deterministic_across_instances(self):
+        """Two caches fed the same insert history retain the same
+        entries — the property that keeps replica caches bit-identical
+        and affinity misses cheap."""
+        hist = [(f"k{i}", cls, i) for i, cls in enumerate(
+            ["interactive", "best_effort", "standard", "interactive",
+             "interactive", "standard", "best_effort", "interactive",
+             "standard", "interactive"])]
+        caches = [_quota_cache(), _quota_cache()]
+        for cache in caches:
+            for key, cls, i in hist:
+                cache.put(key, cls, _val(i))
+        for key, cls, i in hist:
+            a = caches[0].lookup(key, cls)
+            b = caches[1].lookup(key, cls)
+            if a is rc.MISS:
+                assert b is rc.MISS
+            else:
+                assert np.array_equal(a, b)
+        sa, sb = caches[0].stats(), caches[1].stats()
+        assert sa["by_class"] == sb["by_class"]
+
+    def test_oversize_value_is_refused_not_thrashed(self):
+        """A value larger than the whole class budget is refused (and
+        counted uncacheable) WITHOUT evicting the resident entries."""
+        c = _quota_cache()
+        c.put("keep", "interactive", _val(0))
+        assert not c.put("huge", "interactive", _val(1, floats=1024))
+        assert np.array_equal(c.lookup("keep", "interactive"), _val(0))
+        blk = c.stats()["by_class"]["interactive"]
+        assert blk["uncacheable"] == 1
+        assert blk["evicted"] == 0
+
+    def test_lookup_reads_across_classes(self):
+        """Retention is per-class; reads are free sharing — a result
+        inserted by best_effort serves an interactive hit."""
+        c = _quota_cache()
+        c.put("shared", "best_effort", _val(3, floats=128))
+        assert np.array_equal(c.lookup("shared", "interactive"),
+                              _val(3, floats=128))
+        assert c.stats()["by_class"]["interactive"]["hits"] == 1
+
+    def test_invalidate_and_clear(self):
+        c = _quota_cache()
+        c.put("a", "standard", _val(1))
+        assert c.invalidate("a")
+        assert not c.invalidate("a")
+        assert c.lookup("a", "standard") is rc.MISS
+        c.put("b", "standard", _val(2))
+        c.clear()
+        assert c.lookup("b", "standard") is rc.MISS
+        assert c.stats()["bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# cache-hit bit-equality per endpoint family
+# ---------------------------------------------------------------------------
+
+
+def _endpoint_builders():
+    rng = np.random.default_rng(11)
+    ctx = Context(seed=11)
+    out = {}
+
+    T, A = sk.JLT(64, 16, ctx), rng.standard_normal(
+        (64, 6)).astype(np.float32)
+    out["sketch"] = lambda ex: ex.submit_sketch(
+        T, A, dimension=sk.COLUMNWISE)
+
+    Ts = sk.CWT(64, 32, ctx)
+    As = rng.standard_normal((64, 5)).astype(np.float32)
+    Bs = rng.standard_normal((64, 2)).astype(np.float32)
+    out["solve"] = lambda ex: ex.submit_solve(As, Bs, transform=Ts)
+
+    r = rng.integers(0, 64, 50)
+    cc = rng.integers(0, 16, 50)
+    v = rng.standard_normal(50).astype(np.float32)
+    Asp = SparseMatrix.from_scipy(
+        sp.coo_matrix((v, (r, cc)), shape=(64, 16)))
+    Tsp = sk.CWT(64, 16, ctx)
+    out["sparse"] = lambda ex: ex.submit_sparse(
+        Tsp, Asp, dimension=sk.COLUMNWISE)
+
+    M = rng.standard_normal((24, 24)).astype(np.float32)
+    out["condest"] = lambda ex: ex.submit_condest(M, steps=4, seed=2)
+
+    X = rng.standard_normal((32, 5)).astype(np.float32)
+    Y = rng.standard_normal((32, 1)).astype(np.float32)
+    k = ml.Gaussian(5, sigma=2.0)
+    coef = ml.kernel_ridge(k, X, Y, 0.1)
+    q = rng.standard_normal((3, 5)).astype(np.float32)
+    out["krr"] = lambda ex: ex.submit_krr_predict(k, q, X, coef)
+    return out
+
+
+class TestHitBitEquality:
+    @pytest.mark.parametrize("family", ["sketch", "solve", "sparse",
+                                        "condest", "krr"])
+    def test_warm_hit_is_bit_equal_to_cold(self, fresh_engine, family):
+        """Per endpoint family: the second identical submit is a hit
+        (one flush total) and its value is bit-identical both to the
+        first compute and to a cache-off cold executor."""
+        build = _endpoint_builders()[family]
+        ex = _executor(max_batch=4, linger_us=1000)
+        try:
+            cold = build(ex).result(timeout=120)
+            _wait_entries(ex, 1)
+            warm = build(ex).result(timeout=120)
+            cb = ex.stats()["cache"]
+            assert cb["hits"] == 1 and cb["misses"] == 1
+        finally:
+            ex.shutdown()
+        ex0 = engine.MicrobatchExecutor(max_batch=1, linger_us=100,
+                                        cache=False)
+        try:
+            ref = build(ex0).result(timeout=120)
+        finally:
+            ex0.shutdown()
+        assert _bits_equal(warm, cold)
+        assert _bits_equal(warm, ref)
+
+
+# ---------------------------------------------------------------------------
+# operand residency
+# ---------------------------------------------------------------------------
+
+
+class TestResidency:
+    def test_register_ref_submit_bit_equal(self, fresh_engine):
+        """A ref submit resolves the pinned bytes: bit-equal to the
+        raw-bytes submit, one shared cache line for both."""
+        T, A = _sketch_req(seed=13)
+        ex = _executor(max_batch=4)
+        try:
+            raw = np.asarray(ex.submit_sketch(T, A).result(timeout=60))
+            _wait_entries(ex, 1)
+            ref = ex.register_operand(A)
+            assert str(ref) in ex.resident_operands()
+            via = np.asarray(
+                ex.submit_sketch(T, ref).result(timeout=60))
+            assert np.array_equal(via, raw)
+            # raw and ref submits share one digest -> second was a hit
+            assert ex.stats()["cache"]["hits"] == 1
+            assert ex.unregister_operand(ref)
+            assert not ex.unregister_operand(ref)
+            with pytest.raises(KeyError, match="no resident operand"):
+                ex.submit_sketch(T, ref)
+        finally:
+            ex.shutdown()
+
+    def test_transform_registration_skips_sketch_stage(
+            self, fresh_engine):
+        """register_operand(transform=) sketches once and pins the
+        result under the request digest: the later matching submit is
+        served from the pin — zero additional flushes — and survives
+        a cache clear (pins live outside the byte quotas)."""
+        T, A = _sketch_req(seed=14)
+        ex = _executor(max_batch=4)
+        try:
+            ref = ex.register_operand(A, transform=T,
+                                      dimension=sk.COLUMNWISE)
+            flushes = ex.stats()["flushes"]
+            assert flushes == 1
+            ex._cache.clear()
+            out = np.asarray(ex.submit_sketch(
+                T, ref, dimension=sk.COLUMNWISE).result(timeout=60))
+            assert ex.stats()["flushes"] == flushes
+        finally:
+            ex.shutdown()
+        ex0 = engine.MicrobatchExecutor(max_batch=1, linger_us=100,
+                                        cache=False)
+        try:
+            want = np.asarray(ex0.submit_sketch(
+                T, A, dimension=sk.COLUMNWISE).result(timeout=60))
+        finally:
+            ex0.shutdown()
+        assert np.array_equal(out, want)
+
+    def test_pin_conflicting_bytes_refused(self):
+        t = rc.ResidencyTable(name="unit")
+        A = np.ones((4, 4), np.float32)
+        d = t.pin("d0", A)
+        assert d == "d0"
+        t.pin("d0", A.copy())              # identical bytes: no-op
+        with pytest.raises(ValueError, match="different bytes"):
+            t.pin("d0", np.zeros((4, 4), np.float32))
+        assert t.unpin("d0")
+        t.pin("d0", np.zeros((4, 4), np.float32), )
+
+    def test_unpin_drops_owned_results(self):
+        t = rc.ResidencyTable(name="unit")
+        t.pin("op", np.ones(4, np.float32))
+        t.pin_result("req1", np.full(2, 7.0), owner="op")
+        assert np.array_equal(t.result("req1"), np.full(2, 7.0))
+        t.unpin("op")
+        assert t.result("req1") is None
+        assert t.stats() == {"resident_operands": 0,
+                             "pinned_results": 0, "resident_bytes": 0}
+
+
+# ---------------------------------------------------------------------------
+# fleet front door: router single-flight + broadcast residency
+# ---------------------------------------------------------------------------
+
+
+class TestFleetFrontDoor:
+    def test_router_storm_coalesces_and_fans_bit_equal(
+            self, fresh_engine):
+        T, A = _sketch_req(seed=17)
+        pool = fleet.ReplicaPool(2, max_batch=8, linger_us=50_000)
+        router = fleet.Router(pool, cache=True)
+        try:
+            futs = [router.submit("sketch_apply", transform=T, A=A)
+                    for _ in range(10)]
+            outs = [np.asarray(f.result(timeout=60)) for f in futs]
+            for o in outs[1:]:
+                assert np.array_equal(o, outs[0])
+            s = router.stats()
+            assert s["coalesced"] >= 1
+            assert s["coalesced"] + s["routed"] == 10
+            sf = s["single_flight"]
+            assert sf["coalesced"] == s["coalesced"]
+            assert sf["in_flight"] == 0
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_router_does_not_coalesce_across_seeds(self, fresh_engine):
+        _, A = _sketch_req(seed=0)
+        T1 = sk.JLT(64, 16, Context(seed=1))
+        T2 = sk.JLT(64, 16, Context(seed=2))
+        pool = fleet.ReplicaPool(1, max_batch=8, linger_us=200_000)
+        router = fleet.Router(pool, cache=True)
+        try:
+            f1 = router.submit("sketch_apply", transform=T1, A=A)
+            f2 = router.submit("sketch_apply", transform=T2, A=A)
+            pool.get(pool.names()[0]).executor.flush()
+            r1 = np.asarray(f1.result(timeout=60))
+            r2 = np.asarray(f2.result(timeout=60))
+            assert not np.array_equal(r1, r2)
+            assert router.stats()["coalesced"] == 0
+        finally:
+            router.close()
+            pool.shutdown()
+
+    def test_register_broadcasts_to_every_replica(self, fresh_engine):
+        """router.register_operand pins on every replica (their
+        digests must agree) so a ref submit resolves wherever affinity
+        routes it; unregister drops all pins."""
+        T, A = _sketch_req(seed=19)
+        pool = fleet.ReplicaPool(2, max_batch=8)
+        router = fleet.Router(pool, cache=True)
+        try:
+            base = np.asarray(router.submit(
+                "sketch_apply", transform=T, A=A).result(timeout=60))
+            ref = router.register_operand(A)
+            for name in pool.names():
+                assert str(ref) in (pool.get(name).executor
+                                    .resident_operands())
+            via = np.asarray(router.submit(
+                "sketch_apply", transform=T, A=ref).result(timeout=60))
+            assert np.array_equal(via, base)
+            assert router.unregister_operand(ref) == 2
+            for name in pool.names():
+                assert not (pool.get(name).executor
+                            .resident_operands())
+        finally:
+            router.close()
+            pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos: a poisoned leader fails every coalesced waiter, orphan-free
+# ---------------------------------------------------------------------------
+
+
+class TestChaos:
+    def test_poisoned_flight_fans_exception_no_orphans(
+            self, fresh_engine):
+        """A tag-pinned serve.flush fault poisons the storm's ONE
+        flush: the leader and every coalesced follower fail with the
+        SAME exception, no future is left pending, nothing enters the
+        cache, no flight is left open — and the cache.* lock sites
+        recorded by the runtime witness stay acyclic."""
+        sk_locks.reset_witness()
+        sk_locks.enable_witness(True)
+        try:
+            T, A = _sketch_req(seed=21)
+            plan = {"seed": 7, "faults": [
+                {"site": "serve.flush", "error": "SketchError",
+                 "tag": "poison"}]}
+            ex = _executor(max_batch=8, linger_us=500_000)
+            try:
+                with faults.fault_plan(plan):
+                    with faults.tag("poison"):
+                        futs = [ex.submit_sketch(T, A)
+                                for _ in range(6)]
+                    ex.flush()
+                    excs = [f.exception(timeout=60) for f in futs]
+                assert all(f.done() for f in futs)
+                assert all(isinstance(e, sk_errors.SketchError)
+                           for e in excs)
+                # one flush, one failure, fanned identically: every
+                # follower carries the leader's exception object
+                assert len({id(e) for e in excs}) == 1
+                cb = ex.stats()["cache"]
+                assert cb["misses"] == 1
+                assert cb["single_flight_coalesced"] == 5
+                assert cb["entries"] == 0      # failure never cached
+                assert cb["in_flight"] == 0    # flight detached
+                # the poisoned digest recovers: a clean resubmit leads
+                # a fresh flight and computes
+                good = np.asarray(
+                    ex.submit_sketch(T, A).result(timeout=60))
+                assert good.size
+            finally:
+                ex.shutdown()
+            sk_locks.check_witness()           # cache.* sites acyclic
+        finally:
+            sk_locks.enable_witness(False)
+            sk_locks.reset_witness()
+
+    def test_aborted_dispatch_fails_followers(self):
+        """abort_flight: a leader whose submit raised synchronously
+        fails its already-attached followers with that exception."""
+        c = rc.ResultCache(name="unit", max_bytes=1 << 20)
+        from concurrent.futures import Future
+        lead = Future()
+        fl = c.lead_flight("k", "standard", lead)
+        follower = c.join_flight("k", "standard")
+        assert follower is not None
+        boom = RuntimeError("shed")
+        c.abort_flight(fl, boom)
+        assert follower.exception(timeout=5) is boom
+        assert c.join_flight("k", "standard") is None
+        assert c.stats()["in_flight"] == 0
