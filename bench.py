@@ -2077,6 +2077,163 @@ def _sparse(n_requests: int = 32, max_batch: int = 8,
     print(json.dumps(rec), flush=True)
 
 
+def _fwht(n_requests: int = 8, max_batch: int = 4, rounds: int = 5,
+          s_dim: int = 256, m_dim: int = 8,
+          n_dims=(4096, 16384, 65536)) -> None:
+    """Panel vs panel-free SRHT A/B (``python bench.py --fwht``;
+    backend-agnostic — run with JAX_PLATFORMS=cpu for the
+    hardware-free record).
+
+    Two legs, one per retired panel path:
+
+    - **fold leg** (the dist-shard / session-append contraction): per
+      ``n`` in ``n_dims``, contract an integer-lattice ``(n, m)``
+      operand through the SRHT operator both ways — *panel* generates
+      the O(n·s) Sylvester-Hadamard column panel and pays the
+      O(n·s·m) GEMM (the status quo this PR retires, regenerated per
+      fold exactly as the shard tasks and streaming appenders did);
+      *panel-free* is ``FJLT.fold_rows``, the O(n·log n·m) in-place
+      FWHT fold. Operands are dyadic (integer lattice, ``n``/``s``
+      even powers of two), so the two sides must be **bit-equal** —
+      the speedup is free of any numerics trade. The largest-``n``
+      speedup is appended to ``benchmarks/ledger.json`` as
+      ``fwht_panel_free_speedup`` (the CI fwht gate requires ≥ 1.3);
+    - **serve leg**: an ``n_requests`` SRHT storm through the
+      microbatch executor's panel-free flush, fully warmed — the
+      measured window must show ZERO engine cache misses and ZERO
+      recompiles, and every served result must be bit-equal to the
+      ``A @ panel.T`` oracle.
+
+    Prints exactly one JSON line; exits nonzero on any violation."""
+    import jax
+    import numpy as np
+
+    from libskylark_tpu import Context, engine
+    from libskylark_tpu import sketch as sk
+    from libskylark_tpu.sketch.fjlt import FJLT
+
+    rng = np.random.default_rng(0)
+    violations = []
+
+    # -- fold leg: O(n·s) panel + GEMM vs O(n·log n·m) FWHT fold --------
+    folds = {}
+    for n in n_dims:
+        t = FJLT(n, s_dim, Context(seed=n), fut="wht")
+        X = rng.integers(-4, 5, (n, m_dim)).astype(np.float32)
+
+        def panel_fold():
+            # panel regenerated per fold — that IS the per-shard /
+            # per-append cost the panel-free path removes, so it
+            # stays inside the measured window
+            P = np.asarray(t.operator_panel(0, n))
+            return P @ X
+
+        def free_fold():
+            return np.asarray(t.fold_rows(X, 0, n))
+
+        p_out, f_out = panel_fold(), free_fold()
+        if not np.array_equal(p_out, f_out):
+            violations.append(
+                f"fold n={n}: panel-free fold not bit-equal to the "
+                "panel contraction on dyadic operands")
+        best_p = best_f = float("inf")
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            panel_fold()
+            best_p = min(best_p, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            free_fold()
+            best_f = min(best_f, time.perf_counter() - t0)
+        folds[str(n)] = {
+            "panel_s": round(best_p, 4),
+            "panel_free_s": round(best_f, 4),
+            "speedup": round(best_p / best_f, 2),
+            "bit_equal": bool(np.array_equal(p_out, f_out)),
+        }
+    top_n = str(max(n_dims))
+    speedup = folds[top_n]["speedup"]
+    if speedup < 1.3:
+        violations.append(
+            f"fold n={top_n}: panel-free speedup {speedup} below the "
+            "1.3x acceptance floor")
+
+    # -- serve leg: warmed panel-free storm, zero-compile window --------
+    engine.reset()
+    n_srv = n_dims[0]
+    ts = [FJLT(n_srv, s_dim, Context(seed=i), fut="wht")
+          for i in range(n_requests)]
+    ops = [rng.integers(-4, 5, (m_dim, n_srv)).astype(np.float32)
+           for _ in range(n_requests)]
+    ex = engine.MicrobatchExecutor(max_batch=max_batch, linger_us=5000,
+                                   max_queue=8 * n_requests)
+
+    def storm():
+        futs = [ex.submit_sketch(t, A, dimension=sk.ROWWISE)
+                for t, A in zip(ts, ops)]
+        outs = [f.result(timeout=300) for f in futs]
+        jax.block_until_ready(outs)
+        return outs
+
+    cap = 1
+    while cap <= max_batch:
+        futs = [ex.submit_sketch(t, A, dimension=sk.ROWWISE)
+                for t, A in zip(ts[:cap], ops[:cap])]
+        ex.flush()
+        [f.result(timeout=300) for f in futs]
+        cap *= 2
+    storm()
+    m0, r0 = engine.stats().misses, engine.stats().recompiles
+    outs = storm()
+    best_storm = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        storm()
+        best_storm = min(best_storm, time.perf_counter() - t0)
+    misses = engine.stats().misses - m0
+    recompiles = engine.stats().recompiles - r0
+    fwht_stats = ex.stats()["fwht"]
+    ex.shutdown()
+    if misses:
+        violations.append(
+            f"{misses} engine cache miss(es) in the measured window")
+    if recompiles:
+        violations.append(
+            f"{recompiles} recompile(s) in the measured window")
+    if not fwht_stats["by_backend"]:
+        violations.append("no SRHT flushes attributed on the serve leg")
+    for i, o in enumerate(outs):
+        P = np.asarray(ts[i].operator_panel(0, n_srv))
+        if not np.array_equal(np.asarray(o), ops[i] @ P.T):
+            violations.append(
+                f"serve request {i}: panel-free flush not bit-equal "
+                "to the A @ panel.T oracle")
+            break
+
+    rec = {
+        "metric": "fwht_panel_free_speedup",
+        "value": speedup,
+        "platform": jax.default_backend(),
+        "s_dim": s_dim,
+        "m_dim": m_dim,
+        "fold_ab": folds,
+        "serve": {
+            "n_dim": n_srv,
+            "rps": round(n_requests / best_storm, 1),
+            "misses_after_warmup": misses,
+            "recompiles_after_warmup": recompiles,
+            "flushes_by_backend": {
+                k: v["flushes"]
+                for k, v in fwht_stats["by_backend"].items()},
+        },
+        "violations": violations,
+        "telemetry": _telemetry_snapshot(),
+    }
+    print(json.dumps(rec), flush=True)
+    if violations:
+        sys.exit(1)
+    _ledger_append("fwht_panel_free_speedup", speedup)
+
+
 # ---------------------------------------------------------------------------
 # kernel certification: measured (not ranked) plan-cache entries
 # ---------------------------------------------------------------------------
@@ -2086,7 +2243,8 @@ def _certify_kernels(rounds: int = 5, capacity: int = 8) -> None:
     """One-shot serve-ladder certification job (``python bench.py
     --certify-kernels``): measure the Pallas-vs-XLA batched-flush
     ladder per representative serve bucket — dense (JLT), hash (CWT),
-    fastfood, and the sparse-CSR family — and feed the winners into
+    fastfood, the sparse-CSR family, and the panel-free SRHT/FWHT
+    tier — and feed the winners into
     the plan cache as **measured** entries, upgrading the r12 "ranked"
     (cost-model) decisions into recorded chip-level outcomes
     (``tune.record_measurement``: measured entries displace ranked
@@ -2107,7 +2265,8 @@ def _certify_kernels(rounds: int = 5, capacity: int = 8) -> None:
 
     from libskylark_tpu import tune
     from libskylark_tpu.sketch import (pallas_dense, pallas_fastfood,
-                                       pallas_hash, pallas_sparse)
+                                       pallas_fwht, pallas_hash,
+                                       pallas_sparse)
 
     ph = probe_health_block(run_probe=True)
     on_tpu = jax.default_backend() == "tpu"
@@ -2242,6 +2401,23 @@ def _certify_kernels(rounds: int = 5, capacity: int = 8) -> None:
         if live else None,
     }
     buckets["sparse_cwt_cw_4096x16_s32_z1024"] = (w4, cands4)
+
+    # -- SRHT family: panel-free FWHT rowwise (8, 4096) s256 -------------
+    kd5 = keys(capacity)
+    A5 = jnp.asarray(
+        rng.integers(-4, 5, (capacity, 8, 4096)).astype(np.float32))
+    w5 = tune.serve_workload("sketch_apply", "SRHT", "float32",
+                             (8, 4096), 256, capacity, rowwise=True)
+    from libskylark_tpu.sketch.fjlt import srht_serve_apply
+
+    xla_srht = jax.jit(jax.vmap(
+        lambda k, a: srht_serve_apply(k, a, s_dim=256, rowwise=True)))
+    cands5 = {
+        "xla": lambda: xla_srht(kd5, A5),
+        "pallas": (lambda: pallas_fwht.srht_apply_batched(
+            kd5, A5, s_dim=256, rowwise=True)) if live else None,
+    }
+    buckets["srht_rw_8x4096_s256"] = (w5, cands5)
 
     results = {}
     upgraded = 0
@@ -2635,6 +2811,11 @@ if __name__ == "__main__":
         # cached vs uncached (bit-equality + zero-flush + single-
         # flight proof); backend-agnostic, in-process like --serve
         _cache()
+    elif "--fwht" in sys.argv:
+        # panel vs panel-free SRHT A/B: FWHT fold vs O(n*s) panel
+        # contraction (bit-equality + zero-compile proof + ledger
+        # record); backend-agnostic
+        _fwht()
     elif "--certify-kernels" in sys.argv:
         # one-shot serve-ladder certification: measure pallas-vs-XLA
         # per serve bucket and upgrade ranked plan-cache entries to

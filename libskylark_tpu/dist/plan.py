@@ -421,10 +421,14 @@ class _Folder:
             if p.targets:
                 self.sy = self.sy + panel @ jnp.asarray(Y)
         elif p.kind == "srht":
-            panel = jnp.asarray(self.t.operator_panel(lo, hi, self._dt))
-            self.sx = self.sx + panel @ jnp.asarray(X)
+            # panel-free FWHT fold over exactly these rows (the r21
+            # fix): O(rows·log rows·m) aligned-block transforms instead
+            # of jnp.asarray-ing a fresh O(rows·s) operator panel on
+            # every (re-)execution. operator_panel stays as the
+            # bit-equality oracle (tests/test_fwht.py).
+            self.sx = self.sx + self.t.fold_rows(X, lo, hi, self._dt)
             if p.targets:
-                self.sy = self.sy + panel @ jnp.asarray(Y)
+                self.sy = self.sy + self.t.fold_rows(Y, lo, hi, self._dt)
         else:                    # ust
             sel = np.nonzero((self._idx >= lo) & (self._idx < hi))[0]
             if sel.size:

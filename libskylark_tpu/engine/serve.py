@@ -141,6 +141,7 @@ import contextlib
 import dataclasses
 import functools
 import itertools
+import math
 import threading
 import time
 import warnings
@@ -169,7 +170,8 @@ from libskylark_tpu.telemetry import trace as _trace
 ENDPOINTS = ("sketch_apply", "fastfood_features", "solve_l2_sketched",
              "krr_predict", "sparse_sketch_apply",
              "sparse_solve_l2_sketched", "graph_ase", "graph_ppr",
-             "condest", "lowrank", "rlsc_predict")
+             "condest", "lowrank", "rlsc_predict",
+             "compressed_matmul")
 
 # endpoints with a batched Pallas flush kernel behind the selection
 # seam (arg > env > plan cache > default); the others always flush
@@ -201,6 +203,20 @@ _SPARSE_NNZ_HIST = _metrics.histogram(
     "bucket-population signal (one bucket per (shape class, nnz "
     "class, dtype))",
     buckets=tuple(float(1 << p) for p in range(6, 21)))
+
+# panel-free FWHT tier telemetry (docs/performance, "In-kernel FWHT
+# and compressed matmul") — created HERE once; the per-executor
+# disaggregation lives in ``stats()["fwht"]`` and rides the serve
+# collector.
+_FWHT_FLUSHES = _metrics.counter(
+    "serve.fwht_flushes",
+    "SRHT-family sketch_apply flushes by resolved flush backend "
+    "(pallas = the in-kernel FWHT butterfly, xla = the panel-free "
+    "fwht_sketch lowering)")
+_CM_SUBMITS = _metrics.counter(
+    "serve.compressed_matmul_submits",
+    "Compressed approximate-matmul submissions reaching the flush "
+    "path (cache hits bypass prep and are not counted here)")
 
 _KERNEL_BACKENDS = _env.SERVE_KERNEL_BACKENDS
 
@@ -355,16 +371,28 @@ def _decline_slug(msg: str) -> str:
 def _sketch_family(transform):
     """(family tag, dist instance) for a serve-able transform."""
     from libskylark_tpu.sketch.dense import DenseTransform
+    from libskylark_tpu.sketch.fjlt import FJLT
     from libskylark_tpu.sketch.hash import CWT
 
     if isinstance(transform, CWT):
         return "CWT", None
+    if isinstance(transform, FJLT):
+        # the serve family is the SRHT: the panel-free fwht_sketch
+        # program (and the in-kernel FWHT butterfly behind it) is
+        # closed-form only for the Sylvester-Hadamard mixer — the
+        # same restriction operator_panel/fold_rows carry
+        if transform._fut_name != "wht":
+            raise _errors.UnsupportedError(
+                "FJLT serves panel-free only with the 'wht' "
+                f"(Sylvester-Hadamard) mixer, not "
+                f"{transform._fut_name!r}")
+        return "SRHT", None
     if isinstance(transform, DenseTransform):
         return transform.sketch_type, transform.dist
     raise TypeError(
-        "serve endpoints batch dense (JLT/CT) and CWT transforms "
-        "(Fastfood/RFT feature maps go through submit_fastfood); "
-        f"got {type(transform).__name__}")
+        "serve endpoints batch dense (JLT/CT), CWT and FJLT/SRHT "
+        "transforms (Fastfood/RFT feature maps go through "
+        f"submit_fastfood); got {type(transform).__name__}")
 
 
 def _sketch_statics(transform, A, dimension, pad_floor):
@@ -384,13 +412,124 @@ def _sketch_statics(transform, A, dimension, pad_floor):
             f"operand dim {n} != transform input dim "
             f"{transform.input_dim}")
     family, dist = _sketch_family(transform)
-    pad_axes = (0, 1)  # both extents paddable: N is stream-exact,
-    #                    the other axis is sliced off the output
+    if family == "SRHT":
+        # the FWHT length IS the operator: padding the transform axis
+        # would change what the sketch computes, so only the free axis
+        # buckets (the panel path padded both — the operator panel was
+        # stream-exact at any extent; the panel-free program is not)
+        if n & (n - 1):
+            raise ValueError(
+                f"SRHT serve requires a power-of-2 transform dim, "
+                f"got {n}")
+        pad_axes = (0,) if rowwise else (1,)
+    else:
+        pad_axes = (0, 1)  # both extents paddable: N is stream-exact,
+        #                    the other axis is sliced off the output
     padded = bucketing.pad_shape(A.shape, pad_axes, pad_floor)
     statics = ("sketch_apply", family, repr(dist),
                transform.sketch_dim, rowwise, str(A.dtype), padded)
     return statics, {"A": A, "family": family, "dist": dist,
                      "rowwise": rowwise, "padded": padded}
+
+
+def _is_sparse_operand(A) -> bool:
+    from libskylark_tpu.base.sparse import SparseMatrix
+
+    if isinstance(A, SparseMatrix):
+        return True
+    try:
+        import scipy.sparse as sp
+
+        return sp.issparse(A)
+    except ImportError:  # pragma: no cover - scipy is a hard dep here
+        return False
+
+
+def default_cmm_transform(A, *, s_dim: Optional[int] = None,
+                          seed: int = 0):
+    """The transform ``submit_compressed_matmul`` builds when the
+    caller holds none: SRHT (FJLT/``wht``) when A's contraction dim is
+    a power of two, CWT otherwise, at ``s_dim`` (default
+    ``SKYLARK_FWHT_CM_SDIM``) buckets seeded from ``seed``. Shared by
+    the executor and fleet-router conveniences so the two front doors
+    build bit-identical operators — a fleet submit and a local submit
+    of the same (A, B, s_dim, seed) coalesce in the result cache."""
+    from libskylark_tpu.base.context import Allocation
+
+    n = int(A.shape[1] if hasattr(A, "shape")
+            else np.asarray(A).shape[1])
+    s = int(s_dim or _env.FWHT_CM_SDIM.get())
+    alloc = Allocation(int(seed), 0)
+    if n & (n - 1):
+        from libskylark_tpu.sketch.hash import CWT
+
+        return CWT(n, s, alloc)
+    from libskylark_tpu.sketch.fjlt import FJLT
+
+    return FJLT(n, s, alloc, fut="wht")
+
+
+def _cmm_statics(transform, A, B, pad_floor):
+    """(statics, info) for a compressed_matmul request: estimate A·B
+    (A: (m, n) dense or CSR, B: (n, p) dense) from one shared sketch —
+    ``(A Sᵀ)(S B)`` with the SAME operator S both sides, family CWT or
+    SRHT. The contraction extent n is an exact bucket component (both
+    family programs are stream-exact only at the true extent, and the
+    error bound is a function of the true contraction); m and p bucket
+    to their pow2 classes. The expected-error scale
+    ``‖A‖_F·‖B‖_F·√(2/s)`` is computed host-side here and rides the
+    request meta — the future resolves to ``(estimate, bound)``."""
+    family, _dist = _sketch_family(transform)
+    if family not in ("CWT", "SRHT"):
+        raise TypeError(
+            f"compressed_matmul serves CWT/SRHT sketches, got "
+            f"{family} (a dense virtual panel would cost more than "
+            "the product it estimates)")
+    B = np.asarray(B)
+    if B.ndim != 2:
+        raise ValueError(f"compressed_matmul expects a (n, p) B, got "
+                         f"{B.shape}")
+    sparse = _is_sparse_operand(A)
+    if sparse:
+        A = _coerce_sparse(A)
+        m, n = A.shape
+        dtype = str(np.dtype(A.device_dtype))
+        norm_a = float(np.linalg.norm(A.csr_parts(
+            np.dtype(dtype))[0]))
+    else:
+        A = np.asarray(A)
+        if A.ndim != 2:
+            raise ValueError(
+                f"compressed_matmul expects a (m, n) A, got {A.shape}")
+        m, n = A.shape
+        dtype = str(A.dtype)
+        norm_a = float(np.linalg.norm(A))
+    if B.shape[0] != n:
+        raise ValueError(
+            f"contraction mismatch: A is {(m, n)}, B is {B.shape}")
+    if n != transform.input_dim:
+        raise ValueError(
+            f"contraction dim {n} != transform input dim "
+            f"{transform.input_dim}")
+    if family == "SRHT" and n & (n - 1):
+        raise ValueError(
+            f"SRHT compressed_matmul requires a power-of-2 "
+            f"contraction dim, got {n}")
+    s_dim = transform.sketch_dim
+    bound = (norm_a * float(np.linalg.norm(B))
+             * math.sqrt(2.0 / s_dim))
+    m_pad = bucketing.pow2_pad(m, pad_floor)
+    p_pad = bucketing.pow2_pad(B.shape[1], pad_floor)
+    nnz_cls = (bucketing.nnz_class(A.nnz,
+                                   _env.SPARSE_NNZ_FLOOR.get())
+               if sparse else 0)
+    statics = ("compressed_matmul", family, s_dim, sparse, n, dtype,
+               m_pad, p_pad, nnz_cls)
+    return statics, {"A": A, "B": B, "family": family,
+                     "sparse": sparse, "s_dim": s_dim, "n": n,
+                     "m": m, "p": B.shape[1], "bound": bound,
+                     "padded_A": (m_pad, n), "padded_B": (n, p_pad),
+                     "nnz_class": nnz_cls, "dtype": dtype}
 
 
 def _fastfood_statics(transform, A, pad_floor):
@@ -753,6 +892,9 @@ def derive_request(endpoint: str, *,
         return _krr_statics(kwargs["kernel"], kwargs["X_new"],
                             kwargs["X_train"], kwargs["coef"],
                             pad_floor, endpoint="rlsc_predict")
+    if endpoint == "compressed_matmul":
+        return _cmm_statics(kwargs["transform"], kwargs["A"],
+                            kwargs["B"], pad_floor)
     raise ValueError(f"unknown serve endpoint {endpoint!r}; "
                      f"expected one of {ENDPOINTS}")
 
@@ -820,6 +962,14 @@ def request_digest(endpoint: str, derived: tuple, kwargs: dict) -> str:
         parts = [("kd_s", kd(ts)), ("scale_s", scale_of(ts)),
                  ("kd_t", kd(tt)), ("scale_t", scale_of(tt)),
                  ("A", info["A"])]
+    elif endpoint == "compressed_matmul":
+        t = kwargs["transform"]
+        parts = [("kd", kd(t)), ("scale", scale_of(t))]
+        if info["sparse"]:
+            parts += csr(info["A"], info["dtype"])
+        else:
+            parts.append(("A", info["A"]))
+        parts.append(("B", info["B"]))
     else:
         raise ValueError(f"unknown serve endpoint {endpoint!r}; "
                          f"expected one of {ENDPOINTS}")
@@ -943,6 +1093,9 @@ class MicrobatchExecutor:
             collections.Counter()
         self._sparse_nnz_hist: "collections.Counter" = \
             collections.Counter()
+        # SRHT/FWHT flush disaggregation (docs/performance, "In-kernel
+        # FWHT and compressed matmul")
+        self._fwht_sel: "collections.Counter" = collections.Counter()
         # QoS accounting (under _stats_lock): (kind, class, tenant)
         # counters, per-class latency windows, per-bucket adaptive-
         # controller observations (latency window, warm capacity set,
@@ -1142,6 +1295,9 @@ class MicrobatchExecutor:
             elif endpoint == "rlsc_predict":
                 key, statics, ctx, req = self._prep_rlsc(
                     _derived=derived, **kwargs)
+            elif endpoint == "compressed_matmul":
+                key, statics, ctx, req = self._prep_cmm(
+                    _derived=derived, **kwargs)
             else:
                 raise ValueError(f"unknown serve endpoint {endpoint!r}; "
                                  f"expected one of {ENDPOINTS}")
@@ -1296,6 +1452,29 @@ class MicrobatchExecutor:
         row classes."""
         return self.submit("lowrank", transform_s=transform_s,
                            transform_t=transform_t, A=A, k=k, **kw)
+
+    def submit_compressed_matmul(self, A, B, transform=None, *,
+                                 s_dim: Optional[int] = None,
+                                 seed: int = 0, **kw) -> Future:
+        """Compressed approximate matmul (docs/performance,
+        "In-kernel FWHT and compressed matmul"): estimate ``A @ B``
+        from one shared sketch — ``(A Sᵀ)(S B)`` with the SAME
+        operator S on both sides, so the estimate is unbiased
+        (``E[SᵀS] = I`` for both families). Resolves to
+        ``(estimate, bound)``: the (m, p) host estimate and the
+        expected-error scale ``‖A‖_F·‖B‖_F·√(2/s)`` (the standard
+        sketched-AMM Frobenius bound — an expectation-level scale,
+        not a tail guarantee). ``A`` may be dense or CSR (the sparse
+        lane sketches straight off the r18 CSR packing for CWT, and
+        densifies in-executable for SRHT). Pass a caller-held CWT or
+        FJLT/``wht`` transform for seed control, or let ``s_dim``
+        (default ``SKYLARK_FWHT_CM_SDIM``) and ``seed`` build one:
+        SRHT when the contraction dim is a power of two, CWT
+        otherwise."""
+        if transform is None:
+            transform = default_cmm_transform(A, s_dim=s_dim, seed=seed)
+        return self.submit("compressed_matmul", transform=transform,
+                           A=A, B=B, **kw)
 
     def submit_rlsc_predict(self, kernel, X_new, X_train, coef,
                             coding=None, **kw) -> Future:
@@ -1533,6 +1712,38 @@ class MicrobatchExecutor:
             true_shapes={"A": A.shape},
             meta={"padded": info["padded"], "rowwise": info["rowwise"],
                   "s_dim": transform.sketch_dim},
+        )
+        return statics, statics, ctx, req
+
+    def _prep_cmm(self, transform, A, B, _derived=None):
+        statics, info = _derived or _cmm_statics(
+            transform, A, B, self.pad_floor)
+        A, B = info["A"], info["B"]
+        dtype = np.dtype(info["dtype"])
+        ctx = {"family": info["family"], "s_dim": info["s_dim"],
+               "sparse": info["sparse"], "padded_A": info["padded_A"],
+               "padded_B": info["padded_B"],
+               "nnz_class": info["nnz_class"], "dtype": info["dtype"]}
+        arrays = {"kd": self._key_data(transform),
+                  "B": B.astype(dtype, copy=False)}
+        if info["sparse"]:
+            data, idx, ptr = self._pack_csr(
+                A, info["padded_A"][0], info["nnz_class"], dtype)
+            arrays.update(data=data, indices=idx, indptr=ptr)
+            true_shapes = {"data": (A.nnz,), "B": B.shape}
+        else:
+            arrays["A"] = A.astype(dtype, copy=False)
+            true_shapes = {"A": A.shape, "B": B.shape}
+        _CM_SUBMITS.inc_always()
+        with self._stats_lock:
+            self._counts["cm_submits"] += 1
+        req = _Request(
+            endpoint="compressed_matmul",
+            arrays=arrays,
+            true_shapes=true_shapes,
+            meta={"m": info["m"], "p": info["p"],
+                  "bound": info["bound"],
+                  "padded_A": info["padded_A"]},
         )
         return statics, statics, ctx, req
 
@@ -2273,6 +2484,19 @@ class MicrobatchExecutor:
         padded, rowwise = ctx["padded"], ctx["rowwise"]
         n = padded[1] if rowwise else padded[0]
         m = padded[0] if rowwise else padded[1]
+        if ctx["family"] == "SRHT":
+            # n is the exact transform extent for this family
+            # (_sketch_statics pads the free axis only)
+            min_n = _env.FWHT_MIN_N.get()
+            if n < min_n:
+                return False, (f"n={n} below SKYLARK_FWHT_MIN_N="
+                               f"{min_n} (short transforms beat the "
+                               "in-kernel generation overhead)")
+            from libskylark_tpu.sketch import pallas_fwht
+
+            return pallas_fwht.qualify(ctx["s_dim"], n, m,
+                                       ctx["dtype"],
+                                       interpret=interpret)
         if ctx["family"] == "CWT":
             from libskylark_tpu.sketch import pallas_hash
 
@@ -2314,8 +2538,17 @@ class MicrobatchExecutor:
         plan = None
         sparse_pin = (_env.SPARSE_KERNEL.get()
                       if b.statics[0] == "sparse_sketch_apply" else None)
+        # the FWHT-family pin (SKYLARK_FWHT_KERNEL) plays the same
+        # role for the SRHT buckets SKYLARK_SPARSE_KERNEL plays for
+        # the sparse ones: route just this family without disturbing
+        # the rest of the ladder
+        fwht_pin = (_env.FWHT_KERNEL.get()
+                    if (b.statics[0] == "sketch_apply"
+                        and b.statics[1] == "SRHT") else None)
         if self.kernel is not None:
             choice, source = self.kernel, "arg"
+        elif fwht_pin is not None:
+            choice, source = fwht_pin, "env"
         elif sparse_pin is not None:
             # the sparse-family pin (SKYLARK_SPARSE_KERNEL) sits
             # between the executor argument and the general
@@ -2401,6 +2634,11 @@ class MicrobatchExecutor:
             # the pin in _resolve_flush_kernel, so seeding it would
             # silently override the operator's sparse routing
             return False
+        if (len(statics) > 1 and statics[0] == "sketch_apply"
+                and statics[1] == "SRHT"
+                and _env.FWHT_KERNEL.get() is not None):
+            # same rule for the FWHT-family pin
+            return False
         if not sketch_params.get_use_plan_cache():
             return False
         value = None
@@ -2454,6 +2692,15 @@ class MicrobatchExecutor:
                 def one(kd, scale, A):
                     return cwt_serve_apply(kd, A, s_dim=s_dim,
                                            rowwise=rowwise)
+            elif ctx["family"] == "SRHT":
+                from libskylark_tpu.sketch.fjlt import srht_serve_apply
+
+                # the SRHT's scaling is fully determined by (n, s_dim)
+                # inside the program; the scale lane rides unread for
+                # arity uniformity with the other sketch families
+                def one(kd, scale, A):
+                    return srht_serve_apply(kd, A, s_dim=s_dim,
+                                            rowwise=rowwise)
             else:
                 from libskylark_tpu.sketch.dense import serve_apply
 
@@ -2479,6 +2726,13 @@ class MicrobatchExecutor:
                         return pallas_hash.cwt_apply_batched(
                             kd, A, s_dim=s_dim, rowwise=rowwise,
                             accum="exact" if interpret else "mxu",
+                            interpret=interpret)
+                    if ctx["family"] == "SRHT":
+                        from libskylark_tpu.sketch import pallas_fwht
+
+                        return pallas_fwht.srht_apply_batched(
+                            kd, A, s_dim=s_dim, rowwise=rowwise,
+                            m_tile=plan.m_tile if plan else None,
                             interpret=interpret)
                     from libskylark_tpu.sketch import pallas_dense
 
@@ -2564,6 +2818,77 @@ class MicrobatchExecutor:
                 batched_sparse, name="serve.sparse_sketch_apply",
                 donate_argnums=(0, 1, 2, 3, 4),
                 key_fn=serve_key)
+        if endpoint == "compressed_matmul":
+            # always-xla flush (like the solve endpoints): the two
+            # family sketch programs each run panel-free already, and
+            # the closing (m, s)x(s, p) gemm is XLA's bread and butter
+            # — tune covers it as the xla-only "serve_cmm" op for
+            # roofline/certification, not as a kernel decision
+            family, s_dim = ctx["family"], ctx["s_dim"]
+            padded_a = ctx["padded_A"]
+            if family == "SRHT":
+                from libskylark_tpu.sketch.fjlt import srht_serve_apply
+
+                def skA_dense(kd, A):
+                    return srht_serve_apply(kd, A, s_dim=s_dim,
+                                            rowwise=True)
+
+                def skB(kd, B):
+                    return srht_serve_apply(kd, B, s_dim=s_dim,
+                                            rowwise=False)
+            else:
+                from libskylark_tpu.sketch.hash import cwt_serve_apply
+
+                def skA_dense(kd, A):
+                    return cwt_serve_apply(kd, A, s_dim=s_dim,
+                                           rowwise=True)
+
+                def skB(kd, B):
+                    return cwt_serve_apply(kd, B, s_dim=s_dim,
+                                           rowwise=False)
+
+            if ctx["sparse"]:
+                from libskylark_tpu.sketch import sparse_serve as _ssrv
+
+                if family == "CWT":
+                    # sketch straight off the padded CSR lanes (the
+                    # r18 packing) — no densify
+                    def one_cm(kd, data, indices, indptr, B):
+                        SA = _ssrv.cwt_sparse_serve_apply(
+                            kd, data, indices, indptr, s_dim=s_dim,
+                            rowwise=True, shape=padded_a)
+                        return SA @ skB(kd, B)
+                else:
+                    # the SRHT has no CSR program (the FWHT mixes
+                    # every coordinate); densify in-executable, the
+                    # same policy the dense-family sparse flush uses
+                    def one_cm(kd, data, indices, indptr, B):
+                        Ad = _ssrv.scatter_dense(
+                            data, indices, indptr, shape=padded_a)
+                        return skA_dense(kd, Ad) @ skB(kd, B)
+
+                inner_cm = jax.vmap(one_cm)
+
+                def batched_cmm(kd, data, indices, indptr, B):
+                    return inner_cm(kd, data, indices, indptr, B)
+
+                return engine_compile(
+                    batched_cmm, name="serve.compressed_matmul",
+                    donate_argnums=(0, 1, 2, 3, 4),
+                    key_fn=lambda *a: statics)
+
+            def one_cm(kd, A, B):
+                return skA_dense(kd, A) @ skB(kd, B)
+
+            inner_cm = jax.vmap(one_cm)
+
+            def batched_cmm(kd, A, B):
+                return inner_cm(kd, A, B)
+
+            return engine_compile(
+                batched_cmm, name="serve.compressed_matmul",
+                donate_argnums=(0, 1, 2),
+                key_fn=lambda *a: statics)
         if endpoint == "sparse_solve_l2_sketched":
             from libskylark_tpu.sketch import sparse_serve as _ssrv
 
@@ -2837,6 +3162,37 @@ class MicrobatchExecutor:
                     cohort[0].meta["padded_B"], capacity, dtype)))
             args = tuple(args)
             primary = "data"
+        elif endpoint == "compressed_matmul":
+            dtype = cohort[0].arrays["B"].dtype
+            kd = bucketing.stack_pad([r.arrays["kd"] for r in cohort],
+                                     (2,), capacity, np.uint32)
+            args = [self._device_put_batch(kd)]
+            if b.ctx["sparse"]:
+                nnz_pad = cohort[0].arrays["data"].shape[0]
+                padded = (nnz_pad,)
+                ptr_len = cohort[0].arrays["indptr"].shape[0]
+                args += [
+                    self._device_put_batch(bucketing.stack_pad(
+                        [r.arrays["data"] for r in cohort],
+                        (nnz_pad,), capacity, dtype)),
+                    self._device_put_batch(bucketing.stack_pad(
+                        [r.arrays["indices"] for r in cohort],
+                        (nnz_pad,), capacity, np.int32)),
+                    self._device_put_batch(bucketing.stack_pad(
+                        [r.arrays["indptr"] for r in cohort],
+                        (ptr_len,), capacity, np.int32)),
+                ]
+                primary = "data"
+            else:
+                padded = b.ctx["padded_A"]
+                args.append(self._device_put_batch(bucketing.stack_pad(
+                    [r.arrays["A"] for r in cohort], padded, capacity,
+                    dtype)))
+                primary = "A"
+            args.append(self._device_put_batch(bucketing.stack_pad(
+                [r.arrays["B"] for r in cohort], b.ctx["padded_B"],
+                capacity, dtype)))
+            args = tuple(args)
         elif endpoint in ("graph_ase", "graph_ppr"):
             # CSR adjacency lanes (the r18 packing): uniform within
             # the bucket (nnz class is a static); graph_ase leads
@@ -2983,6 +3339,10 @@ class MicrobatchExecutor:
                     self._sparse_kernel_sel[kernel_backend] += 1
                     _SPARSE_KERNEL_FLUSHES.inc_always(
                         backend=kernel_backend)
+                if (endpoint == "sketch_apply"
+                        and b.statics[1] == "SRHT"):
+                    self._fwht_sel[kernel_backend] += 1
+                    _FWHT_FLUSHES.inc_always(backend=kernel_backend)
             self._batch_hist[capacity] += 1
             self._cohort_hist[k] += 1
             pad_total = bucketing.padded_elements(padded, capacity)
@@ -3053,6 +3413,11 @@ class MicrobatchExecutor:
         if endpoint == "sparse_solve_l2_sketched":
             x = out[lane]
             return x[:, 0] if r.meta["squeeze"] else x
+        if endpoint == "compressed_matmul":
+            # (estimate, bound): the view discipline holds for the
+            # estimate; the bound is a host float computed at submit
+            return (out[lane, : r.meta["m"], : r.meta["p"]],
+                    r.meta["bound"])
         if endpoint == "graph_ase":
             return out[lane, : r.meta["n"], :]
         if endpoint == "graph_ppr":
@@ -3294,6 +3659,7 @@ class MicrobatchExecutor:
             kdec = dict(sorted(self._kernel_dec.items()))
             sp_sel = dict(sorted(self._sparse_kernel_sel.items()))
             sp_nnz = dict(sorted(self._sparse_nnz_hist.items()))
+            fw_sel = dict(sorted(self._fwht_sel.items()))
         with self._lock:
             queued = self._pending
         return {
@@ -3331,6 +3697,14 @@ class MicrobatchExecutor:
                 "by_backend": {k: {"kernel_flushes": int(v)}
                                for k, v in sp_sel.items()},
                 "nnz_class_hist": sp_nnz,
+            },
+            # panel-free FWHT tier (docs/performance, "In-kernel FWHT
+            # and compressed matmul"); by_backend renders as
+            # skylark_serve_fwht_flushes{backend="..."}
+            "fwht": {
+                "by_backend": {k: {"flushes": int(v)}
+                               for k, v in fw_sel.items()},
+                "cm_submits": c.get("cm_submits", 0),
             },
             "batch_capacity_hist": batch_hist,
             "cohort_size_hist": cohort_hist,
@@ -3456,6 +3830,8 @@ def serve_stats() -> dict:
         {"submits": 0, "densified": 0})
     sparse_sel: "collections.Counter" = collections.Counter()
     sparse_nnz: "collections.Counter" = collections.Counter()
+    fwht_sel: "collections.Counter" = collections.Counter()
+    cm_submits = 0
     qos_blocks: list = []
     cache_blocks: list = []
     by_replica: dict = {}
@@ -3479,6 +3855,9 @@ def serve_stats() -> dict:
         for kk, vv in s["sparse"]["by_backend"].items():
             sparse_sel[kk] += vv["kernel_flushes"]
         sparse_nnz.update(s["sparse"]["nnz_class_hist"])
+        for kk, vv in s["fwht"]["by_backend"].items():
+            fwht_sel[kk] += vv["flushes"]
+        cm_submits += s["fwht"]["cm_submits"]
         qos_blocks.append(s["qos"])
         cache_blocks.append(s.get("cache"))
         states[s["state"]] += 1
@@ -3508,6 +3887,11 @@ def serve_stats() -> dict:
         "by_backend": {k: {"kernel_flushes": int(v)}
                        for k, v in sorted(sparse_sel.items())},
         "nnz_class_hist": dict(sorted(sparse_nnz.items())),
+    }
+    agg["fwht"] = {
+        "by_backend": {k: {"flushes": int(v)}
+                       for k, v in sorted(fwht_sel.items())},
+        "cm_submits": int(cm_submits),
     }
     agg["qos"] = _merge_qos_blocks(qos_blocks)
     agg["cache"] = _rcache.merge_cache_blocks(cache_blocks)

@@ -892,6 +892,17 @@ class Router:
                            X_train=X_train, coef=coef, coding=coding,
                            **kw)
 
+    def submit_compressed_matmul(self, A, B, transform=None, *,
+                                 s_dim=None, seed: int = 0,
+                                 **kw) -> Future:
+        if transform is None:
+            # same construction as the executor convenience, so the
+            # two front doors build bit-identical default operators
+            transform = _serve.default_cmm_transform(
+                A, s_dim=s_dim, seed=seed)
+        return self.submit("compressed_matmul", transform=transform,
+                           A=A, B=B, **kw)
+
     # -- stateful sessions (docs/sessions) -----------------------------
 
     def open_sketch_session(self, kind: str, *,

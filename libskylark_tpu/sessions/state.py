@@ -243,12 +243,17 @@ class SessionState:
             if Y is not None:
                 self.acc["SY"] = self.acc["SY"] + panel @ jnp.asarray(Y)
         elif s.kind == "srht":
+            # panel-free FWHT fold (the dist/plan twin — see the
+            # _Folder docstring's both-or-neither rule): the cached
+            # full diagonal amortizes the Rademacher stream across
+            # thousands of small appends, as it did for the panels.
             t, diag = self._srht
-            panel = jnp.asarray(t.operator_panel(
-                lo, hi, np.dtype(s.dtype), diagonal=diag))
-            self.acc["SX"] = self.acc["SX"] + panel @ Xj
+            self.acc["SX"] = self.acc["SX"] + t.fold_rows(
+                Xj, lo, hi, np.dtype(s.dtype), diagonal=diag)
             if Y is not None:
-                self.acc["SY"] = self.acc["SY"] + panel @ jnp.asarray(Y)
+                self.acc["SY"] = self.acc["SY"] + t.fold_rows(
+                    jnp.asarray(Y), lo, hi, np.dtype(s.dtype),
+                    diagonal=diag)
         else:  # krr
             from libskylark_tpu.sketch import ROWWISE
 

@@ -24,8 +24,39 @@ import jax.numpy as jnp
 import numpy as np
 
 from libskylark_tpu.base import errors, randgen
+from libskylark_tpu.sketch import fut as _fut
 from libskylark_tpu.sketch.fut import make_fut
 from libskylark_tpu.sketch.transform import SketchTransform, register
+
+
+def srht_serve_apply(key_data, A, *, s_dim: int, rowwise: bool):
+    """Panel-free SRHT serve program (the ``sketch_apply`` executable
+    body for the FJLT/``wht`` family, docs/serving).
+
+    Rebuilds the Rademacher diagonal (sub-stream 0) and the sampled
+    coordinates (sub-stream 1) from the raw key data with the same
+    positional :func:`randgen.stream_slice` calls the transform's own
+    ``diagonal()`` / ``sample_indices()`` make — bit-identical streams
+    — then contracts through :func:`fut.fwht_sketch` instead of a
+    materialized operator panel. The transform axis is the exact
+    (never padded) extent: the FWHT length defines the operator, so
+    ``_sketch_statics`` pads only the free axis for this family."""
+    import jax
+    import jax.random as jr
+
+    key = jr.wrap_key_data(jnp.asarray(key_data))
+    n = A.shape[1] if rowwise else A.shape[0]
+    if n & (n - 1):
+        raise ValueError(f"SRHT serve requires power-of-2 n, got {n}")
+    D = randgen.stream_slice(
+        jax.random.fold_in(key, 0), randgen.Rademacher(), 0, n,
+        dtype=A.dtype)
+    idx = randgen.stream_slice(
+        jax.random.fold_in(key, 1), randgen.UniformInt(0, n - 1),
+        0, s_dim, dtype=jnp.int32)
+    return _fut.fwht_sketch(
+        A, D, idx, 1.0 / math.sqrt(n), math.sqrt(n / s_dim),
+        axis=1 if rowwise else 0)
 
 
 def _popcount_parity(a: np.ndarray) -> np.ndarray:
@@ -141,10 +172,7 @@ class FJLT(SketchTransform):
         # generation and device->host transfer once, not per panel.
         # Runtime state only — never serialized (the OperatorCache
         # discipline).
-        idx = getattr(self, "_panel_idx_cache", None)
-        if idx is None:
-            idx = np.asarray(self.sample_indices()).astype(np.uint64)
-            self._panel_idx_cache = idx
+        idx = self._host_sample_indices()
         cols = np.arange(col_start, col_stop, dtype=np.uint64)
         par = _popcount_parity(idx[:, None] & cols[None, :])
         signs = (1.0 - 2.0 * par).astype(dt)
@@ -155,6 +183,73 @@ class FJLT(SketchTransform):
                 self.subkey(0), randgen.Rademacher(), col_start,
                 col_stop, dtype=dt))
         return (signs * diag) / np.asarray(math.sqrt(self._S), dt)
+
+    def _host_sample_indices(self) -> np.ndarray:
+        """Host uint64 copy of :meth:`sample_indices`, memoized (the
+        ``operator_panel`` cache — shared so the panel oracle and the
+        panel-free fold gather from literally the same host array)."""
+        idx = getattr(self, "_panel_idx_cache", None)
+        if idx is None:
+            idx = np.asarray(self.sample_indices()).astype(np.uint64)
+            self._panel_idx_cache = idx
+        return idx
+
+    def fold_rows(self, X, row_start: int, row_stop: int,
+                  dtype=jnp.float32, diagonal=None) -> jnp.ndarray:
+        """Panel-free partial fold: ``operator_panel(row_start,
+        row_stop) @ X`` without materializing the O(rows·s) panel.
+
+        The row range decomposes greedily into ≤ 2·log2(n) aligned
+        power-of-two blocks ``[b, b+L)`` (``b % L == 0``); within one,
+        ``popcount(idx_k & (b+j)) = popcount(idx_k & b) +
+        popcount((idx_k mod L) & j)``, so the block's contribution is
+        ``(−1)^popcount(idx_k & b) · FWHT_L(D_blk ⊙ X_blk)[idx_k mod
+        L]`` — an O(L·log L·m) transform instead of an O(L·s) panel
+        generation plus an O(L·s·m) contraction. Per-block signs and
+        gather coordinates come host-side from the memoized sample
+        indices (the same array the panel oracle uses), so the fold is
+        the panel's bit pattern whenever every intermediate is exactly
+        representable (integer-valued data, ``n``/``s`` even powers of
+        two — the regression battery in tests/test_fwht.py), and
+        allclose otherwise. ``diagonal`` follows the
+        :meth:`operator_panel` contract: the FULL host diagonal, of
+        which only ``[row_start:row_stop)`` is read."""
+        if self._fut_name != "wht":
+            raise errors.UnsupportedError(
+                "fold_rows is closed-form only for the 'wht' "
+                f"(Sylvester-Hadamard) mixer, not {self._fut_name!r}")
+        dt = np.dtype(dtype)
+        lo, hi = int(row_start), int(row_stop)
+        X = jnp.asarray(X)
+        if X.dtype != dt:
+            X = X.astype(dt)
+        if X.shape[0] != hi - lo:
+            raise ValueError(
+                f"operand rows {X.shape[0]} != range extent {hi - lo}")
+        idx = self._host_sample_indices()
+        out = jnp.zeros((self._S,) + X.shape[1:], dt)
+        off = lo
+        while off < hi:
+            rem = hi - off
+            block = 1 << (rem.bit_length() - 1)
+            if off:
+                block = min(block, off & -off)
+            par = _popcount_parity(idx & np.uint64(off))
+            signs = jnp.asarray((1.0 - 2.0 * par).astype(dt))
+            gidx = jnp.asarray((idx & np.uint64(block - 1))
+                               .astype(np.int32))
+            if diagonal is not None:
+                d = np.asarray(diagonal, dtype=dt)[off:off + block]
+            else:
+                d = randgen.stream_slice(
+                    self.subkey(0), randgen.Rademacher(), off,
+                    off + block, dtype=dt)
+            w = d[:, None] * X[off - lo:off - lo + block]
+            if block > 1:
+                w = _fut.fwht(w, axis=0)
+            out = out + signs[:, None] * w[gidx]
+            off += block
+        return (1.0 / math.sqrt(self._S)) * out
 
     def _apply_columnwise(self, A: jnp.ndarray) -> jnp.ndarray:
         D = self.diagonal(A.dtype)

@@ -154,6 +154,45 @@ def wht(A: jnp.ndarray, axis: int = 0, precision=None) -> jnp.ndarray:
     return _wht_butterfly(A, axis)
 
 
+#: The promoted serve-program name for the panel-free Hadamard lowering
+#: (docs/performance, "In-kernel FWHT and compressed matmul"): the SRHT
+#: serve/dist/session paths contract through ``fwht`` instead of
+#: materializing ``FJLT.operator_panel`` columns. Same function as
+#: :func:`wht` — the alias marks the serve-surface contract: its
+#: lowering (butterfly or kron matmul) must stay exact-arithmetic-
+#: identical to the dense Sylvester reference ``_hadamard_np``.
+fwht = wht
+
+
+def fwht_sketch(A: jnp.ndarray, diag: jnp.ndarray, idx: jnp.ndarray,
+                fut_scale: float, samp_scale: float, axis: int = 0,
+                precision=None) -> jnp.ndarray:
+    """Fused sign→FWHT→sample composition: the panel-free SRHT program.
+
+    Computes ``samp_scale · gather(fwht(fut_scale · diag ⊙ A, axis),
+    idx)`` with the multiplications and the gather composed in exactly
+    the order of ``FJLT._apply_columnwise`` / ``_apply_rowwise`` — the
+    fused path is *bit-equal* to the separate diag→FWHT→gather
+    composition (same op sequence, just one traced program), and
+    bit-equal to the ``operator_panel`` matmul reference whenever every
+    intermediate is exactly representable (integer-valued operands with
+    ``n`` and ``s`` even powers of two; the dyadic battery in
+    tests/test_fwht.py pins this).
+
+    ``diag`` is the length-``n`` Rademacher sign diagonal fused into
+    the first butterfly stage; ``idx`` the ``s`` sampled coordinates
+    gathered out of the last. ``axis`` is the contracted (transform)
+    axis: 0 for columnwise operands ``(n, m)``, 1 for rowwise
+    ``(m, n)``."""
+    if axis == 0:
+        mixed = wht(fut_scale * diag[:, None] * A, axis=0,
+                    precision=precision)
+        return samp_scale * mixed[idx, :]
+    mixed = wht(fut_scale * diag[None, :] * A, axis=1,
+                precision=precision)
+    return samp_scale * mixed[:, idx]
+
+
 class FUT:
     """A fast unitary transform with the reference's scale convention."""
 

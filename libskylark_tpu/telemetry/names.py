@@ -50,6 +50,9 @@ METRICS: Dict[str, str] = {
     "serve.sparse_densified": "counter",
     "serve.sparse_kernel_flushes": "counter",
     "serve.sparse_nnz_class": "histogram",
+    # FWHT serve tier (engine/serve.py, docs/performance)
+    "serve.fwht_flushes": "counter",
+    "serve.compressed_matmul_submits": "counter",
     # stateful serve sessions (sessions/registry.py)
     "sessions.opened": "counter",
     "sessions.appends": "counter",

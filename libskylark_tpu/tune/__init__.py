@@ -125,6 +125,11 @@ def serve_workload(endpoint: str, family: str, dtype, lane_shape,
     elif endpoint == "fastfood_features":
         op = "serve_fastfood"
         m, n = int(lane_shape[0]), int(lane_shape[1])
+    elif endpoint == "compressed_matmul":
+        # lane_shape is (m_pad, n); the kept extent of B (p_pad) rides
+        # the nnz slot — the shape triple only has room for (m, n, s).
+        op = "serve_cmm"
+        m, n = int(lane_shape[0]), int(lane_shape[1])
     else:
         raise ValueError(
             f"endpoint {endpoint!r} has no serve-bucket workload")

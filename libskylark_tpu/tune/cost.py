@@ -29,6 +29,7 @@ sort on (modeled seconds, plan_id).
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Sequence
 
 from libskylark_tpu.tune.plans import (FASTFOOD_OPS, HASH_OPS,
@@ -263,6 +264,67 @@ def _sparse_lane_cost(m: int, n: int, s: int, nnz: int, p: Plan,
             "modeled_s": max(hbm_s, mxu_s + gen_s)}
 
 
+def _srht_lane_cost(m: int, n: int, s: int, p: Plan,
+                    rates: dict) -> dict:
+    """One SRHT serve lane (m kept extent, n pow2 transform extent, s
+    sampled rows). XLA: the panel-free ``fwht_sketch`` lowering — the
+    kron-factored WHT is two HIGHEST matmuls against factors of size
+    ~sqrt(n) each (4·m·n·sqrt(n) flops), the sign diagonal and sample
+    gather ride the VPU. Pallas (sketch/pallas_fwht.py): log-n
+    butterfly sweeps fold into one H_128 MXU factor plus the one-hot
+    sample gather, all at HIGHEST; the Threefry streams regenerate
+    once per m-tile sweep and serialize against the MXU (no pipelined
+    variant)."""
+    bytes_moved = 4.0 * (m * n + m * s)
+    hbm_s = bytes_moved / rates["hbm_bytes_per_s"]
+    if p.backend == "xla":
+        root = math.sqrt(float(n))
+        flops = 4.0 * m * n * root * MXU_PASSES["f32"]
+        gen_entries = float(n + s)     # sign diagonal + sample indices
+        compute_s = (flops / rates["mxu_flops_per_s"]
+                     + gen_entries * GEN_OPS_PER_ENTRY
+                     / rates["vpu_ops_per_s"])
+        return {"flops": flops, "bytes": bytes_moved,
+                "gen_entries": gen_entries,
+                "modeled_s": max(hbm_s, compute_s)}
+    m_tile = p.m_tile or 256
+    flops = (2.0 * m * n * 128.0 + 2.0 * m * n * s) * MXU_PASSES["f32"]
+    sweeps = max(1, -(-m // m_tile))
+    gen_entries = float((n + s) * sweeps)
+    compute_s = (flops / rates["mxu_flops_per_s"]
+                 + gen_entries * GEN_OPS_PER_ENTRY
+                 / rates["vpu_ops_per_s"])
+    return {"flops": flops, "bytes": bytes_moved,
+            "gen_entries": gen_entries,
+            "modeled_s": max(hbm_s, compute_s)}
+
+
+def _cmm_cost(w: Workload, p: Plan, rates: dict) -> dict:
+    """One compressed-approximate-matmul lane: sketch both operands
+    down the shared contraction (A·Sᵀ and S·B) and multiply the
+    (m×s)·(s×p) estimates. Always-XLA (the flush composes two existing
+    sketch programs plus a small GEMM — there is no fused kernel), so
+    a pallas plan is a caller bug, not a rankable candidate. The
+    workload's ``nnz`` slot carries the kept extent of B (p) — the
+    shape triple only has room for (m, n, s)."""
+    if p.backend != "xla":
+        raise ValueError(
+            "serve_cmm has no pallas kernel; only the XLA flush exists")
+    m, n, s = w.bucket()
+    pk = max(int(w.nnz), 1)            # kept extent of B, pow2 class
+    lane = _srht_lane_cost if w.transform == "SRHT" else _hash_lane_cost
+    ska = lane(m, n, s, p, rates)
+    skb = lane(pk, n, s, p, rates)
+    gemm_flops = 2.0 * m * s * pk * MXU_PASSES["f32"]
+    gemm_bytes = 4.0 * (m * s + s * pk + m * pk)
+    gemm_s = max(gemm_flops / rates["mxu_flops_per_s"],
+                 gemm_bytes / rates["hbm_bytes_per_s"])
+    return {"flops": ska["flops"] + skb["flops"] + gemm_flops,
+            "bytes": ska["bytes"] + skb["bytes"] + gemm_bytes,
+            "gen_entries": ska["gen_entries"] + skb["gen_entries"],
+            "modeled_s": ska["modeled_s"] + skb["modeled_s"] + gemm_s}
+
+
 def _hash_or_serve_cost(w: Workload, p: Plan, rates: dict) -> dict:
     """Cost record for the hash direct-apply sites and the serve-bucket
     sites. Serve workloads scale one lane's cost by the batch capacity
@@ -273,7 +335,9 @@ def _hash_or_serve_cost(w: Workload, p: Plan, rates: dict) -> dict:
         raise ValueError(
             f"unknown {w.op} backend {p.backend!r} (pallas|xla)")
     m, n, s = w.bucket()
-    if w.op == "serve_fastfood":
+    if w.op == "serve_cmm":
+        rec = _cmm_cost(w, p, rates)
+    elif w.op == "serve_fastfood":
         ff = Plan("fused" if p.backend == "pallas" else "xla_chain",
                   precision=p.precision)
         rec = _fastfood_cost(w, ff, rates)
@@ -281,6 +345,8 @@ def _hash_or_serve_cost(w: Workload, p: Plan, rates: dict) -> dict:
         rec = _sparse_lane_cost(m, n, s, max(int(w.nnz), 1), p, rates)
     elif w.op in HASH_OPS or w.transform == "CWT":
         rec = _hash_lane_cost(m, n, s, p, rates)
+    elif w.transform == "SRHT":
+        rec = _srht_lane_cost(m, n, s, p, rates)
     elif w.transform in SERVE_DENSE_FAMILIES:
         rec = _serve_dense_lane_cost(m, n, s, p, rates)
     else:

@@ -56,15 +56,16 @@ HASH_OPS = ("hash_rowwise", "hash_columnwise")
 # (``Workload.nnz``) — the sparse kernel's cost is a function of the
 # nonzero count, not the dense extents.
 SERVE_OPS = ("serve_sketch_cw", "serve_sketch_rw", "serve_fastfood",
-             "serve_sparse_cw", "serve_sparse_rw")
+             "serve_sparse_cw", "serve_sparse_rw", "serve_cmm")
 
 # the sparse-CSR serve sites (subset of SERVE_OPS): scatter-free
 # sparse-CountSketch kernel (sketch/pallas_sparse.py) vs the XLA
 # O(nnz) scatter
 SPARSE_SERVE_OPS = ("serve_sparse_cw", "serve_sparse_rw")
 
-# dense-family serve buckets enumerate a small m-tile ladder (the
-# batched kernel's only knob); CWT/fastfood serve kernels are knobless.
+# dense-family and SRHT serve buckets enumerate a small m-tile ladder
+# (the batched kernel's only knob); CWT/fastfood serve kernels are
+# knobless.
 SERVE_DENSE_M_TILES = (128, 256, 512)
 
 # serve families whose sketch operator is a dense virtual stream, and
@@ -242,13 +243,20 @@ def _serve_candidates(w: Workload) -> Iterator[Plan]:
     cache entry can never opt a flush into bf16. Sparse buckets whose
     family is not CWT have no kernel (the dense-family sparse flush is
     an in-executable densify + the dense program) and enumerate only
-    the XLA path."""
+    the XLA path. The compressed-matmul endpoint is always-XLA (two
+    sketch programs plus a small GEMM; no fused kernel exists), so it
+    enumerates exactly one plan. SRHT buckets ride the same m-tile
+    ladder as the dense families: the in-kernel FWHT sweeps the batch
+    in row panels and the panel height is its only knob."""
+    if w.op == "serve_cmm":
+        yield Plan("xla")
+        return
     if w.op in SPARSE_SERVE_OPS:
         if w.transform == "CWT":
             yield Plan("pallas")
         yield Plan("xla")
         return
-    if w.transform in SERVE_DENSE_FAMILIES:
+    if w.transform in SERVE_DENSE_FAMILIES or w.transform == "SRHT":
         m, _n, _s = w.bucket()
         for mt in SERVE_DENSE_M_TILES:
             if mt <= max(m, SERVE_DENSE_M_TILES[0]):

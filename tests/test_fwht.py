@@ -1,0 +1,651 @@
+"""FWHT-native tier (docs/performance, "In-kernel FWHT and compressed
+matmul"): the panel-free SRHT lowering, the in-kernel Pallas butterfly,
+and the compressed approximate-matmul endpoint.
+
+Oracles:
+
+- *Sylvester reference*: ``fut.fwht`` equals the dense
+  ``_hadamard_np`` matmul bit for bit on integer-valued f32 lattices
+  (exact adds both ways), allclose on general floats.
+- *dyadic bit-equality*: the fused ``fwht_sketch`` / serve /
+  ``fold_rows`` / Pallas programs are bit-equal to the
+  ``operator_panel`` matmul whenever every intermediate is exactly
+  representable — integer-valued operands with ``n`` and ``s`` EVEN
+  powers of two (``1/sqrt(n)`` dyadic). Odd powers (n = 2^13, ...)
+  are allclose only: the scales are irrational and summation orders
+  legitimately differ in the last ulp.
+- *stream bit-identity*: the in-kernel Threefry regeneration draws the
+  SAME sign diagonal and sample coordinates as the transform's own
+  ``diagonal()`` / ``sample_indices()`` — pinned end-to-end by
+  requiring the Pallas path bit-equal to the XLA twin on dyadic input
+  (one flipped sign or swapped sample would break equality).
+- *selection precedence* for the SRHT family: executor ``kernel=``
+  argument > ``SKYLARK_FWHT_KERNEL`` > ``SKYLARK_SERVE_KERNEL`` >
+  plan cache > xla default, with the FWHT pin invisible to non-SRHT
+  buckets and outranking warmup-pack restoration.
+- *compressed matmul*: ``(A Sᵀ)(S B)`` is within the returned
+  ``‖A‖_F·‖B‖_F·√(2/s)`` scale on well-conditioned data; the sparse-A
+  CWT lane is bit-equal to its densified twin.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import scipy.sparse as sp
+
+from libskylark_tpu import Context, engine, tune
+from libskylark_tpu import sketch as sk
+from libskylark_tpu.base.context import Allocation
+from libskylark_tpu.base.errors import UnsupportedError
+from libskylark_tpu.sketch import fjlt as _fjlt
+from libskylark_tpu.sketch import fut as _fut
+from libskylark_tpu.sketch import pallas_fwht
+from libskylark_tpu.sketch.fjlt import FJLT
+from libskylark_tpu.sketch.hash import CWT
+
+
+@pytest.fixture()
+def fresh_engine():
+    engine.reset()
+    yield
+    engine.reset()
+
+
+def _executor(**kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("linger_us", 1000)
+    return engine.MicrobatchExecutor(**kw)
+
+
+def _kd(transform):
+    return engine.serve.MicrobatchExecutor._key_data(transform)
+
+
+def _lattice(rng, shape):
+    """Integer-valued f32: every butterfly intermediate is exact."""
+    return rng.integers(-4, 5, size=shape).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# fut.fwht vs the dense Sylvester reference
+# ---------------------------------------------------------------------------
+
+
+class TestFWHT:
+    @pytest.mark.parametrize("n", [2, 8, 64, 256, 1024])
+    def test_matches_hadamard_matmul(self, n):
+        rng = np.random.default_rng(n)
+        A = _lattice(rng, (n, 5))
+        H = _fut._hadamard_np(n).astype(np.float32)
+        out = np.asarray(_fut.fwht(jnp.asarray(A), axis=0))
+        assert np.array_equal(out, H @ A)
+
+    def test_general_floats_allclose(self):
+        rng = np.random.default_rng(0)
+        A = rng.standard_normal((512, 7)).astype(np.float32)
+        H = _fut._hadamard_np(512).astype(np.float32)
+        out = np.asarray(_fut.fwht(jnp.asarray(A), axis=0))
+        np.testing.assert_allclose(out, H @ A, rtol=2e-4, atol=2e-3)
+
+    def test_axis1(self):
+        rng = np.random.default_rng(1)
+        A = _lattice(rng, (3, 128))
+        H = _fut._hadamard_np(128).astype(np.float32)
+        out = np.asarray(_fut.fwht(jnp.asarray(A), axis=1))
+        assert np.array_equal(out, A @ H)
+
+    def test_nonpow2_rejected(self):
+        with pytest.raises(ValueError, match="power-of-2"):
+            _fut.fwht(jnp.zeros((12, 3)), axis=0)
+
+    def test_fused_sketch_equals_composed(self):
+        """fwht_sketch is the literal diag→FWHT→gather composition."""
+        rng = np.random.default_rng(2)
+        n, s, m = 1024, 64, 9
+        A = rng.standard_normal((n, m)).astype(np.float32)
+        D = (1.0 - 2.0 * rng.integers(0, 2, n)).astype(np.float32)
+        idx = rng.integers(0, n, s).astype(np.int32)
+        fs, ss = 1.0 / math.sqrt(n), math.sqrt(n / s)
+        fused = np.asarray(_fut.fwht_sketch(
+            jnp.asarray(A), jnp.asarray(D), jnp.asarray(idx), fs, ss,
+            axis=0))
+        mixed = _fut.fwht(fs * jnp.asarray(D)[:, None] * A, axis=0)
+        composed = np.asarray(ss * mixed[jnp.asarray(idx), :])
+        assert np.array_equal(fused, composed)
+
+
+# ---------------------------------------------------------------------------
+# the panel-free SRHT programs vs the operator-panel oracle
+# ---------------------------------------------------------------------------
+
+
+class TestPanelFree:
+    @pytest.mark.parametrize("n,s", [(256, 16), (4096, 64)])
+    def test_serve_apply_bit_equal_dyadic(self, n, s):
+        """n, s even powers of two + lattice data: bit-equal to both
+        the transform's own apply and the materialized panel."""
+        rng = np.random.default_rng(s)
+        t = FJLT(n, s, Context(seed=5), fut="wht")
+        A = _lattice(rng, (7, n))
+        out = np.asarray(_fjlt.srht_serve_apply(
+            _kd(t), jnp.asarray(A), s_dim=s, rowwise=True))
+        ref = np.asarray(t.apply(A, sk.ROWWISE))
+        assert np.array_equal(out, ref)
+        panel = t.operator_panel(0, n)
+        assert np.array_equal(out, A @ np.asarray(panel).T)
+
+    def test_serve_apply_columnwise(self):
+        n, s = 1024, 64
+        rng = np.random.default_rng(3)
+        t = FJLT(n, s, Context(seed=9), fut="wht")
+        A = _lattice(rng, (n, 5))
+        out = np.asarray(_fjlt.srht_serve_apply(
+            _kd(t), jnp.asarray(A), s_dim=s, rowwise=False))
+        assert np.array_equal(out, np.asarray(t.apply(A, sk.COLUMNWISE)))
+
+    def test_serve_apply_floats_allclose(self):
+        n, s = 2048, 128
+        rng = np.random.default_rng(4)
+        t = FJLT(n, s, Context(seed=2), fut="wht")
+        A = rng.standard_normal((6, n)).astype(np.float32)
+        out = np.asarray(_fjlt.srht_serve_apply(
+            _kd(t), jnp.asarray(A), s_dim=s, rowwise=True))
+        np.testing.assert_allclose(
+            out, np.asarray(t.apply(A, sk.ROWWISE)), rtol=1e-4,
+            atol=1e-4)
+
+    @pytest.mark.parametrize("lo,hi", [(0, 256), (0, 1), (17, 18),
+                                       (13, 200), (128, 256)])
+    def test_fold_rows_vs_panel(self, lo, hi):
+        """Partial folds over aligned-block decompositions equal the
+        panel contraction (dyadic regime: bitwise)."""
+        n, s, m = 256, 16, 6
+        rng = np.random.default_rng(hi)
+        t = FJLT(n, s, Context(seed=13), fut="wht")
+        X = _lattice(rng, (hi - lo, m))
+        out = np.asarray(t.fold_rows(X, lo, hi))
+        panel = np.asarray(t.operator_panel(lo, hi))
+        assert np.array_equal(out, panel @ X)
+
+    def test_fold_rows_split_sums_to_full(self):
+        n, s, m = 1024, 64, 4
+        rng = np.random.default_rng(8)
+        t = FJLT(n, s, Context(seed=21), fut="wht")
+        X = _lattice(rng, (n, m))
+        full = np.asarray(t.fold_rows(X, 0, n))
+        split = (np.asarray(t.fold_rows(X[:300], 0, 300))
+                 + np.asarray(t.fold_rows(X[300:], 300, n)))
+        np.testing.assert_allclose(full, split, rtol=1e-5, atol=1e-5)
+        assert np.array_equal(
+            full, np.asarray(t.apply(X, sk.COLUMNWISE)))
+
+    def test_fold_rows_non_wht_rejected(self):
+        t = FJLT(256, 16, Context(seed=1), fut="dct")
+        with pytest.raises(UnsupportedError):
+            t.fold_rows(np.zeros((4, 2), np.float32), 0, 4)
+
+
+# ---------------------------------------------------------------------------
+# the Pallas in-kernel butterfly (interpret mode on the CPU mesh)
+# ---------------------------------------------------------------------------
+
+
+class TestPallasKernel:
+    @pytest.mark.parametrize("n,s,m", [(256, 16, 3), (4096, 64, 37)])
+    def test_kernel_bit_equal_to_xla_twin_dyadic(self, n, s, m):
+        """Bit-equality pins BOTH the butterfly arithmetic and the
+        in-kernel Threefry streams: one flipped Rademacher sign or one
+        swapped sample index would break it."""
+        rng = np.random.default_rng(n + s)
+        t = FJLT(n, s, Context(seed=31), fut="wht")
+        A = _lattice(rng, (m, n))
+        ker = np.asarray(pallas_fwht.srht_apply(
+            _kd(t), jnp.asarray(A), s_dim=s, rowwise=True,
+            interpret=True))
+        twin = np.asarray(_fjlt.srht_serve_apply(
+            _kd(t), jnp.asarray(A), s_dim=s, rowwise=True))
+        assert np.array_equal(ker, twin)
+
+    def test_kernel_columnwise_and_floats(self):
+        n, s, m = 1024, 128, 11
+        rng = np.random.default_rng(6)
+        t = FJLT(n, s, Context(seed=17), fut="wht")
+        A = rng.standard_normal((n, m)).astype(np.float32)
+        ker = np.asarray(pallas_fwht.srht_apply(
+            _kd(t), jnp.asarray(A), s_dim=s, rowwise=False,
+            interpret=True))
+        ref = np.asarray(t.apply(A, sk.COLUMNWISE))
+        np.testing.assert_allclose(ker, ref, rtol=1e-4, atol=1e-4)
+
+    def test_batched_lane_invariance(self):
+        """A lane out of a B=3 cohort is bit-equal to its own B=1
+        run — capacity never reaches per-lane arithmetic."""
+        n, s, m = 512, 32, 5
+        rng = np.random.default_rng(7)
+        kds = np.stack([_kd(FJLT(n, s, Context(seed=40 + i),
+                                 fut="wht")) for i in range(3)])
+        A = np.stack([_lattice(rng, (m, n)) for _ in range(3)])
+        out = np.asarray(pallas_fwht.srht_apply_batched(
+            kds, jnp.asarray(A), s_dim=s, rowwise=True,
+            interpret=True))
+        for i in range(3):
+            solo = np.asarray(pallas_fwht.srht_apply(
+                kds[i], jnp.asarray(A[i]), s_dim=s, rowwise=True,
+                interpret=True))
+            assert np.array_equal(out[i], solo)
+
+    def test_qualify_declines(self):
+        ok, why = pallas_fwht.qualify(16, 1000, 4, jnp.float32,
+                                      interpret=True)
+        assert not ok and "power of two" in why
+        ok, why = pallas_fwht.qualify(16, 64, 4, jnp.float32,
+                                      interpret=True)
+        assert not ok     # below one lane block
+        ok, why = pallas_fwht.qualify(4096, 8192, 4, jnp.float32,
+                                      interpret=True)
+        assert not ok and "cipher sweep" in why
+        ok, why = pallas_fwht.qualify(16, 1024, 4, jnp.bfloat16,
+                                      interpret=True)
+        assert not ok and "float32" in why
+        ok, why = pallas_fwht.qualify(16, 1024, 4, jnp.float32,
+                                      interpret=True)
+        assert ok
+
+
+# ---------------------------------------------------------------------------
+# serve integration: the SRHT sketch_apply family
+# ---------------------------------------------------------------------------
+
+
+class TestServeSRHT:
+    def test_capacity1_bit_equality_both_orientations(
+            self, fresh_engine):
+        rng = np.random.default_rng(11)
+        n, s = 1024, 256
+        t = FJLT(n, s, Context(seed=7), fut="wht")
+        with _executor() as ex:
+            A = _lattice(rng, (37, n))
+            out = np.asarray(ex.submit_sketch(
+                t, A, dimension=sk.ROWWISE).result(timeout=60))
+            assert np.array_equal(
+                out, np.asarray(t.apply(A, sk.ROWWISE)))
+            Ac = _lattice(rng, (n, 9))
+            outc = np.asarray(ex.submit_sketch(
+                t, Ac, dimension=sk.COLUMNWISE).result(timeout=60))
+            assert np.array_equal(
+                outc, np.asarray(t.apply(Ac, sk.COLUMNWISE)))
+            st = ex.stats()["fwht"]
+            assert st["by_backend"]["xla"]["flushes"] == 2
+
+    def test_cohort_lane_matches_capacity1(self, fresh_engine):
+        rng = np.random.default_rng(12)
+        n, s = 512, 64
+        ts = [FJLT(n, s, Context(seed=50 + i), fut="wht")
+              for i in range(4)]
+        ops = [_lattice(rng, (6, n)) for _ in range(4)]
+        with _executor(max_batch=4, linger_us=50000) as ex:
+            futs = [ex.submit_sketch(t, A, dimension=sk.ROWWISE)
+                    for t, A in zip(ts, ops)]
+            ex.flush()
+            batched = [np.asarray(f.result(timeout=60)) for f in futs]
+        with _executor(max_batch=1, linger_us=100) as ex1:
+            for t, A, got in zip(ts, ops, batched):
+                solo = np.asarray(ex1.submit_sketch(
+                    t, A, dimension=sk.ROWWISE).result(timeout=60))
+                assert np.array_equal(got, solo)
+
+    def test_nonpow2_rejected(self, fresh_engine):
+        t = FJLT(1000, 64, Context(seed=3), fut="wht")
+        with _executor() as ex:
+            with pytest.raises(ValueError, match="power-of-2"):
+                ex.submit_sketch(
+                    t, np.zeros((4, 1000), np.float32),
+                    dimension=sk.ROWWISE)
+
+    def test_non_wht_mixer_rejected(self, fresh_engine):
+        t = FJLT(1024, 64, Context(seed=3), fut="dct")
+        with _executor() as ex:
+            with pytest.raises(UnsupportedError):
+                ex.submit_sketch(t, np.zeros((4, 1024), np.float32),
+                                 dimension=sk.ROWWISE)
+
+    def test_zero_recompiles_after_warmup(self, fresh_engine):
+        rng = np.random.default_rng(13)
+        n, s = 512, 64
+        t = FJLT(n, s, Context(seed=19), fut="wht")
+        reqs = [_lattice(rng, (5, n)) for _ in range(8)]
+        with _executor(max_batch=8, linger_us=4000) as ex:
+            for cap in (1, 2, 4, 8):
+                futs = [ex.submit_sketch(t, A, dimension=sk.ROWWISE)
+                        for A in reqs[:cap]]
+                ex.flush()
+                [f.result(timeout=60) for f in futs]
+            m0, r0 = engine.stats().misses, engine.stats().recompiles
+            for _ in range(2):
+                futs = [ex.submit_sketch(t, A, dimension=sk.ROWWISE)
+                        for A in reqs]
+                ex.flush()
+                [f.result(timeout=60) for f in futs]
+            assert engine.stats().misses - m0 == 0
+            assert engine.stats().recompiles - r0 == 0
+
+    def test_pallas_pin_bit_equal_and_counted(self, fresh_engine,
+                                              monkeypatch):
+        """SKYLARK_FWHT_KERNEL=pallas routes the flush through the
+        interpret-mode kernel; dyadic input stays bit-equal and the
+        flush is attributed to the pallas backend."""
+        monkeypatch.setenv("SKYLARK_FWHT_KERNEL", "pallas")
+        rng = np.random.default_rng(14)
+        n, s = 4096, 256
+        t = FJLT(n, s, Context(seed=23), fut="wht")
+        A = _lattice(rng, (16, n))
+        with _executor() as ex:
+            out = np.asarray(ex.submit_sketch(
+                t, A, dimension=sk.ROWWISE).result(timeout=120))
+            st = ex.stats()["fwht"]
+        assert np.array_equal(out, np.asarray(t.apply(A, sk.ROWWISE)))
+        assert st["by_backend"]["pallas"]["flushes"] == 1
+
+    def test_min_n_decline(self, fresh_engine, monkeypatch):
+        """Below SKYLARK_FWHT_MIN_N a pallas intent declines (counted
+        reason) back to the XLA program."""
+        monkeypatch.setenv("SKYLARK_FWHT_KERNEL", "pallas")
+        rng = np.random.default_rng(15)
+        t = FJLT(1024, 64, Context(seed=29), fut="wht")
+        A = _lattice(rng, (4, 1024))
+        with _executor() as ex:
+            out = np.asarray(ex.submit_sketch(
+                t, A, dimension=sk.ROWWISE).result(timeout=60))
+            st = ex.stats()
+        assert np.array_equal(out, np.asarray(t.apply(A, sk.ROWWISE)))
+        assert st["fwht"]["by_backend"] == {"xla": {"flushes": 1}}
+        assert any("fwht-min-n" in k.replace("_", "-")
+                   for k in st["kernel"]["by_reason"])
+
+
+# ---------------------------------------------------------------------------
+# selection precedence for the SRHT family
+# ---------------------------------------------------------------------------
+
+
+class TestFWHTPrecedence:
+    def _flush_one(self, ex):
+        rng = np.random.default_rng(16)
+        t = FJLT(4096, 64, Context(seed=7), fut="wht")
+        A = rng.standard_normal((4, 4096)).astype(np.float32)
+        fut = ex.submit_sketch(t, A, dimension=sk.ROWWISE)
+        ex.flush()
+        fut.result(timeout=120)
+        (choice,) = ex._kernel_memo.values()
+        return choice
+
+    def test_arg_beats_env(self, fresh_engine, monkeypatch):
+        monkeypatch.setenv("SKYLARK_FWHT_KERNEL", "pallas")
+        with _executor(kernel="xla") as ex:
+            backend, _plan, source, declined = self._flush_one(ex)
+        assert (backend, source, declined) == ("xla", "arg", None)
+
+    def test_env_pin_resolves(self, fresh_engine, monkeypatch):
+        monkeypatch.setenv("SKYLARK_FWHT_KERNEL", "pallas")
+        prev = tune.set_cache(tune.PlanCache(path=None))
+        try:
+            with _executor() as ex:
+                backend, _plan, source, _d = self._flush_one(ex)
+        finally:
+            tune.set_cache(prev)
+        # interpret-mode pallas qualifies on the CPU mesh (the CI
+        # bit-equality leg); the pin is attributed to the env
+        assert source == "env"
+        assert backend == "pallas"
+
+    def test_fwht_pin_beats_general_serve_env(self, fresh_engine,
+                                              monkeypatch):
+        monkeypatch.setenv("SKYLARK_SERVE_KERNEL", "pallas")
+        monkeypatch.setenv("SKYLARK_FWHT_KERNEL", "xla")
+        with _executor() as ex:
+            backend, _plan, source, declined = self._flush_one(ex)
+        assert (backend, source, declined) == ("xla", "env", None)
+
+    def test_pin_invisible_to_cwt_buckets(self, fresh_engine,
+                                          monkeypatch):
+        monkeypatch.setenv("SKYLARK_FWHT_KERNEL", "pallas")
+        rng = np.random.default_rng(17)
+        t = CWT(512, 32, Context(seed=9))
+        A = rng.standard_normal((512, 4)).astype(np.float32)
+        prev = tune.set_cache(tune.PlanCache(path=None))
+        try:
+            with _executor() as ex:
+                fut = ex.submit_sketch(t, A, dimension=sk.COLUMNWISE)
+                ex.flush()
+                fut.result(timeout=60)
+                (choice,) = ex._kernel_memo.values()
+        finally:
+            tune.set_cache(prev)
+        assert choice[2] == "default"
+
+    def test_pin_outranks_pack_restore(self, fresh_engine,
+                                       monkeypatch):
+        statics = ("sketch_apply", "SRHT", "None", 64, True,
+                   "float32", (8, 4096))
+        with _executor() as ex:
+            monkeypatch.setenv("SKYLARK_FWHT_KERNEL", "xla")
+            assert not ex.restore_kernel_choice(statics, 4, "pallas")
+            monkeypatch.delenv("SKYLARK_FWHT_KERNEL")
+            assert ex.restore_kernel_choice(statics, 4, "pallas")
+
+    def test_ladder_has_mtile_candidates(self):
+        w = tune.serve_workload("sketch_apply", "SRHT", "float32",
+                                (512, 4096), 256, 4, rowwise=True)
+        cands = tune.enumerate_candidates(w)
+        mtiles = sorted(p.m_tile for p in cands
+                        if p.backend == "pallas")
+        assert mtiles == [128, 256, 512]
+        ranked = tune.rank_candidates(w)
+        assert ranked[0][0].backend == "xla"   # CPU host certifies xla
+        pallas_rec = next(c for p, c in ranked
+                          if p.backend == "pallas")
+        assert pallas_rec.get("interpret")
+
+
+# ---------------------------------------------------------------------------
+# compressed approximate matmul
+# ---------------------------------------------------------------------------
+
+
+class TestCompressedMatmul:
+    def test_srht_dense_within_bound(self, fresh_engine):
+        rng = np.random.default_rng(18)
+        n, m, p = 2048, 40, 17
+        t = FJLT(n, 512, Context(seed=11), fut="wht")
+        A = rng.standard_normal((m, n)).astype(np.float32)
+        B = rng.standard_normal((n, p)).astype(np.float32)
+        with _executor() as ex:
+            est, bound = ex.submit_compressed_matmul(
+                A, B, t).result(timeout=120)
+        est = np.asarray(est)
+        assert est.shape == (m, p)
+        err = np.linalg.norm(est - A @ B)
+        assert err <= bound
+        assert bound == pytest.approx(
+            np.linalg.norm(A) * np.linalg.norm(B)
+            * math.sqrt(2.0 / 512))
+
+    def test_cwt_dense_within_bound(self, fresh_engine):
+        rng = np.random.default_rng(19)
+        n, m, p = 1500, 30, 9            # non-pow2 contraction
+        t = CWT(n, 512, Context(seed=13))
+        A = rng.standard_normal((m, n)).astype(np.float32)
+        B = rng.standard_normal((n, p)).astype(np.float32)
+        with _executor() as ex:
+            est, bound = ex.submit_compressed_matmul(
+                A, B, t).result(timeout=120)
+        assert np.linalg.norm(np.asarray(est) - A @ B) <= bound
+
+    def test_sparse_cwt_bit_equal_to_densified(self, fresh_engine):
+        rng = np.random.default_rng(20)
+        n, m, p = 1500, 30, 9
+        t = CWT(n, 256, Context(seed=17))
+        A = sp.random(m, n, density=0.05, random_state=5,
+                      dtype=np.float32, format="csr")
+        B = rng.standard_normal((n, p)).astype(np.float32)
+        with _executor() as ex:
+            es, bs = ex.submit_compressed_matmul(
+                A, B, t).result(timeout=120)
+            ed, bd = ex.submit_compressed_matmul(
+                A.toarray(), B, t).result(timeout=120)
+        assert np.array_equal(np.asarray(es), np.asarray(ed))
+        assert bs == pytest.approx(bd)
+
+    def test_sparse_srht_matches_densified(self, fresh_engine):
+        rng = np.random.default_rng(21)
+        n, m, p = 2048, 30, 9
+        t = FJLT(n, 256, Context(seed=19), fut="wht")
+        A = sp.random(m, n, density=0.05, random_state=6,
+                      dtype=np.float32, format="csr")
+        B = rng.standard_normal((n, p)).astype(np.float32)
+        with _executor() as ex:
+            es, _ = ex.submit_compressed_matmul(
+                A, B, t).result(timeout=120)
+            ed, _ = ex.submit_compressed_matmul(
+                A.toarray(), B, t).result(timeout=120)
+        np.testing.assert_allclose(np.asarray(es), np.asarray(ed),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_default_transform_family_split(self, fresh_engine):
+        """No caller transform: SRHT on pow2 contraction, CWT
+        otherwise; the two front doors build bit-identical defaults."""
+        rng = np.random.default_rng(22)
+        with _executor() as ex:
+            A = rng.standard_normal((8, 1024)).astype(np.float32)
+            B = rng.standard_normal((1024, 3)).astype(np.float32)
+            est, _ = ex.submit_compressed_matmul(
+                A, B, s_dim=256, seed=4).result(timeout=120)
+            t = engine.serve.default_cmm_transform(A, s_dim=256,
+                                                   seed=4)
+            assert isinstance(t, FJLT)
+            est2, _ = ex.submit_compressed_matmul(
+                A, B, t).result(timeout=120)
+            assert np.array_equal(np.asarray(est), np.asarray(est2))
+            A2 = rng.standard_normal((8, 1000)).astype(np.float32)
+            assert isinstance(
+                engine.serve.default_cmm_transform(A2), CWT)
+
+    def test_unsupported_family_rejected(self, fresh_engine):
+        rng = np.random.default_rng(23)
+        t = sk.JLT(256, 32, Context(seed=3))
+        A = rng.standard_normal((4, 256)).astype(np.float32)
+        B = rng.standard_normal((256, 3)).astype(np.float32)
+        with _executor() as ex:
+            with pytest.raises(TypeError):
+                ex.submit_compressed_matmul(A, B, t)
+
+    def test_contraction_mismatch_rejected(self, fresh_engine):
+        t = CWT(256, 32, Context(seed=3))
+        with _executor() as ex:
+            with pytest.raises(ValueError):
+                ex.submit_compressed_matmul(
+                    np.zeros((4, 256), np.float32),
+                    np.zeros((128, 3), np.float32), t)
+
+    def test_submits_counted(self, fresh_engine):
+        rng = np.random.default_rng(24)
+        t = CWT(512, 64, Context(seed=31))
+        A = rng.standard_normal((4, 512)).astype(np.float32)
+        B = rng.standard_normal((512, 3)).astype(np.float32)
+        with _executor() as ex:
+            ex.submit_compressed_matmul(A, B, t).result(timeout=60)
+            st = ex.stats()["fwht"]
+        assert st["cm_submits"] == 1
+        assert engine.serve_stats()["fwht"]["cm_submits"] >= 1
+
+    def test_tune_workload_is_xla_only(self):
+        w = tune.serve_workload("compressed_matmul", "SRHT",
+                                "float32", (64, 2048), 512, 2,
+                                nnz=64)
+        cands = tune.enumerate_candidates(w)
+        assert [p.backend for p in cands] == ["xla"]
+        ranked = tune.rank_candidates(w)
+        assert ranked[0][1]["modeled_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# cross-subsystem dyadic regression: the dist shard fold and the
+# session appender ride the SAME panel-free fold_rows — both must stay
+# on the operator-panel oracle's bit pattern in the dyadic regime
+# ---------------------------------------------------------------------------
+
+
+class TestPanelFreeDistSessions:
+    def test_dist_srht_shards_bit_equal_dyadic(self):
+        """Ragged shard folds summed across shards equal the one-shot
+        apply bit for bit (n, s even powers of two + lattice data:
+        every partial is an exact dyadic rational, so the shard-order
+        summation is exact)."""
+        from libskylark_tpu.dist import plan as dp
+
+        n, s, d = 256, 16, 6
+        rng = np.random.default_rng(26)
+        A = _lattice(rng, (n, d))
+        plan = dp.ShardPlan(kind="srht", n=n, s_dim=s, d=d, seed=5,
+                            targets=0, shard_rows=48).validate()
+        sx = np.zeros((s, d), np.float32)
+        for i, _, _ in plan.shards():
+            sx = sx + dp.compute_shard(
+                plan, i, dp.ArraySource(A))["SX"]
+        t = FJLT(n, s, Context(seed=5), fut="wht")
+        assert np.array_equal(
+            sx, np.asarray(t.apply(jnp.asarray(A), sk.COLUMNWISE)))
+
+    def test_session_fold_bit_equal_to_dist_fold_dyadic(self, tmp_path):
+        """The sessions appender (cached full diagonal) and the dist
+        folder (per-slice streams) are twins — same bits at the same
+        offsets (the both-or-neither rule in sessions/state.py)."""
+        from libskylark_tpu import sessions
+        from libskylark_tpu.io.chunked import iter_array_batches
+
+        n, s, d = 256, 16, 6
+        rng = np.random.default_rng(27)
+        A = _lattice(rng, (n, d))
+        reg = sessions.SessionRegistry(directory=str(tmp_path))
+        sid = reg.open(sessions.SessionSpec(
+            kind="srht", n=n, s_dim=s, d=d, seed=5))
+        seq = 0
+        for Xb, _ in iter_array_batches(A, 40):
+            seq += 1
+            reg.append(sid, Xb, seq=seq)
+        out = reg.finalize(sid)
+        t = FJLT(n, s, Context(seed=5), fut="wht")
+        assert np.array_equal(
+            out["SX"],
+            np.asarray(t.apply(jnp.asarray(A), sk.COLUMNWISE)))
+
+
+# ---------------------------------------------------------------------------
+# telemetry surface
+# ---------------------------------------------------------------------------
+
+
+class TestTelemetry:
+    def test_prometheus_names(self, fresh_engine):
+        from libskylark_tpu import telemetry
+
+        rng = np.random.default_rng(25)
+        t = FJLT(1024, 64, Context(seed=3), fut="wht")
+        with _executor() as ex:
+            ex.submit_sketch(t, _lattice(rng, (4, 1024)),
+                             dimension=sk.ROWWISE).result(timeout=60)
+            tc = CWT(512, 64, Context(seed=5))
+            ex.submit_compressed_matmul(
+                rng.standard_normal((4, 512)).astype(np.float32),
+                rng.standard_normal((512, 3)).astype(np.float32),
+                tc).result(timeout=60)
+        text = telemetry.prometheus_text()
+        assert "skylark_serve_fwht_flushes_total" in text
+        assert "skylark_serve_compressed_matmul_submits_total" in text

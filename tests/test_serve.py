@@ -356,8 +356,14 @@ class TestBucketingAndCache:
             T = sk.JLT(32, 8, Context(seed=0))
             with pytest.raises(ValueError, match="input dim"):
                 ex.submit_sketch(T, np.zeros((31, 2), np.float32))
+            # FJLT serves panel-free since the SRHT tier, but only the
+            # Sylvester-Hadamard mixer has the closed form
+            with pytest.raises(sk_errors.UnsupportedError, match="wht"):
+                ex.submit_sketch(
+                    sk.FJLT(32, 8, Context(seed=1), fut="dct"),
+                    np.zeros((32, 2), np.float32))
             with pytest.raises(TypeError, match="dense"):
-                ex.submit_sketch(sk.FJLT(32, 8, Context(seed=1)),
+                ex.submit_sketch(sk.UST(32, 8, Context(seed=2)),
                                  np.zeros((32, 2), np.float32))
 
 
