@@ -424,6 +424,51 @@ DIST_HEDGE_DELAY_MS = declare(
         "shard task older than this is mirrored when "
         "``SKYLARK_DIST_HEDGE`` is on.")
 
+DIST_SERVE_PIPELINE = declare(
+    "SKYLARK_DIST_SERVE_PIPELINE", default=0, parser=parse_int,
+    kind="int", propagate=True,
+    doc="Pipeline depth of a dist-serve job (``submit_dist_sketch`` "
+        "and friends): the maximum concurrently outstanding shard "
+        "tasks per job. 0 (default) sizes the window automatically to "
+        "2x the fleet — deep enough that ingest, shard compute and "
+        "incremental merging overlap, while memory stays bounded at "
+        "``depth x`` one sketch-sized partial (docs/distributed).")
+
+DIST_SERVE_MERGE_FANIN = declare(
+    "SKYLARK_DIST_SERVE_MERGE_FANIN", default=8,
+    parser=parse_positive_int, kind="int", propagate=True,
+    doc="Merge fan-in of the incremental dist-serve merger: how many "
+        "ready pairwise-tree combines are folded per shard-completion "
+        "event. A scheduling knob only — the merge tree itself stays "
+        "the canonical pairwise reduction, so the merged bits never "
+        "depend on this value (docs/distributed).")
+
+DIST_SERVE_MIN_COVERAGE_INTERACTIVE = declare(
+    "SKYLARK_DIST_SERVE_MIN_COVERAGE_INTERACTIVE", default=1.0,
+    parser=parse_float, kind="float", propagate=True,
+    doc="Default ``min_coverage`` of interactive-class dist-serve "
+        "requests. Below 1.0 an interactive request may resolve "
+        "EARLY with a quantified ``DegradedSketchResult`` once "
+        "coverage reaches the gate and every unresolved shard has "
+        "already failed at least once — the latency-SLO trade "
+        "(docs/distributed, docs/qos). Per-call ``min_coverage=`` "
+        "overrides.")
+
+DIST_SERVE_MIN_COVERAGE_STANDARD = declare(
+    "SKYLARK_DIST_SERVE_MIN_COVERAGE_STANDARD", default=1.0,
+    parser=parse_float, kind="float", propagate=True,
+    doc="Default ``min_coverage`` of standard-class dist-serve "
+        "requests. Standard (batch) jobs never resolve early: the "
+        "storm runs to completion and the gate applies to the final "
+        "merge. Per-call ``min_coverage=`` overrides.")
+
+DIST_SERVE_MIN_COVERAGE_BEST_EFFORT = declare(
+    "SKYLARK_DIST_SERVE_MIN_COVERAGE_BEST_EFFORT", default=1.0,
+    parser=parse_float, kind="float", propagate=True,
+    doc="Default ``min_coverage`` of best_effort-class dist-serve "
+        "requests (gate applied to the final merge, no early "
+        "resolve). Per-call ``min_coverage=`` overrides.")
+
 FAULT_PLAN = declare(
     "SKYLARK_FAULT_PLAN", default=None, kind="json",
     doc="Deterministic fault-injection plan (inline JSON or a path); "
@@ -449,6 +494,17 @@ USE_PLAN_CACHE = declare(
     kind="flag",
     doc="Consult the plan cache at dispatch time (default on); "
         "``0`` disables all cached-plan consultation.")
+
+COST_CALIB = declare(
+    "SKYLARK_COST_CALIB", default=None, parser=parse_path_or_off,
+    kind="path",
+    doc="Measured calibration source for the analytic cost model "
+        "(``tune/cost.py``): a ``benchmarks/ledger.json``-format file "
+        "whose ``cost_calib_<rate>`` records (written by ``bench.py`` "
+        "modes) override the hand-set roofline rates for the matching "
+        "host class, with provenance tracked per rate. ``auto`` "
+        "resolves the repo ledger; unset or an off-word keeps the "
+        "pure analytic model (docs/performance).")
 
 # -- sparse serve operands (engine/serve.py, docs/serving) ------------------
 

@@ -26,15 +26,24 @@ the surviving rows whose coverage is *reported*, never silent.
 - :mod:`~libskylark_tpu.dist.algorithms` — distributed randomized SVD
   and sketched least-squares whose only cross-host traffic is the
   merged sketch.
+- :mod:`~libskylark_tpu.dist.serve` — the pipelined serve tier:
+  :class:`DistServeJob` behind the ``submit_dist_sketch`` /
+  ``submit_dist_lstsq`` / ``submit_dist_svd`` endpoints of
+  :class:`~libskylark_tpu.engine.serve.MicrobatchExecutor` and
+  :class:`~libskylark_tpu.fleet.Router` — incremental canonical
+  merging, per-QoS-class ``min_coverage`` gates with interactive
+  early resolve, tenant-billed retries/hedges, and content-addressed
+  caching of whole distributed jobs.
 
 Chaos-replayed by ``benchmarks/chaos_battery.py`` (the ``dist.shard``
 / ``dist.ingest`` / ``dist.merge`` fault sites) and CI-gated by
-``benchmarks/dist_smoke.py`` (a SIGKILLed process replica mid-storm:
-every shard reassigned, the merge bit-equal to the one-shot
-reference).
+``benchmarks/dist_smoke.py`` and ``benchmarks/dist_serve_smoke.py``
+(a SIGKILLed process replica mid-storm: every shard reassigned, the
+merge bit-equal to the one-shot reference).
 """
 
-from libskylark_tpu.dist.algorithms import randomized_svd, sketched_lstsq
+from libskylark_tpu.dist.algorithms import (lstsq_plan, randomized_svd,
+                                            sketched_lstsq, svd_plan)
 from libskylark_tpu.dist.coordinator import (DistSketchCoordinator,
                                              dist_stats)
 from libskylark_tpu.dist.plan import (ArraySource, DegradedSketchResult,
@@ -42,10 +51,16 @@ from libskylark_tpu.dist.plan import (ArraySource, DegradedSketchResult,
                                       LibsvmSource, ShardPlan,
                                       ShardSource, merge_partials,
                                       sketch_local)
+from libskylark_tpu.dist.serve import (DistServeJob, IncrementalMerger,
+                                       class_min_coverage,
+                                       dist_request_digest,
+                                       dist_serve_stats)
 
 __all__ = [
-    "ArraySource", "DegradedSketchResult", "DistSketchCoordinator",
-    "DistSketchResult", "HDF5Source", "LibsvmSource", "ShardPlan",
-    "ShardSource", "dist_stats", "merge_partials", "randomized_svd",
-    "sketch_local", "sketched_lstsq",
+    "ArraySource", "DegradedSketchResult", "DistServeJob",
+    "DistSketchCoordinator", "DistSketchResult", "HDF5Source",
+    "IncrementalMerger", "LibsvmSource", "ShardPlan", "ShardSource",
+    "class_min_coverage", "dist_request_digest", "dist_serve_stats",
+    "dist_stats", "lstsq_plan", "merge_partials", "randomized_svd",
+    "sketch_local", "sketched_lstsq", "svd_plan",
 ]

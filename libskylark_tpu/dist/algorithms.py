@@ -7,6 +7,12 @@ merged ``s_dim × d`` sketch — each replica streams its own row shards
 communication is proportional to sketch size, not data size. Both
 entry points ride the full fault-tolerance contract: retried shard
 tasks, quantified degraded merges, the ``min_coverage`` gate.
+
+The plan builders (:func:`svd_plan`, :func:`lstsq_plan`) are shared
+with the pipelined serve endpoints (``submit_dist_svd`` /
+``submit_dist_lstsq`` — :mod:`libskylark_tpu.dist.serve`), so the
+library call and the serve request of the same arguments sketch the
+same plan, hence the same bits and the same cache digest.
 """
 
 from __future__ import annotations
@@ -18,6 +24,42 @@ import numpy as np
 from libskylark_tpu.base import errors
 from libskylark_tpu.dist import plan as _plan
 from libskylark_tpu.dist.coordinator import DistSketchCoordinator
+
+
+def svd_plan(source: _plan.ShardSource, rank: int, *,
+             s_dim: Optional[int] = None, seed: int = 0,
+             kind: str = "jlt", shard_rows: int = 0) -> _plan.ShardPlan:
+    """The validated :class:`~libskylark_tpu.dist.plan.ShardPlan` of a
+    distributed randomized SVD: additive row sketch at
+    ``s_dim or max(2·rank, rank+8)`` (clamped to ``source.n``)."""
+    if rank < 1:
+        raise errors.InvalidParametersError(
+            f"rank must be >= 1, got {rank}")
+    s = int(s_dim) if s_dim else max(2 * int(rank), int(rank) + 8)
+    if kind not in _plan.ADDITIVE_KINDS:
+        raise errors.InvalidParametersError(
+            f"randomized_svd needs an additive sketch kind, got {kind!r}")
+    return _plan.ShardPlan(kind=kind, n=source.n,
+                           s_dim=min(s, source.n), d=source.d,
+                           seed=seed, shard_rows=shard_rows).validate()
+
+
+def lstsq_plan(source: _plan.ShardSource, *, s_dim: int, seed: int = 0,
+               kind: str = "cwt",
+               shard_rows: int = 0) -> _plan.ShardPlan:
+    """The validated joint-sketch plan of a distributed sketched
+    least-squares solve: the source must carry targets (``Y``)."""
+    if source.targets < 1:
+        raise errors.InvalidParametersError(
+            "sketched_lstsq needs a source with targets (Y rows)")
+    if kind not in _plan.ADDITIVE_KINDS:
+        raise errors.InvalidParametersError(
+            f"sketched_lstsq needs an additive sketch kind, got {kind!r}")
+    return _plan.ShardPlan(kind=kind, n=source.n,
+                           s_dim=min(int(s_dim), source.n),
+                           d=source.d, seed=seed,
+                           targets=source.targets,
+                           shard_rows=shard_rows).validate()
 
 
 def _run(plan: _plan.ShardPlan, source: _plan.ShardSource,
@@ -42,15 +84,8 @@ def randomized_svd(source: _plan.ShardSource, rank: int, *,
     ``Vt`` (top ``rank``), plus the merge's exact ``coverage`` and
     ``missing`` ranges — a degraded merge above ``min_coverage``
     yields the SVD *of the surviving rows' sketch*, labeled as such."""
-    if rank < 1:
-        raise errors.InvalidParametersError(f"rank must be >= 1, got {rank}")
-    s = int(s_dim) if s_dim else max(2 * int(rank), int(rank) + 8)
-    if kind not in _plan.ADDITIVE_KINDS:
-        raise errors.InvalidParametersError(
-            f"randomized_svd needs an additive sketch kind, got {kind!r}")
-    plan = _plan.ShardPlan(kind=kind, n=source.n, s_dim=min(s, source.n),
-                           d=source.d, seed=seed,
-                           shard_rows=shard_rows).validate()
+    plan = svd_plan(source, rank, s_dim=s_dim, seed=seed, kind=kind,
+                    shard_rows=shard_rows)
     res = _run(plan, source, coordinator, min_coverage)
     import jax.numpy as jnp
 
@@ -72,16 +107,8 @@ def sketched_lstsq(source: _plan.ShardSource, *,
     the row shards, solve the small ``s_dim × d`` problem locally.
     The source must carry targets (``Y``). Returns ``coef`` (d ×
     targets) plus the coverage accounting."""
-    if source.targets < 1:
-        raise errors.InvalidParametersError(
-            "sketched_lstsq needs a source with targets (Y rows)")
-    if kind not in _plan.ADDITIVE_KINDS:
-        raise errors.InvalidParametersError(
-            f"sketched_lstsq needs an additive sketch kind, got {kind!r}")
-    plan = _plan.ShardPlan(kind=kind, n=source.n,
-                           s_dim=min(int(s_dim), source.n), d=source.d,
-                           seed=seed, targets=source.targets,
-                           shard_rows=shard_rows).validate()
+    plan = lstsq_plan(source, s_dim=s_dim, seed=seed, kind=kind,
+                      shard_rows=shard_rows)
     res = _run(plan, source, coordinator, min_coverage)
     import jax.numpy as jnp
 
@@ -91,4 +118,4 @@ def sketched_lstsq(source: _plan.ShardSource, *,
             "degraded": res.degraded}
 
 
-__all__ = ["randomized_svd", "sketched_lstsq"]
+__all__ = ["lstsq_plan", "randomized_svd", "sketched_lstsq", "svd_plan"]

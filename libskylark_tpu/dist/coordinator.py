@@ -244,7 +244,8 @@ class DistSketchCoordinator:
         def task_payload(index: int) -> dict:
             lo, hi = plan.shard_range(index)
             return {"plan": plan_doc, "index": index,
-                    "source": source.subrange(lo, hi)}
+                    "source": _plan.source_to_wire(
+                        source.subrange(lo, hi))}
 
         def dispatch(index: int, *, hedge: bool = False,
                      exclude: Optional[str] = None) -> bool:

@@ -506,17 +506,50 @@ def compute_shard(plan: ShardPlan, index: int, source: ShardSource,
     return folder.partial()
 
 
+def source_to_wire(source: ShardSource):
+    """The cross-replica wire form of a (usually pre-sliced) source.
+
+    Plain in-memory :class:`ArraySource` instances flatten to a dict
+    whose row arrays sit directly in a top-level container — within the
+    shm transport's scan depth (:mod:`libskylark_tpu.fleet.shm`
+    recurses containers two levels), so a shard task dispatched to a
+    process replica ships its rows as zero-copy ring segments instead
+    of pickled bytes down the pipe. Everything else (range-readable
+    descriptors, test/source subclasses with overridden ``read``)
+    passes through unchanged and pickles as before."""
+    if type(source) is ArraySource:
+        wire = {"__kind__": "array_source", "offset": source._off,
+                "batch_rows": source.batch_rows, "X": source._X}
+        if source._Y is not None:
+            wire["Y"] = source._Y
+        return wire
+    return source
+
+
+def source_from_wire(obj) -> ShardSource:
+    """Inverse of :func:`source_to_wire` (identity for pass-throughs).
+    Decoded shm views arrive read-only; ``ArraySource`` never writes
+    its rows, so the view is used as-is — the zero-copy half of the
+    contract."""
+    if isinstance(obj, dict) and obj.get("__kind__") == "array_source":
+        return ArraySource(obj["X"], obj.get("Y"),
+                           batch_rows=int(obj["batch_rows"]),
+                           offset=int(obj["offset"]))
+    return obj
+
+
 def execute_task(payload: Mapping) -> dict:
     """The replica-side entry point of one shard task (the ``shard``
     verb of :class:`libskylark_tpu.fleet.Replica` lands here). The
     payload carries the serialized plan, the shard index, and the
     range-readable source (possibly pre-sliced to just this shard's
-    rows)."""
+    rows, possibly in :func:`source_to_wire` form)."""
     plan = ShardPlan.from_dict(payload["plan"])
     index = int(payload["index"])
     lo, hi = plan.shard_range(index)
+    source = source_from_wire(payload["source"])
     return {"index": index, "rows": hi - lo,
-            "partial": compute_shard(plan, index, payload["source"])}
+            "partial": compute_shard(plan, index, source)}
 
 
 # ---------------------------------------------------------------------------
@@ -674,4 +707,5 @@ __all__ = [
     "DistSketchResult", "HDF5Source", "KINDS", "LibsvmSource",
     "ShardPlan", "ShardSource", "build_result", "compute_shard",
     "execute_task", "merge_partials", "missing_ranges", "sketch_local",
+    "source_from_wire", "source_to_wire",
 ]

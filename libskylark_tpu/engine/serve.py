@@ -1132,6 +1132,12 @@ class MicrobatchExecutor:
                                            max_bytes=cache_bytes)
                        if cache else None)
         self._residency = _rcache.ResidencyTable(name=self.name)
+        # pipelined dist-serve endpoints (docs/distributed): the local
+        # no-fleet coordinator is built lazily; by-replica shard-task
+        # counts feed the serve_stats() dist block
+        self._dist_local_co = None
+        self._dist_by_replica: "collections.Counter" = \
+            collections.Counter()
 
         import queue as _queue
 
@@ -1485,6 +1491,187 @@ class MicrobatchExecutor:
         return self.submit("rlsc_predict", kernel=kernel, X_new=X_new,
                            X_train=X_train, coef=coef, coding=coding,
                            **kw)
+
+    # ------------------------------------------------------------------
+    # pipelined distributed serve endpoints (docs/distributed)
+    # ------------------------------------------------------------------
+
+    def _submit_dist(self, endpoint: str, plan, source, *,
+                     tenant=None, qos_class=None, min_coverage=None,
+                     deadline=None, timeout=None, request_id=None,
+                     pool=None, replicas=None, coordinator=None,
+                     pipeline=None, _digest=None, solve=None,
+                     digest_extra=()) -> Future:
+        """Common path of the dist endpoints: QoS admission, the
+        content-addressed fast paths (a dist result is a pure function
+        of (source digest, plan fingerprint, seed) — same digest, same
+        bits), then a :class:`~libskylark_tpu.dist.serve.DistServeJob`
+        driven on a daemon thread under a ``serve.submit`` span whose
+        request id parents every ``dist.shard_task`` span."""
+        from libskylark_tpu.dist import serve as _dserve
+        from libskylark_tpu.dist.coordinator import (
+            DistSketchCoordinator)
+
+        plan.validate()
+        if source.n < plan.n:
+            raise _errors.InvalidParametersError(
+                f"source holds {source.n} rows < plan.n={plan.n}")
+        rid = request_id
+        # QoS admission: same double-billing discipline as submit() —
+        # ``qos_class=`` marks a front-door-admitted request
+        if qos_class is None:
+            try:
+                tenant, qos_class = self._tenants.admit(tenant)
+            except _errors.TenantQuotaError as e:
+                _cls = self._tenants.resolve(tenant)[1]
+                with self._stats_lock:
+                    self._qos_counts[
+                        ("rate_limited", _cls, e.tenant)] += 1
+                _QOS_RATE_LIMITED.inc(
+                    **{"class": _cls, "tenant": e.tenant})
+                raise
+            tenant = self._tenants.accounting_name(tenant)
+        else:
+            qos_class = _qtenants.coerce_class(qos_class)
+            tenant = str(tenant) if tenant else ""
+        faults.check("qos.admit", tags=faults.current_tags(),
+                     detail=f"{endpoint} {tenant or '-'} {qos_class}")
+        with self._stats_lock:
+            self._counts["dist_jobs"] += 1
+        # the effective coverage gate is part of the request's identity:
+        # an interactive caller gating at 0.9 and a batch caller gating
+        # at 1.0 must never share a cache or single-flight key, or the
+        # batch caller could be handed a degraded answer its SLO forbids
+        gate = (_dserve.class_min_coverage(qos_class)
+                if min_coverage is None else float(min_coverage))
+        flight = None
+        cache_key = None
+        if self._cache is not None and not self._is_degraded():
+            cache_key = _digest or _dserve.dist_request_digest(
+                endpoint, plan, source,
+                extra=(*tuple(digest_extra), ("gate", gate)))
+            pinned = self._residency.result(cache_key)
+            if pinned is not None:
+                self._cache.note_hit(qos_class, pinned)
+                return self._bypass_future(qos_class, pinned)
+            hit = self._cache.lookup(cache_key, qos_class)
+            if hit is not _rcache.MISS:
+                return self._bypass_future(qos_class, hit)
+            follower = self._cache.join_flight(cache_key, qos_class)
+            if follower is not None:
+                with self._lock:
+                    self._sched.note_bypass(qos_class)
+                return follower
+        if rid is None and _telemetry.enabled():
+            rid = _trace.new_request_id()
+        fut: Future = Future()
+        with _trace.span("serve.submit", attrs={"endpoint": endpoint},
+                         request_id=rid) as sp:
+            co = coordinator
+            if co is None and (pool is not None
+                               or replicas is not None):
+                co = DistSketchCoordinator(pool=pool, replicas=replicas)
+            if co is None:
+                co = self._dist_local_co
+                if co is None:
+                    with self._lock:
+                        if self._dist_local_co is None:
+                            self._dist_local_co = \
+                                DistSketchCoordinator()
+                        co = self._dist_local_co
+            job = _dserve.DistServeJob(
+                plan, source, coordinator=co, qos_class=qos_class,
+                tenant=tenant, registry=self._tenants,
+                min_coverage=min_coverage,
+                deadline=deadline if deadline is not None else timeout,
+                pipeline=pipeline, request_id=rid,
+                parent_ctx=sp.context() if sp is not None else None)
+
+            def _settle(j, exc):
+                with self._stats_lock:
+                    self._counts["dist_completed" if exc is None
+                                 else "dist_failed"] += 1
+                    if j.stats.get("early_resolved"):
+                        self._counts["dist_early_resolves"] += 1
+                    for name, k in j.stats.get("by_replica",
+                                               {}).items():
+                        self._dist_by_replica[name] += k
+
+            if cache_key is not None:
+                flight = self._cache.lead_flight(cache_key, qos_class,
+                                                 fut)
+            try:
+                _dserve.run_job_into(job, fut, solve=solve,
+                                     on_done=_settle)
+            except BaseException as e:
+                if flight is not None:
+                    self._cache.abort_flight(flight, e)
+                raise
+            if flight is not None:
+                def _insert_ok(f) -> bool:
+                    # a degraded result is circumstance (which replicas
+                    # died this time), not content — never cache it;
+                    # settle_flight still shares it with in-flight
+                    # followers of the same gate+digest
+                    if self._is_degraded() or f.exception() is not None:
+                        return False
+                    v = f.result()
+                    if isinstance(v, dict):
+                        return not v.get("degraded")
+                    return not getattr(v, "degraded", False)
+
+                fut.add_done_callback(
+                    lambda f, _fl=flight: self._cache.settle_flight(
+                        _fl, f, insert=_insert_ok(f)))
+        return fut
+
+    def submit_dist_sketch(self, plan, source, **kw) -> Future:
+        """Pipelined distributed sketch: shard tasks of ``plan`` fan
+        across the coordinator's fleet (``pool=`` / ``replicas=`` /
+        ``coordinator=``; with none, a private thread pool pipelines
+        shard compute locally) and partials merge incrementally as
+        they land. Resolves to the
+        :class:`~libskylark_tpu.dist.plan.DistSketchResult` —
+        full-coverage bits equal to
+        :func:`~libskylark_tpu.dist.plan.sketch_local`. Per-class
+        ``min_coverage`` gates apply (docs/qos): interactive requests
+        may resolve early with a quantified
+        :class:`~libskylark_tpu.dist.plan.DegradedSketchResult`."""
+        return self._submit_dist("dist_sketch", plan, source, **kw)
+
+    def submit_dist_lstsq(self, source, *, s_dim: int, seed: int = 0,
+                          kind: str = "cwt", shard_rows: int = 0,
+                          **kw) -> Future:
+        """Distributed sketch-and-solve least squares
+        (:func:`~libskylark_tpu.dist.algorithms.sketched_lstsq` as a
+        serve endpoint): the joint ``[X | Y]`` sketch streams through
+        the fleet, only the local ``s_dim`` system solves here.
+        Resolves to the same ``{"coef", "coverage", "missing",
+        "degraded"}`` dict."""
+        from libskylark_tpu.dist import serve as _dserve
+        from libskylark_tpu.dist.algorithms import lstsq_plan
+
+        plan = lstsq_plan(source, s_dim=s_dim, seed=seed, kind=kind,
+                          shard_rows=shard_rows)
+        return self._submit_dist("dist_lstsq", plan, source,
+                                 solve=_dserve.solve_lstsq, **kw)
+
+    def submit_dist_svd(self, source, rank: int, *, s_dim=None,
+                        seed: int = 0, kind: str = "jlt",
+                        shard_rows: int = 0, **kw) -> Future:
+        """Distributed randomized SVD
+        (:func:`~libskylark_tpu.dist.algorithms.randomized_svd` as a
+        serve endpoint): resolves to the same ``{"singular_values",
+        "Vt", "coverage", "missing", "degraded"}`` dict."""
+        from libskylark_tpu.dist import serve as _dserve
+        from libskylark_tpu.dist.algorithms import svd_plan
+
+        plan = svd_plan(source, rank, s_dim=s_dim, seed=seed,
+                        kind=kind, shard_rows=shard_rows)
+        return self._submit_dist(
+            "dist_svd", plan, source,
+            solve=lambda r: _dserve.solve_svd(r, rank),
+            digest_extra=(("rank", int(rank)),), **kw)
 
     # ------------------------------------------------------------------
     # stateful sketch sessions (docs/sessions)
@@ -3660,6 +3847,7 @@ class MicrobatchExecutor:
             sp_sel = dict(sorted(self._sparse_kernel_sel.items()))
             sp_nnz = dict(sorted(self._sparse_nnz_hist.items()))
             fw_sel = dict(sorted(self._fwht_sel.items()))
+            dist_by = dict(self._dist_by_replica)
         with self._lock:
             queued = self._pending
         return {
@@ -3705,6 +3893,17 @@ class MicrobatchExecutor:
                 "by_backend": {k: {"flushes": int(v)}
                                for k, v in fw_sel.items()},
                 "cm_submits": c.get("cm_submits", 0),
+            },
+            # pipelined dist-serve jobs (docs/distributed): by_replica
+            # renders as skylark_dist_shard_tasks{replica="..."} — the
+            # shard placement skew surface
+            "dist": {
+                "jobs": c.get("dist_jobs", 0),
+                "completed": c.get("dist_completed", 0),
+                "failed": c.get("dist_failed", 0),
+                "early_resolves": c.get("dist_early_resolves", 0),
+                "by_replica": {k: {"shard_tasks": int(v)}
+                               for k, v in sorted(dist_by.items())},
             },
             "batch_capacity_hist": batch_hist,
             "cohort_size_hist": cohort_hist,
@@ -3832,6 +4031,9 @@ def serve_stats() -> dict:
     sparse_nnz: "collections.Counter" = collections.Counter()
     fwht_sel: "collections.Counter" = collections.Counter()
     cm_submits = 0
+    dist_sums: "collections.Counter" = collections.Counter(
+        {"jobs": 0, "completed": 0, "failed": 0, "early_resolves": 0})
+    dist_by: "collections.Counter" = collections.Counter()
     qos_blocks: list = []
     cache_blocks: list = []
     by_replica: dict = {}
@@ -3858,6 +4060,10 @@ def serve_stats() -> dict:
         for kk, vv in s["fwht"]["by_backend"].items():
             fwht_sel[kk] += vv["flushes"]
         cm_submits += s["fwht"]["cm_submits"]
+        for kk in ("jobs", "completed", "failed", "early_resolves"):
+            dist_sums[kk] += s["dist"][kk]
+        for kk, vv in s["dist"]["by_replica"].items():
+            dist_by[kk] += vv["shard_tasks"]
         qos_blocks.append(s["qos"])
         cache_blocks.append(s.get("cache"))
         states[s["state"]] += 1
@@ -3893,6 +4099,23 @@ def serve_stats() -> dict:
                        for k, v in sorted(fwht_sel.items())},
         "cm_submits": int(cm_submits),
     }
+    # dist-serve rollup (docs/distributed): executor job counters,
+    # fleet-wide shard placement, plus the process-lifetime rollups of
+    # the coordinator and the dist-serve driver (imported lazily —
+    # dist pulls the engine package, not the other way around)
+    agg["dist"] = {
+        **{k: int(dist_sums[k]) for k in
+           ("jobs", "completed", "failed", "early_resolves")},
+        "by_replica": {k: {"shard_tasks": int(v)}
+                       for k, v in sorted(dist_by.items())},
+    }
+    try:
+        from libskylark_tpu.dist.coordinator import dist_stats
+        from libskylark_tpu.dist.serve import dist_serve_stats
+        agg["dist"]["lifetime"] = {"coordinator": dist_stats(),
+                                   "serve": dist_serve_stats()}
+    except Exception:  # noqa: BLE001 — stats must never fail serving
+        pass
     agg["qos"] = _merge_qos_blocks(qos_blocks)
     agg["cache"] = _rcache.merge_cache_blocks(cache_blocks)
     agg["states"] = dict(sorted(states.items()))

@@ -504,12 +504,23 @@ def _worker_main(conn, name: str, executor_kwargs: dict,
                 # distributed-sketch shard task (docs/distributed):
                 # computed on a one-shot thread — ingest + eager folds
                 # must not stall the message loop (the same reasoning
-                # as session opens above). The ``dist.shard`` fault
-                # site fires INSIDE execute_task, in this process —
-                # which is how a ``crash`` spec in a victim child's
-                # SKYLARK_FAULT_PLAN delivers the deterministic
-                # kill -9 mid-storm.
-                def _shard_reply(rid=rid, task=msg[2]):
+                # as session opens above). In-memory shard rows ride
+                # the shm rings like submit operands (wire-flattened by
+                # dist.plan.source_to_wire); descriptor sources pickle.
+                # The ``dist.shard`` fault site fires INSIDE
+                # execute_task, in this process — which is how a
+                # ``crash`` spec in a victim child's SKYLARK_FAULT_PLAN
+                # delivers the deterministic kill -9 mid-storm.
+                task = msg[2]
+                if transport is not None:
+                    try:
+                        task = transport.decode(task)
+                    except Exception:
+                        transport.recover(task)
+                        flush_acks()
+                        raise
+
+                def _shard_reply(rid=rid, task=task):
                     from libskylark_tpu.dist.plan import execute_task
 
                     try:
@@ -813,10 +824,21 @@ class ProcessReplica(Replica):
         return self._send("unregister", str(ref))
 
     def shard(self, task: dict) -> Future:
-        # shard payloads ride the pickle pipe: the task is a plan +
-        # source descriptor (or one shard's rows), the reply an
-        # s_dim × d partial — both sketch-sized, not data-sized
-        return self._send("shard", task)
+        # a task is a plan + source descriptor (or one shard's rows)
+        # and the reply an s_dim × d partial — both sketch-sized, not
+        # data-sized. In-memory rows (wire-flattened ArraySources)
+        # ride the shm rings like submit operands; descriptors and the
+        # reply take the pickle pipe
+        if self._transport is None:
+            return self._send("shard", task)
+        self._flush_shm_acks()
+        payload, claimed = self._transport.encode(task)
+        try:
+            return self._send("shard", payload)
+        except BaseException:
+            # the header never left: the child will never ack these
+            self._transport.unclaim(claimed)
+            raise
 
     def queue_depth(self) -> int:
         # outstanding submits the parent knows about — no pipe

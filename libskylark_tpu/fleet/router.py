@@ -274,6 +274,11 @@ class Router:
         self._removed: set = set()
         self._counts = collections.Counter()
         self._by_replica = collections.Counter()
+        # pipelined dist-serve front door (docs/distributed): one
+        # shared shard coordinator over the pool, built lazily on the
+        # first dist submit — its ring is the shard placement truth
+        # for every dist job this router drives
+        self._dist_co = None
         # bounded-load ownership (consistent hashing with bounded
         # loads): a key's owner is the FIRST replica in its ring
         # preference order owning fewer than ceil(keys/replicas)
@@ -638,6 +643,162 @@ class Router:
             f"no replica accepted {endpoint!r}: tried "
             f"{list(order) or 'none (empty ring)'}"
         ) from last_err
+
+    # -- pipelined distributed serve (docs/distributed) ----------------
+
+    def _dist_coordinator(self):
+        with self._lock:
+            co = self._dist_co
+        if co is None:
+            from libskylark_tpu.dist.coordinator import (
+                DistSketchCoordinator)
+
+            co = DistSketchCoordinator(pool=self._pool)
+            with self._lock:
+                if self._dist_co is None:
+                    self._dist_co = co
+                else:
+                    co = self._dist_co
+        return co
+
+    def _submit_dist(self, endpoint: str, plan, source, *,
+                     tenant=None, qos_class=None, min_coverage=None,
+                     deadline=None, timeout=None, request_id=None,
+                     pipeline=None, solve=None,
+                     digest_extra=()) -> Future:
+        """Front door of one distributed job: admission + digest +
+        single-flight happen HERE, once; then the ring-preferred
+        replica with an in-process executor owns the job (its result
+        cache keys on the forwarded digest), or — process fleets —
+        the router drives the shard storm itself. Either way the
+        shard tasks fan across the whole pool through the shared
+        coordinator."""
+        from libskylark_tpu.dist import serve as _dserve
+
+        plan.validate()
+        if source.n < plan.n:
+            raise _errors.InvalidParametersError(
+                f"source holds {source.n} rows < plan.n={plan.n}")
+        if qos_class is not None:
+            qos_class = _qos.coerce_class(qos_class)
+            tenant = str(tenant) if tenant else ""
+        else:
+            try:
+                tenant, qos_class = _qos.get_registry().admit(tenant)
+            except _errors.TenantQuotaError as e:
+                _cls = _qos.get_registry().resolve(tenant)[1]
+                with self._lock:
+                    self._counts["rate_limited"] += 1
+                _serve._QOS_RATE_LIMITED.inc(
+                    **{"class": _cls, "tenant": e.tenant})
+                raise
+            tenant = _qos.get_registry().accounting_name(tenant)
+        rid = request_id
+        if rid is None and _telemetry.enabled():
+            rid = _trace.new_request_id()
+        with self._lock:
+            self._counts["dist_jobs"] += 1
+        co = self._dist_coordinator()
+        # the owning executor: first ring-preference member exposing
+        # an in-process executor (thread fleets). Digested once —
+        # the executor's cache and this front door share the key.
+        owner_ex = None
+        for name in self._ring.preference(("dist", plan.fingerprint())):
+            try:
+                ex = getattr(self._pool.get(name), "executor", None)
+            except KeyError:
+                continue
+            if ex is not None:
+                owner_ex = ex
+                break
+
+        def _dispatch(digest=None) -> Future:
+            if owner_ex is not None:
+                return owner_ex._submit_dist(
+                    endpoint, plan, source, tenant=tenant,
+                    qos_class=qos_class, min_coverage=min_coverage,
+                    deadline=deadline, timeout=timeout,
+                    request_id=rid, coordinator=co, pipeline=pipeline,
+                    _digest=digest, solve=solve,
+                    digest_extra=digest_extra)
+            with _trace.span("fleet.route",
+                             attrs={"endpoint": endpoint},
+                             request_id=rid) as sp:
+                job = _dserve.DistServeJob(
+                    plan, source, coordinator=co, qos_class=qos_class,
+                    tenant=tenant, registry=_qos.get_registry(),
+                    min_coverage=min_coverage,
+                    deadline=(deadline if deadline is not None
+                              else timeout),
+                    pipeline=pipeline, request_id=rid,
+                    parent_ctx=sp.context() if sp is not None
+                    else None)
+                fut: Future = Future()
+                _dserve.run_job_into(job, fut, solve=solve)
+            return fut
+
+        if self._flights is None:
+            return _dispatch()
+        # the effective coverage gate rides the digest (same rule as
+        # the executor front door): twins gating at 0.9 and 1.0 are
+        # different requests and must not coalesce into one flight
+        gate = (_dserve.class_min_coverage(qos_class)
+                if min_coverage is None else float(min_coverage))
+        digest = _dserve.dist_request_digest(
+            endpoint, plan, source,
+            extra=(*tuple(digest_extra), ("gate", gate)))
+        follower = self._flights.join(digest, qos_class)
+        if follower is not None:
+            with self._lock:
+                self._counts["coalesced"] += 1
+            return follower
+        flight = self._flights.lead(digest, qos_class)
+        try:
+            fut = _dispatch(digest)
+        except BaseException as e:
+            self._flights.abort(flight, e)
+            raise
+        fut.add_done_callback(
+            lambda f, _fl=flight: self._flights.settle(_fl, f))
+        return fut
+
+    def submit_dist_sketch(self, plan, source, **kw) -> Future:
+        """Pipelined distributed sketch through the fleet — see
+        :meth:`MicrobatchExecutor.submit_dist_sketch
+        <libskylark_tpu.engine.serve.MicrobatchExecutor
+        .submit_dist_sketch>`; the router is the QoS front door and
+        the single-flight tier, the pool is the shard fleet."""
+        return self._submit_dist("dist_sketch", plan, source, **kw)
+
+    def submit_dist_lstsq(self, source, *, s_dim: int, seed: int = 0,
+                          kind: str = "cwt", shard_rows: int = 0,
+                          **kw) -> Future:
+        """Distributed sketched least squares through the fleet (the
+        :func:`~libskylark_tpu.dist.algorithms.sketched_lstsq`
+        endpoint)."""
+        from libskylark_tpu.dist import serve as _dserve
+        from libskylark_tpu.dist.algorithms import lstsq_plan
+
+        plan = lstsq_plan(source, s_dim=s_dim, seed=seed, kind=kind,
+                          shard_rows=shard_rows)
+        return self._submit_dist("dist_lstsq", plan, source,
+                                 solve=_dserve.solve_lstsq, **kw)
+
+    def submit_dist_svd(self, source, rank: int, *, s_dim=None,
+                        seed: int = 0, kind: str = "jlt",
+                        shard_rows: int = 0, **kw) -> Future:
+        """Distributed randomized SVD through the fleet (the
+        :func:`~libskylark_tpu.dist.algorithms.randomized_svd`
+        endpoint)."""
+        from libskylark_tpu.dist import serve as _dserve
+        from libskylark_tpu.dist.algorithms import svd_plan
+
+        plan = svd_plan(source, rank, s_dim=s_dim, seed=seed,
+                        kind=kind, shard_rows=shard_rows)
+        return self._submit_dist(
+            "dist_svd", plan, source,
+            solve=lambda r: _dserve.solve_svd(r, rank),
+            digest_extra=(("rank", int(rank)),), **kw)
 
     # -- hedged requests (docs/fleet "Hedged requests") ----------------
 
@@ -1184,6 +1345,10 @@ class Router:
             "hedge_mismatches": c.get("hedge_mismatches", 0),
             "rate_limited": c.get("rate_limited", 0),
             "coalesced": c.get("coalesced", 0),
+            "dist_jobs": c.get("dist_jobs", 0),
+            "dist_coordinator": (self._dist_co.stats()
+                                 if self._dist_co is not None
+                                 else None),
             "single_flight": (self._flights.stats()
                               if self._flights is not None else None),
             "session_handoffs": c.get("session_handoffs", 0),

@@ -70,6 +70,11 @@ METRICS: Dict[str, str] = {
     "dist.shards_abandoned": "counter",
     "dist.merges": "counter",
     "dist.coverage": "gauge",
+    # pipelined dist-serve jobs (dist/serve.py, docs/distributed)
+    "dist.shard_tasks": "counter",
+    "dist.merge_depth": "gauge",
+    "dist.jobs": "counter",
+    "dist.early_resolves": "counter",
     # multi-tenant QoS (qos/tenants.py, qos/controller.py,
     # engine/serve.py — docs/qos)
     "qos.admitted": "counter",

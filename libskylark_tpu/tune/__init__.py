@@ -28,8 +28,9 @@ from typing import Optional
 
 from libskylark_tpu.tune.cache import (PlanCache, default_cache_path,
                                        get_cache, set_cache)
-from libskylark_tpu.tune.cost import (RATES, analyze_jitted, plan_cost,
-                                      rank_plans)
+from libskylark_tpu.tune.cost import (RATES, analyze_jitted,
+                                      effective_rates, plan_cost,
+                                      rank_plans, rate_provenance)
 from libskylark_tpu.tune.plans import (Plan, Workload, bucket_dim,
                                        current_device_kind,
                                        enumerate_candidates,
@@ -38,11 +39,12 @@ from libskylark_tpu.tune.plans import (Plan, Workload, bucket_dim,
 __all__ = [
     "Plan", "PlanCache", "Workload", "analyze_jitted", "autotune_topk",
     "bucket_dim", "current_device_kind", "default_cache_path",
-    "dense_workload", "enumerate_candidates", "fastfood_workload",
-    "get_cache", "hash_workload", "normalize_device_kind", "plan_cost",
-    "plan_for", "plan_fingerprint", "rank_candidates", "rank_plans",
-    "record_measurement", "record_ranked", "serve_workload",
-    "set_cache", "RATES",
+    "dense_workload", "effective_rates", "enumerate_candidates",
+    "fastfood_workload", "get_cache", "hash_workload",
+    "normalize_device_kind", "plan_cost", "plan_for",
+    "plan_fingerprint", "rank_candidates", "rank_plans",
+    "rate_provenance", "record_measurement", "record_ranked",
+    "serve_workload", "set_cache", "RATES",
 ]
 
 

@@ -23,15 +23,23 @@ Two complementary models live here:
 Absolute times from the analytic model are NOT predictions — only the
 ORDERING is consumed (rank the candidates, measure the top-k in a live
 window). The rate constants are v5e headline figures; override via the
-``RATES`` mapping for other parts. Ranking is deterministic: stable
-sort on (modeled seconds, plan_id).
+``RATES`` mapping for other parts, or let measured ``cost_calib_*``
+records from ``benchmarks/ledger.json`` recalibrate them per host
+class (:func:`effective_rates` / ``SKYLARK_COST_CALIB`` — provenance
+per rate via :func:`rate_provenance`, analytic fallback whenever no
+measurement exists). Ranking is deterministic: stable sort on
+(modeled seconds, plan_id).
 """
 
 from __future__ import annotations
 
+import json
 import math
+import os
 from typing import Optional, Sequence
 
+from libskylark_tpu.base import env as _env
+from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.tune.plans import (FASTFOOD_OPS, HASH_OPS,
                                        SERVE_DENSE_FAMILIES, SERVE_OPS,
                                        SPARSE_SERVE_OPS, Plan, Workload,
@@ -99,6 +107,155 @@ MXU_PASSES = {"bf16": 1, "bf16gen2": 2, "bf16x3": 3, "f32": 6}
 _FASTFOOD_TRAFFIC_X = {"fused": 1.0, "split": 3.0, "xla_chain": 9.0}
 
 
+# --------------------------------------------------------------------------
+# measured calibration: ledger records -> per-rate constants
+# --------------------------------------------------------------------------
+#
+# ``bench.py`` modes append ``cost_calib_<rate>`` records to
+# ``benchmarks/ledger.json`` (e.g. ``cost_calib_scatter_rows_per_s``
+# from the timed scatter microbench in ``--dist-serve``). When
+# ``SKYLARK_COST_CALIB`` points at such a ledger (``auto`` = the repo
+# copy), :func:`effective_rates` overlays those measurements on the
+# analytic ``RATES`` — but ONLY records whose ``host_class`` matches
+# this host (same platform + core-count formula as the ledger writer):
+# a rate measured on a 16-core TPU runner must never recalibrate a
+# 1-core CPU ranking. Latest matching record wins. Every rate carries
+# provenance (:func:`rate_provenance`): ``analytic`` until a
+# measurement says otherwise, so rankings only move when a measured
+# number moved them — the property the tune tests pin.
+
+# sentinel: "resolve the path from the env knob" (distinct from None,
+# which callers may pass to mean "no calibration, pure RATES")
+_CALIB_AUTO = object()
+
+_calib_lock = _locks.make_lock("tune.cost.calib")
+_calib_cache: dict = {}  # abspath -> (stat_sig, overlay, provenance)
+
+
+def _host_class() -> str:
+    """This host's comparability class — the exact formula
+    ``bench.py._ledger_append`` stamps on every record."""
+    try:
+        import jax
+
+        plat = jax.default_backend()
+    except Exception:  # noqa: BLE001 — classification, not a gate
+        plat = "unknown"
+    return f"{plat}-{os.cpu_count()}c"
+
+
+def _repo_ledger_path() -> str:
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, "benchmarks", "ledger.json")
+
+
+def _resolve_calib_path(path) -> Optional[str]:
+    if path is _CALIB_AUTO:
+        path = _env.COST_CALIB.get()
+    if path is None:
+        return None
+    if str(path).strip().lower() == "auto":
+        return _repo_ledger_path()
+    return str(path)
+
+
+def _read_calibration(path: str, host_class: str) -> tuple[dict, dict]:
+    """Parse one ledger file into ``(overlay, provenance)``. Tolerant
+    of junk lines (the ledger is telemetry); only ``cost_calib_<rate>``
+    records for a known rate, with a finite positive value and a
+    matching host class, participate. Later records shadow earlier
+    ones (latest measurement wins)."""
+    overlay: dict = {}
+    prov: dict = {}
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError:
+        return overlay, prov
+    for lineno, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        metric = str(rec.get("metric", ""))
+        if not metric.startswith("cost_calib_"):
+            continue
+        rate_name = metric[len("cost_calib_"):]
+        if rate_name not in RATES:
+            continue
+        if rec.get("host_class") != host_class:
+            continue
+        try:
+            value = float(rec.get("value"))
+        except (TypeError, ValueError):
+            continue
+        if not math.isfinite(value) or value <= 0.0:
+            continue
+        overlay[rate_name] = value
+        prov[rate_name] = {"source": "measured", "metric": metric,
+                           "value": value, "host_class": host_class,
+                           "path": path, "line": lineno}
+    return overlay, prov
+
+
+def _calibration(path) -> tuple[dict, dict]:
+    """(overlay, measured-provenance) for ``path`` (env-resolved when
+    the ``_CALIB_AUTO`` sentinel), memoized on the file's stat
+    signature so repeated rankings don't re-read the ledger but a
+    fresh bench append is picked up immediately."""
+    resolved = _resolve_calib_path(path)
+    if resolved is None:
+        return {}, {}
+    resolved = os.path.abspath(resolved)
+    try:
+        st = os.stat(resolved)
+        sig = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        sig = None
+    with _calib_lock:
+        hit = _calib_cache.get(resolved)
+        if hit is not None and hit[0] == sig:
+            return hit[1], hit[2]
+    if sig is None:
+        overlay, prov = {}, {}
+    else:
+        overlay, prov = _read_calibration(resolved, _host_class())
+    with _calib_lock:
+        _calib_cache[resolved] = (sig, overlay, prov)
+    return overlay, prov
+
+
+def effective_rates(path=_CALIB_AUTO) -> dict:
+    """The rate table rankings actually consume: analytic ``RATES``
+    overlaid with any matching measured ``cost_calib_*`` ledger
+    records. Default resolves the ledger from ``SKYLARK_COST_CALIB``
+    (unset → no overlay → exactly ``RATES``, so the analytic model is
+    the fallback whenever no measurement exists); pass an explicit
+    ledger path to calibrate from a specific file, or ``None`` for the
+    pure analytic table."""
+    overlay, _prov = _calibration(path)
+    rates = dict(RATES)
+    rates.update(overlay)
+    return rates
+
+
+def rate_provenance(path=_CALIB_AUTO) -> dict:
+    """Per-rate provenance for :func:`effective_rates` at the same
+    ``path``: ``{"source": "analytic"}`` for hand-set roofline
+    constants, else ``{"source": "measured", "metric", "value",
+    "host_class", "path", "line"}`` naming the ledger record that set
+    it."""
+    _overlay, prov = _calibration(path)
+    return {name: dict(prov.get(name, {"source": "analytic"}))
+            for name in RATES}
+
+
 def _dense_operator_cached(m: int, n: int, s: int, m_tile: int) -> bool:
     """Whether the kernel would serve this plan from the VMEM operator
     cache — the kernel's OWN decision logic and env-resolved budgets
@@ -122,8 +279,11 @@ def _dense_operator_cached(m: int, n: int, s: int, m_tile: int) -> bool:
 def plan_cost(w: Workload, p: Plan, rates: Optional[dict] = None) -> dict:
     """Modeled cost record for serving ``w`` with ``p``:
     ``{flops, bytes, gen_entries, modeled_s}``. See module doc — only
-    the ordering of ``modeled_s`` across plans is meaningful."""
-    rates = rates or RATES
+    the ordering of ``modeled_s`` across plans is meaningful. When
+    ``rates`` is None the table comes from :func:`effective_rates`
+    (analytic ``RATES`` unless ``SKYLARK_COST_CALIB`` names a ledger
+    with matching measured records)."""
+    rates = effective_rates() if rates is None else rates
     m, n, s = w.shape
     if w.op in FASTFOOD_OPS:
         return _fastfood_cost(w, p, rates)
@@ -388,7 +548,10 @@ def rank_plans(w: Workload, plans: Sequence[Plan],
                ) -> list[tuple[Plan, dict]]:
     """Deterministically rank ``plans`` for ``w``: ascending modeled
     seconds, ties broken by plan_id. The offline pre-ranking a live TPU
-    window's top-k measurement starts from."""
+    window's top-k measurement starts from. ``rates=None`` resolves
+    through :func:`effective_rates`, so a measured ``cost_calib_*``
+    ledger record can flip a ranking — and nothing else can."""
+    rates = effective_rates() if rates is None else rates
     scored = [(p, plan_cost(w, p, rates)) for p in plans]
     scored.sort(key=lambda pc: (pc[1]["modeled_s"], pc[0].plan_id()))
     return scored

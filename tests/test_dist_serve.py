@@ -1,0 +1,490 @@
+"""Pipelined dist-serve endpoints (libskylark_tpu/dist/serve,
+docs/distributed).
+
+The contract under test: ``submit_dist_sketch`` / ``submit_dist_lstsq``
+/ ``submit_dist_svd`` fan shard tasks through the fleet (or a private
+local pool) and merge partials incrementally AS THEY LAND, with
+
+- full-coverage bits equal to the one-shot ``sketch_local`` reference,
+  across every arrival order and merge fan-in (the eager tree IS the
+  canonical ``merge_partials`` tree);
+- per-class ``min_coverage`` SLOs: an interactive request may resolve
+  early with a quantified ``DegradedSketchResult`` (exact missing
+  ranges), its standard-class twin blocks for 1.0 and raises
+  ``SketchCoverageError`` when a shard is lost for good;
+- retries/hedges billed to the owning tenant's token bucket (first
+  attempts free; quota exhaustion degrades the job, never crashes it);
+- degraded results staying OUT of the content-addressed result cache,
+  and gates riding the request digest (a 0.9-gated and a 1.0-gated twin
+  never share a flight or cache entry);
+- ``dist.shard_task`` spans parented under the originating
+  ``serve.submit`` request id, and the stats/metrics rollups
+  (``dist.shard_tasks`` by_replica, ``dist_serve_stats``,
+  ``engine.serve_stats()["dist"]``).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from libskylark_tpu import engine, fleet, telemetry
+from libskylark_tpu.base import env as sk_env
+from libskylark_tpu.base import errors as sk_errors
+from libskylark_tpu.dist import plan as dp
+from libskylark_tpu.dist import serve as dserve
+from libskylark_tpu.dist.coordinator import DistSketchCoordinator
+from libskylark_tpu.qos import tenants as qtenants
+from libskylark_tpu.telemetry import metrics as tmetrics
+from libskylark_tpu.telemetry import trace as ttrace
+
+N, D, S_DIM, TARGETS = 120, 8, 16, 2
+SHARD_ROWS = 12          # 10 shards of 12 rows
+POISON = (108, 120)      # the last shard — see _PoisonSource
+
+
+@pytest.fixture(scope="module")
+def data():
+    # integer-valued float32: every partial sum is exact, so merged
+    # bits never depend on association even across DIFFERENT tree
+    # shapes (degraded-vs-zeroed-oracle comparisons below)
+    rng = np.random.default_rng(23)
+    X = rng.integers(-8, 9, size=(N, D)).astype(np.float32)
+    Y = rng.integers(-8, 9, size=(N, TARGETS)).astype(np.float32)
+    return X, Y
+
+
+def _plan(kind, **kw):
+    base = dict(kind=kind, n=N, s_dim=S_DIM, d=D, seed=5,
+                shard_rows=SHARD_ROWS)
+    base.update(kw)
+    return dp.ShardPlan(**base).validate()
+
+
+def _partials(plan, src):
+    return {i: dp.compute_shard(plan, i, src)
+            for i, _, _ in plan.shards()}
+
+
+class _PoisonSource(dp.ArraySource):
+    """In-memory rows whose ``[fail_lo, fail_hi)`` range permanently
+    fails to read with a retryable error — the shard that covers it can
+    never settle, on any replica, ever. Overrides ``subrange`` so the
+    poison survives the per-task slicing of the dispatch path."""
+
+    def __init__(self, X, Y=None, batch_rows=0, offset=0,
+                 fail=(0, 0)):
+        super().__init__(X, Y, batch_rows=batch_rows, offset=offset)
+        self._fail = tuple(fail)
+
+    def subrange(self, lo, hi):
+        base = super().subrange(lo, hi)
+        return _PoisonSource(base._X, base._Y,
+                             batch_rows=base.batch_rows, offset=lo,
+                             fail=self._fail)
+
+    def read(self, lo, hi):
+        flo, fhi = self._fail
+        if lo < fhi and hi > flo:
+            raise OSError(f"poisoned rows [{flo}, {fhi})")
+        return super().read(lo, hi)
+
+
+@pytest.fixture
+def executor():
+    engine.reset()
+    ex = engine.MicrobatchExecutor(max_batch=4, cache=True)
+    yield ex
+    ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the incremental merger: eager canonical tree
+# ---------------------------------------------------------------------------
+
+
+class TestIncrementalMerger:
+    @pytest.mark.parametrize("kind", dp.KINDS)
+    def test_full_coverage_bit_equal_any_order(self, kind, data):
+        X, Y = data
+        if kind == "srht":                 # WHT needs a pow2 extent
+            rng = np.random.default_rng(29)
+            X = rng.integers(-8, 9, size=(128, D)).astype(np.float32)
+            Y = rng.integers(-8, 9,
+                             size=(128, TARGETS)).astype(np.float32)
+            plan = _plan(kind, n=128, shard_rows=16, targets=TARGETS)
+        else:
+            plan = _plan(kind, targets=TARGETS)
+        src = dp.ArraySource(X, Y)
+        ref = dp.sketch_local(plan, src)
+        parts = _partials(plan, src)
+        orders = [sorted(parts), sorted(parts, reverse=True),
+                  random.Random(3).sample(sorted(parts), len(parts))]
+        for order in orders:
+            m = dserve.IncrementalMerger(plan)
+            for i in order:
+                m.add(i, parts[i])
+            res = m.result()
+            assert res.coverage == 1.0 and not res.degraded
+            assert np.array_equal(res.SX, ref.SX)
+            assert np.array_equal(res.SY, ref.SY)
+
+    def test_tree_shape_and_fanin_neutrality(self, data):
+        X, _ = data
+        plan = _plan("cwt")
+        parts = _partials(plan, dp.ArraySource(X))
+        results = []
+        for fanin in (1, 64):
+            m = dserve.IncrementalMerger(plan, fanin=fanin)
+            for i in parts:
+                m.add(i, parts[i])
+            results.append(m.result())
+            # 10 leaves: 9 pairwise combines, ceil(log2(10)) levels
+            assert m.merge_ops == plan.num_shards - 1
+            assert m.depth == 4
+        assert np.array_equal(results[0].SX, results[1].SX)
+
+    def test_duplicate_add_is_idempotent(self, data):
+        X, _ = data
+        plan = _plan("cwt")
+        src = dp.ArraySource(X)
+        parts = _partials(plan, src)
+        m = dserve.IncrementalMerger(plan)
+        for i in parts:
+            m.add(i, parts[i])
+            m.add(i, parts[i])         # a settled hedge twin
+        res = m.result()
+        assert res.rows_merged == N and res.coverage == 1.0
+        assert np.array_equal(res.SX, dp.sketch_local(plan, src).SX)
+
+    @pytest.mark.parametrize("kind", ["cwt", "ust"])
+    def test_degraded_merge_is_canonical_over_survivors(self, kind,
+                                                        data):
+        X, _ = data
+        plan = _plan(kind)
+        parts = _partials(plan, dp.ArraySource(X))
+        kept = {i: p for i, p in parts.items() if i != 4}
+        m = dserve.IncrementalMerger(plan)
+        for i in kept:
+            m.add(i, kept[i])
+        res = m.result()
+        assert isinstance(res, dp.DegradedSketchResult)
+        assert res.coverage == (N - SHARD_ROWS) / N
+        assert res.missing == ((48, 60),)
+        assert np.array_equal(res.SX,
+                              dp.merge_partials(plan, kept)["SX"])
+
+    def test_degraded_equals_zeroed_source_oracle(self, data):
+        # the satellite-3 identity: a merge missing the TAIL shard is
+        # bit-equal to the one-shot sketch of the same rows with the
+        # missing range zeroed (the zero partial adds exactly and the
+        # canonical trees coincide)
+        X, _ = data
+        plan = _plan("cwt")
+        parts = _partials(plan, dp.ArraySource(X))
+        m = dserve.IncrementalMerger(plan)
+        for i in parts:
+            if i != plan.num_shards - 1:
+                m.add(i, parts[i])
+        res = m.result()
+        Xz = X.copy()
+        Xz[POISON[0]:POISON[1]] = 0
+        oracle = dp.sketch_local(plan, dp.ArraySource(Xz))
+        assert res.degraded and res.missing == (POISON,)
+        assert np.array_equal(res.SX, oracle.SX)
+
+
+# ---------------------------------------------------------------------------
+# executor endpoints
+# ---------------------------------------------------------------------------
+
+
+class TestExecutorEndpoints:
+    def test_sketch_bit_equal_and_accounted(self, executor, data):
+        X, _ = data
+        plan = _plan("jlt")
+        src = dp.ArraySource(X)
+        ref = dp.sketch_local(plan, src)
+        c0 = engine.stats().compiles
+        res = executor.submit_dist_sketch(plan, src).result(timeout=120)
+        assert res.coverage == 1.0 and not res.degraded
+        assert np.array_equal(res.SX, ref.SX)
+        # shard tasks never touch the solver's executable cache
+        assert engine.stats().compiles == c0
+        d = executor.stats()["dist"]
+        assert d["jobs"] == 1 and d["completed"] == 1
+        assert d["by_replica"]["<local>"]["shard_tasks"] \
+            == plan.num_shards
+
+    def test_identical_resubmit_hits_result_cache(self, executor,
+                                                  data):
+        X, _ = data
+        plan = _plan("cwt")
+        src = dp.ArraySource(X)
+        r1 = executor.submit_dist_sketch(plan, src).result(timeout=120)
+        r2 = executor.submit_dist_sketch(plan, src).result(timeout=120)
+        assert np.array_equal(r1.SX, r2.SX)
+        d = executor.stats()["dist"]
+        assert d["jobs"] == 2 and d["completed"] == 1   # one ran
+        assert not r2.SX.flags.writeable      # shared, so frozen
+
+    def test_lstsq_endpoint_matches_local_factor(self, executor,
+                                                 data):
+        X, Y = data
+        from libskylark_tpu.dist.algorithms import lstsq_plan
+
+        src = dp.ArraySource(X, Y)
+        out = executor.submit_dist_lstsq(
+            src, s_dim=S_DIM, seed=5, kind="cwt",
+            shard_rows=SHARD_ROWS).result(timeout=120)
+        plan = lstsq_plan(src, s_dim=S_DIM, seed=5, kind="cwt",
+                          shard_rows=SHARD_ROWS)
+        ref = dserve.solve_lstsq(dp.sketch_local(plan, src))
+        assert out["coverage"] == 1.0 and not out["degraded"]
+        assert out["missing"] == []
+        np.testing.assert_allclose(out["coef"], ref["coef"],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_svd_endpoint_matches_local_factor(self, executor, data):
+        X, _ = data
+        from libskylark_tpu.dist.algorithms import svd_plan
+
+        src = dp.ArraySource(X)
+        rank = 3
+        out = executor.submit_dist_svd(
+            src, rank, seed=5, shard_rows=SHARD_ROWS).result(
+                timeout=120)
+        plan = svd_plan(src, rank, seed=5, shard_rows=SHARD_ROWS)
+        ref = dserve.solve_svd(dp.sketch_local(plan, src), rank)
+        assert out["singular_values"].shape == (rank,)
+        assert out["Vt"].shape == (rank, D)
+        np.testing.assert_allclose(out["singular_values"],
+                                   ref["singular_values"],
+                                   rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# router endpoints over a live thread fleet
+# ---------------------------------------------------------------------------
+
+
+class TestRouterFleet:
+    @pytest.fixture
+    def router(self):
+        engine.reset()
+        pool = fleet.ReplicaPool(2, backend="thread")
+        router = fleet.Router(pool)
+        yield router
+        router.close()
+        pool.shutdown()
+
+    def test_fleet_fanout_bit_equal(self, router, data):
+        X, _ = data
+        plan = _plan("cwt")
+        src = dp.ArraySource(X)
+        ref = dp.sketch_local(plan, src)
+        res = router.submit_dist_sketch(plan, src).result(timeout=120)
+        assert res.coverage == 1.0
+        assert np.array_equal(res.SX, ref.SX)
+        rs = router.stats()
+        assert rs["dist_jobs"] == 1
+        co = rs["dist_coordinator"]
+        assert co["dispatched"] == plan.num_shards
+        # every shard landed on a fleet member, none fell back local
+        assert set(co["by_replica"]) <= {"r0", "r1"}
+        assert sum(co["by_replica"].values()) == plan.num_shards
+
+
+# ---------------------------------------------------------------------------
+# per-class coverage SLOs + tenant billing (docs/qos)
+# ---------------------------------------------------------------------------
+
+
+class TestDegradedQoS:
+    def test_interactive_resolves_degraded_with_exact_missing(
+            self, executor, data):
+        X, _ = data
+        plan = _plan("cwt")
+        src = _PoisonSource(X, fail=POISON)
+        res = executor.submit_dist_sketch(
+            plan, src, qos_class="interactive", min_coverage=0.9,
+            coordinator=DistSketchCoordinator(retries=1)).result(
+                timeout=120)
+        assert isinstance(res, dp.DegradedSketchResult)
+        assert res.coverage == 0.9
+        assert res.missing == (POISON,)
+        # quantified AND exact: the surviving rows' sketch is bit-equal
+        # to the one-shot sketch with the lost range zeroed
+        Xz = X.copy()
+        Xz[POISON[0]:POISON[1]] = 0
+        oracle = dp.sketch_local(plan, dp.ArraySource(Xz))
+        assert np.array_equal(res.SX, oracle.SX)
+
+    def test_standard_twin_blocks_for_full_coverage(self, executor,
+                                                    data):
+        X, _ = data
+        plan = _plan("cwt")
+        src = _PoisonSource(X, fail=POISON)
+        fut = executor.submit_dist_sketch(
+            plan, src, qos_class="standard",
+            coordinator=DistSketchCoordinator(retries=1))
+        with pytest.raises(sk_errors.SketchCoverageError):
+            fut.result(timeout=120)
+        assert executor.stats()["dist"]["failed"] == 1
+
+    def test_degraded_result_never_enters_the_cache(self, executor,
+                                                    data):
+        X, _ = data
+        plan = _plan("cwt")
+        src = _PoisonSource(X, fail=POISON)
+        kw = dict(qos_class="interactive", min_coverage=0.9)
+        r1 = executor.submit_dist_sketch(
+            plan, src,
+            coordinator=DistSketchCoordinator(retries=1),
+            **kw).result(timeout=120)
+        assert r1.degraded
+        r2 = executor.submit_dist_sketch(
+            plan, src,
+            coordinator=DistSketchCoordinator(retries=1),
+            **kw).result(timeout=120)
+        assert r2.degraded
+        # both jobs RAN (no cached degraded bits were replayed)
+        d = executor.stats()["dist"]
+        assert d["jobs"] == 2 and d["completed"] == 2
+
+    def test_class_gate_env_knob(self, executor, data, monkeypatch):
+        monkeypatch.setenv(
+            "SKYLARK_DIST_SERVE_MIN_COVERAGE_INTERACTIVE", "0.9")
+        assert dserve.class_min_coverage("interactive") == 0.9
+        assert dserve.class_min_coverage("standard") == 1.0
+        assert dserve.class_min_coverage("no-such-class") == 1.0
+        X, _ = data
+        plan = _plan("cwt")
+        src = _PoisonSource(X, fail=POISON)
+        res = executor.submit_dist_sketch(
+            plan, src, qos_class="interactive",
+            coordinator=DistSketchCoordinator(retries=1)).result(
+                timeout=120)
+        assert res.degraded and res.coverage == 0.9
+
+    def test_retries_billed_quota_degrades_not_crashes(self, data):
+        X, _ = data
+        reg = qtenants.TenantRegistry()
+        # bucket of 2: the front-door admission takes one, the first
+        # re-execution of the poisoned shard takes the other; the
+        # second re-execution is refused and the shard abandons.
+        # standard class (not interactive) so no early resolve races
+        # the retry ladder — the billing sequence is deterministic
+        reg.register("acme", "standard", rate=1e-9, burst=2.0)
+        engine.reset()
+        ex = engine.MicrobatchExecutor(max_batch=4, cache=False,
+                                       tenants=reg)
+        try:
+            plan = _plan("cwt")
+            src = _PoisonSource(X, fail=POISON)
+            ss0 = dserve.dist_serve_stats()
+            res = ex.submit_dist_sketch(
+                plan, src, tenant="acme", min_coverage=0.9,
+                coordinator=DistSketchCoordinator(retries=4)).result(
+                    timeout=120)
+            assert res.degraded and res.coverage == 0.9
+            ss1 = dserve.dist_serve_stats()
+            assert ss1["retries_billed"] - ss0["retries_billed"] == 1
+            assert ss1["quota_stopped"] - ss0["quota_stopped"] == 1
+            # the bucket is empty: the NEXT request is refused at the
+            # front door, before any shard work
+            with pytest.raises(sk_errors.TenantQuotaError):
+                ex.submit_dist_sketch(plan, dp.ArraySource(X),
+                                      tenant="acme")
+        finally:
+            ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# observability: spans, metrics, rollups (docs/observability)
+# ---------------------------------------------------------------------------
+
+
+class TestObservability:
+    @pytest.fixture
+    def tracing(self):
+        was = telemetry.enabled()
+        telemetry.set_enabled(True)
+        ttrace.clear_finished()
+        yield
+        telemetry.set_enabled(was)
+
+    def test_shard_spans_parented_under_submit(self, tracing,
+                                               executor, data):
+        X, _ = data
+        plan = _plan("cwt")
+        executor.submit_dist_sketch(
+            plan, dp.ArraySource(X)).result(timeout=120)
+        spans = ttrace.finished_spans()
+        submits = [s for s in spans if s.name == "serve.submit"
+                   and s.attrs.get("endpoint") == "dist_sketch"]
+        assert len(submits) == 1
+        root = submits[0]
+        assert root.request_id and root.request_id.startswith("req-")
+        shard = [s for s in spans if s.name == "dist.shard_task"]
+        assert len(shard) == plan.num_shards
+        for s in shard:
+            assert s.trace_id == root.trace_id
+            assert s.parent_id == root.span_id
+            assert s.request_id == root.request_id
+            assert s.attrs["replica"] == "<local>"
+            assert s.attrs["outcome"] == "settled"
+
+    def test_metrics_and_lifetime_rollups(self, executor, data):
+        X, _ = data
+        plan = _plan("cwt")
+        executor.submit_dist_sketch(
+            plan, dp.ArraySource(X)).result(timeout=120)
+        snap = tmetrics.snapshot()
+        for name in ("dist.shard_tasks", "dist.merge_depth",
+                     "dist.jobs", "dist.early_resolves"):
+            assert name in snap["metrics"]
+        ss = snap["collectors"]["dist_serve"]
+        assert ss["jobs"] >= 1 and ss["shard_tasks"] >= plan.num_shards
+        assert ss["by_replica"].get("<local>", 0) >= plan.num_shards
+        assert ss["merge_depth_peak"] >= 1
+        assert ss["last_coverage"] == 1.0
+        agg = engine.serve_stats()
+        assert agg["dist"]["jobs"] >= 1
+        assert agg["dist"]["by_replica"]["<local>"]["shard_tasks"] \
+            >= plan.num_shards
+        life = agg["dist"]["lifetime"]
+        assert life["serve"]["jobs"] >= 1
+        assert "coordinator" in life
+
+
+# ---------------------------------------------------------------------------
+# env knobs (docs/env_vars table)
+# ---------------------------------------------------------------------------
+
+
+class TestEnvKnobs:
+    def test_defaults_and_propagation(self, monkeypatch):
+        for var in ("SKYLARK_DIST_SERVE_PIPELINE",
+                    "SKYLARK_DIST_SERVE_MERGE_FANIN",
+                    "SKYLARK_DIST_SERVE_MIN_COVERAGE_INTERACTIVE",
+                    "SKYLARK_DIST_SERVE_MIN_COVERAGE_STANDARD",
+                    "SKYLARK_DIST_SERVE_MIN_COVERAGE_BEST_EFFORT"):
+            monkeypatch.delenv(var, raising=False)
+            # replica children must see the same gates as the parent
+            assert sk_env.REGISTRY[var].propagate
+        assert sk_env.DIST_SERVE_PIPELINE.get() == 0
+        assert sk_env.DIST_SERVE_MERGE_FANIN.get() == 8
+        for cls in qtenants.CLASSES:
+            assert dserve.class_min_coverage(cls) == 1.0
+
+    def test_pipeline_depth_bounds_inflight(self, executor, data):
+        X, _ = data
+        plan = _plan("cwt")
+        res = executor.submit_dist_sketch(
+            plan, dp.ArraySource(X), pipeline=1).result(timeout=120)
+        assert res.coverage == 1.0
+        assert np.array_equal(
+            res.SX, dp.sketch_local(plan, dp.ArraySource(X)).SX)

@@ -528,3 +528,123 @@ class TestBenchFeedback:
         bench._record_plan_measurement({"kernel": False}, 8192, 8192,
                                        1024, 50.0)
         assert injected_cache.entries == {}
+
+
+class TestCostCalibration:
+    """Measured calibration of the analytic cost model (tune/cost.py):
+    ``cost_calib_<rate>`` ledger records overlay RATES for the matching
+    host class, with provenance; the analytic model is the fallback;
+    and calibration changes plan RANKING only when a measurement says
+    so."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh(self, monkeypatch):
+        from libskylark_tpu.tune import cost
+
+        monkeypatch.delenv("SKYLARK_COST_CALIB", raising=False)
+        cost._calib_cache.clear()
+        yield
+        cost._calib_cache.clear()
+
+    @staticmethod
+    def _ledger(tmp_path, records, name="ledger.json"):
+        p = tmp_path / name
+        p.write_text("\n".join(
+            r if isinstance(r, str) else json.dumps(r)
+            for r in records) + "\n")
+        return str(p)
+
+    def test_unset_knob_is_pure_analytic(self):
+        from libskylark_tpu.tune import cost
+
+        assert cost.effective_rates() == cost.RATES
+        prov = cost.rate_provenance()
+        assert set(prov) == set(cost.RATES)
+        assert all(v == {"source": "analytic"} for v in prov.values())
+
+    def test_overlay_latest_wins_host_filter_junk_tolerance(
+            self, tmp_path):
+        from libskylark_tpu.tune import cost
+
+        hc = cost._host_class()
+        path = self._ledger(tmp_path, [
+            "not json {",                                      # junk
+            {"metric": "cost_calib_scatter_rows_per_s",
+             "value": 1.0e9, "host_class": hc},                # older
+            {"metric": "cost_calib_scatter_rows_per_s",
+             "value": 7.7e8, "host_class": "tpu-v9-999c"},     # other host
+            {"metric": "cost_calib_scatter_rows_per_s",
+             "value": -5.0, "host_class": hc},                 # invalid
+            {"metric": "cost_calib_no_such_rate",
+             "value": 3.0, "host_class": hc},                  # unknown
+            {"metric": "dist_serve_fanout_speedup",
+             "value": 0.9, "host_class": hc},                  # not calib
+            {"metric": "cost_calib_scatter_rows_per_s",
+             "value": 2.5e9, "host_class": hc},                # winner
+        ])
+        rates = cost.effective_rates(path)
+        assert rates["scatter_rows_per_s"] == 2.5e9
+        # untouched rates stay analytic
+        assert rates["mxu_flops_per_s"] == cost.RATES["mxu_flops_per_s"]
+        prov = cost.rate_provenance(path)
+        m = prov["scatter_rows_per_s"]
+        assert m["source"] == "measured" and m["value"] == 2.5e9
+        assert m["host_class"] == hc and m["line"] == 7
+        assert prov["mxu_flops_per_s"] == {"source": "analytic"}
+
+    def test_ranking_flips_only_under_a_measurement(self, tmp_path,
+                                                    monkeypatch):
+        from libskylark_tpu.tune import cost
+
+        # the pinned workload: a huge-n hash sketch on tpu-v5e, where
+        # the analytic scatter rate (1.2e9 rows/s) makes the scatter-
+        # free pallas kernel win; a MEASURED scatter rate of 5e9 rows/s
+        # says this host scatters fast enough that XLA wins instead
+        w = tune.Workload(device_kind="tpu-v5e", op="hash_rowwise",
+                          transform="CWT", dtype="float32",
+                          shape=(32, 1 << 20, 256))
+        plans = [tune.Plan("xla"), tune.Plan("pallas")]
+        analytic = [p.backend for p, _ in cost.rank_plans(w, plans)]
+        assert analytic == ["pallas", "xla"]
+
+        # a measurement that AGREES with the analytic constant must
+        # not change the ranking — calibration is not a reshuffle
+        agree = self._ledger(tmp_path, [
+            {"metric": "cost_calib_scatter_rows_per_s",
+             "value": cost.RATES["scatter_rows_per_s"],
+             "host_class": cost._host_class()}], name="agree.json")
+        monkeypatch.setenv("SKYLARK_COST_CALIB", agree)
+        assert [p.backend
+                for p, _ in cost.rank_plans(w, plans)] == analytic
+
+        flip = self._ledger(tmp_path, [
+            {"metric": "cost_calib_scatter_rows_per_s",
+             "value": 5.0e9,
+             "host_class": cost._host_class()}], name="flip.json")
+        monkeypatch.setenv("SKYLARK_COST_CALIB", flip)
+        assert [p.backend for p, _ in cost.rank_plans(w, plans)] \
+            == ["xla", "pallas"]
+
+    def test_memo_invalidates_when_the_ledger_grows(self, tmp_path):
+        from libskylark_tpu.tune import cost
+
+        hc = cost._host_class()
+        path = self._ledger(tmp_path, [
+            {"metric": "cost_calib_scatter_rows_per_s",
+             "value": 2.0e9, "host_class": hc}])
+        assert cost.effective_rates(path)["scatter_rows_per_s"] == 2.0e9
+        with open(path, "a") as fh:
+            fh.write(json.dumps(
+                {"metric": "cost_calib_scatter_rows_per_s",
+                 "value": 3.0e9, "host_class": hc}) + "\n")
+        assert cost.effective_rates(path)["scatter_rows_per_s"] == 3.0e9
+
+    def test_missing_file_degrades_to_analytic(self, tmp_path,
+                                               monkeypatch):
+        from libskylark_tpu.tune import cost
+
+        monkeypatch.setenv("SKYLARK_COST_CALIB",
+                           str(tmp_path / "nope.json"))
+        assert cost.effective_rates() == cost.RATES
+        assert cost.rate_provenance()["scatter_rows_per_s"] \
+            == {"source": "analytic"}
