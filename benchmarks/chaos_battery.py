@@ -55,7 +55,15 @@ asserts the resilience subsystem's contract end to end:
   same-seed runs replay the identical fired sequence AND identical
   bits; a second, budget-exhausting plan forces abandonment and the
   leg asserts the degraded path's exact coverage arithmetic, missing
-  row ranges, and the ``min_coverage`` raise.
+  row ranges, and the ``min_coverage`` raise;
+- **preemptible training jobs** (the train leg, docs/training): a
+  sliced Block-ADMM KRR job through a 2-replica router with a seeded
+  ``train.slice`` fault fired BEFORE the slice's journaled append —
+  the manager's retry budget re-runs the exact same slice, the job
+  completes **bit-equal to the fault-free engine run** with zero
+  client-visible failures, the manager's retry counter equals the
+  fired-fault count, and two same-seed runs replay the identical
+  fired sequence.
 
 Usage: ``python benchmarks/chaos_battery.py --gate`` (script/ci wires
 ``JAX_PLATFORMS=cpu`` and the canned ``SKYLARK_FAULT_PLAN``). Prints
@@ -582,6 +590,116 @@ def _dist_leg(violations):
     }
 
 
+def _train_run(ops, plan_doc):
+    """One fixed-seed training-job episode (docs/training): a sliced
+    Block-ADMM KRR job through a 2-replica router with a seeded
+    ``train.slice`` fault — the fault fires BEFORE the slice's
+    journaled append, the manager's retry budget re-runs the exact
+    same slice, and the job completes. A single job means a single
+    flusher drains its slices sequentially, so the hit order — and
+    therefore the fired sequence — is deterministic by construction."""
+    import shutil
+    import tempfile
+
+    from libskylark_tpu import fleet
+    from libskylark_tpu.resilience import faults
+    from libskylark_tpu.train import TrainJobSpec
+
+    prev_dir = os.environ.get("SKYLARK_SESSION_DIR")
+    scratch = tempfile.mkdtemp(prefix="skylark_chaos_train_")
+    os.environ["SKYLARK_SESSION_DIR"] = scratch
+    pool = fleet.ReplicaPool(2, max_batch=4)
+    router = fleet.Router(pool)
+    try:
+        with faults.fault_plan(plan_doc) as plan:
+            fut = router.submit_train_job(
+                TrainJobSpec(solver="admm_krr", budget_iters=200,
+                             slice_iters=2,
+                             hyper={"num_features": 16,
+                                    "num_partitions": 2, "lam": 1e-2,
+                                    "seed": 3, "tol": 1e-3}).to_dict(),
+                operands=ops, session_id="train-chaos")
+            out, err = None, None
+            try:
+                out = fut.result(timeout=120.0)
+            except Exception as e:  # noqa: BLE001 — leg accounting
+                err = repr(e)
+            fired = list(plan.fired)
+        retries = sum((r.stats().get("train") or {}).get("retries", 0)
+                      for r in pool.replicas())
+        return {"out": out, "error": err, "fired": fired,
+                "retries": retries}
+    finally:
+        router.close()
+        pool.shutdown()
+        if prev_dir is None:
+            os.environ.pop("SKYLARK_SESSION_DIR", None)
+        else:
+            os.environ["SKYLARK_SESSION_DIR"] = prev_dir
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+def _train_leg(violations):
+    """Training jobs under chaos, twice with the same seed: the
+    injected slice fault must be absorbed by the retry budget (zero
+    client-visible failures), the trained coefficients must be
+    bit-equal to the uninterrupted no-chaos engine run, and two
+    same-seed runs must replay the identical fired sequence."""
+    from libskylark_tpu.train import make_engine
+
+    rng = np.random.default_rng(13)
+    X = rng.standard_normal((48, 6))
+    ops = {"X": X, "Y": (X[:, :1] > 0).astype(np.float64) * 2 - 1}
+    hyper = {"num_features": 16, "num_partitions": 2, "lam": 1e-2,
+             "seed": 3, "tol": 1e-3}
+    eng = make_engine("admm_krr", hyper, ops)
+    st, it = eng.init(), 0
+    while it < 200:
+        st = eng.step(st, 2)
+        it += 2
+        if eng.info(st)["converged"]:
+            break
+    ref = eng.result(st)
+
+    plan_doc = {"seed": 7, "faults": [
+        {"site": "train.slice", "error": "IOError_", "on_hit": 2}]}
+    rec1 = _train_run(ops, plan_doc)
+    rec2 = _train_run(ops, plan_doc)
+    for run, rec in (("run1", rec1), ("run2", rec2)):
+        if rec["error"] is not None:
+            violations.append(
+                f"train leg {run}: job failed instead of absorbing "
+                f"the injected slice fault: {rec['error']}")
+            continue
+        out = rec["out"]
+        if not out.get("converged"):
+            violations.append(f"train leg {run}: job did not converge")
+        if not np.array_equal(out["coef"], ref["coef"]):
+            violations.append(
+                f"train leg {run}: coefficients not bit-equal to the "
+                "fault-free engine run")
+        if rec["retries"] != len(rec["fired"]):
+            violations.append(
+                f"train leg {run}: {rec['retries']} manager retries "
+                f"for {len(rec['fired'])} fired fault(s) — the retry "
+                "budget and the plan disagree")
+    if not rec1["fired"]:
+        violations.append("train leg: plan injected nothing — inert")
+    if any(site != "train.slice" for site, _, _ in rec1["fired"]):
+        violations.append("train leg: unexpected site in fired log")
+    if rec1["fired"] != rec2["fired"]:
+        violations.append(
+            f"train leg: fired sequences differ across same-seed "
+            f"runs: {rec1['fired']} vs {rec2['fired']}")
+    return {
+        "fired": [list(f) for f in rec1["fired"]],
+        "retries": rec1["retries"],
+        "iterations": (None if rec1["out"] is None
+                       else rec1["out"]["iterations"]),
+        "deterministic": rec1["fired"] == rec2["fired"],
+    }
+
+
 def main() -> int:
     from libskylark_tpu import engine
     from libskylark_tpu.base import errors  # noqa: F401 — class names
@@ -663,6 +781,9 @@ def main() -> int:
     # -- dist leg: shard-crash storm + degraded-merge arithmetic --------
     dist_rec = _dist_leg(violations)
 
+    # -- train leg: injected slice fault -> retry-budget replay ---------
+    train_rec = _train_leg(violations)
+
     # -- lock-order witness (instrumented-lock mode) --------------------
     # With SKYLARK_LOCK_WITNESS=1 (the CI chaos gate sets it) every
     # lock the storm touched — executor state/stats/pub, engine cache,
@@ -712,6 +833,7 @@ def main() -> int:
         "hedge": hedge_rec,
         "sessions": session_rec,
         "dist": dist_rec,
+        "train": train_rec,
         "lock_witness": witness_rec,
         "violations": violations,
     }

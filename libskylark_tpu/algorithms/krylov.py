@@ -57,22 +57,26 @@ def _colnorms(X):
     return jnp.sqrt(jnp.sum(X * X, axis=0))
 
 
-@with_solver_precision
-def lsqr(
+def lsqr_parts(
     A: Operator,
     B: jnp.ndarray,
     params: Optional[KrylovParams] = None,
     precond: Optional[Precond] = None,
     shape: Optional[Tuple[int, int]] = None,
 ):
-    """Paige-Saunders LSQR for min ‖A·X − B‖ with optional right
-    preconditioner R (ref: algorithms/Krylov/LSQR.hpp:21-299): the iteration
-    runs on A·R and the solution accumulates in the original space via
-    Z = R·V, exactly as the reference threads ``R.apply``/``apply_adjoint``.
+    """The LSQR iteration taken apart: ``(state0, body, meta)``.
 
-    Returns (X, iterations). B may have k columns; each column has its own
-    scalar recurrence and stopping state.
-    """
+    ``state0`` is the initial carry (a dict of jnp arrays — everything
+    the recurrence needs, nothing more), ``body`` the pure
+    one-iteration transition ``state -> state``, and ``meta`` the
+    loop-free facts (``iter_lim``, ``squeeze``, ``extract`` pulling the
+    solution out of a carry). :func:`lsqr` runs body under the default
+    convergence cond; the train slice engines
+    (:mod:`libskylark_tpu.train.slices`) run the *same* body under a
+    bounded cond so a job advances k iterations per slice and the
+    carried state round-trips through checkpoints bit-equal. Both
+    paths share these parts by construction — a numerics change here
+    changes the one-shot solver and the sliced solver together."""
     params = params or KrylovParams()
     mv, rmv = _as_ops(A)
     R = precond or IdPrecond()
@@ -112,9 +116,6 @@ def lsqr(
         it=jnp.int32(0),
     )
 
-    def cond(s):
-        return (s["it"] < iter_lim) & (~jnp.all(s["done"]))
-
     def body(s):
         # Bidiagonalization step (ref: LSQR.hpp:114-135)
         U = mv(s["Z"]) - s["alpha"][None, :] * s["U"]
@@ -151,13 +152,38 @@ def lsqr(
             done=done, it=s["it"] + 1,
         )
 
-    out = lax.while_loop(cond, body, state)
-    X = out["X"][:, 0] if squeeze else out["X"]
-    return X, out["it"]
+    meta = dict(iter_lim=iter_lim, squeeze=squeeze,
+                extract=lambda s: s["X"][:, 0] if squeeze else s["X"])
+    return state, body, meta
 
 
 @with_solver_precision
-def cg(
+def lsqr(
+    A: Operator,
+    B: jnp.ndarray,
+    params: Optional[KrylovParams] = None,
+    precond: Optional[Precond] = None,
+    shape: Optional[Tuple[int, int]] = None,
+):
+    """Paige-Saunders LSQR for min ‖A·X − B‖ with optional right
+    preconditioner R (ref: algorithms/Krylov/LSQR.hpp:21-299): the iteration
+    runs on A·R and the solution accumulates in the original space via
+    Z = R·V, exactly as the reference threads ``R.apply``/``apply_adjoint``.
+
+    Returns (X, iterations). B may have k columns; each column has its own
+    scalar recurrence and stopping state.
+    """
+    state, body, meta = lsqr_parts(A, B, params, precond, shape)
+    iter_lim = meta["iter_lim"]
+
+    def cond(s):
+        return (s["it"] < iter_lim) & (~jnp.all(s["done"]))
+
+    out = lax.while_loop(cond, body, state)
+    return meta["extract"](out), out["it"]
+
+
+def cg_parts(
     A: Operator,
     B: jnp.ndarray,
     params: Optional[KrylovParams] = None,
@@ -165,8 +191,10 @@ def cg(
     X0: Optional[jnp.ndarray] = None,
     shape: Optional[Tuple[int, int]] = None,
 ):
-    """Preconditioned conjugate gradient for SPD A
-    (ref: algorithms/Krylov/CG.hpp:23). Returns (X, iterations)."""
+    """The CG iteration taken apart — see :func:`lsqr_parts` for the
+    contract. ``shape`` is accepted for signature symmetry (CG systems
+    are square; B fixes the size)."""
+    del shape
     params = params or KrylovParams()
     mv, _ = _as_ops(A)
     M = precond or IdPrecond()
@@ -190,9 +218,6 @@ def cg(
     state = dict(X=X, R=Rr, P=P, rz=rz, it=jnp.int32(0),
                  done=(_colnorms(Rr) <= tol * nrm_b))
 
-    def cond(s):
-        return (s["it"] < iter_lim) & (~jnp.all(s["done"]))
-
     def body(s):
         AP = mv(s["P"])
         pap = jnp.sum(s["P"] * AP, axis=0)
@@ -207,9 +232,30 @@ def cg(
         done = s["done"] | (_colnorms(Rr) <= tol * nrm_b)
         return dict(X=X, R=Rr, P=P, rz=rz_new, it=s["it"] + 1, done=done)
 
+    meta = dict(iter_lim=iter_lim, squeeze=squeeze,
+                extract=lambda s: s["X"][:, 0] if squeeze else s["X"])
+    return state, body, meta
+
+
+@with_solver_precision
+def cg(
+    A: Operator,
+    B: jnp.ndarray,
+    params: Optional[KrylovParams] = None,
+    precond: Optional[Precond] = None,
+    X0: Optional[jnp.ndarray] = None,
+    shape: Optional[Tuple[int, int]] = None,
+):
+    """Preconditioned conjugate gradient for SPD A
+    (ref: algorithms/Krylov/CG.hpp:23). Returns (X, iterations)."""
+    state, body, meta = cg_parts(A, B, params, precond, X0, shape)
+    iter_lim = meta["iter_lim"]
+
+    def cond(s):
+        return (s["it"] < iter_lim) & (~jnp.all(s["done"]))
+
     out = lax.while_loop(cond, body, state)
-    X = out["X"][:, 0] if squeeze else out["X"]
-    return X, out["it"]
+    return meta["extract"](out), out["it"]
 
 
 @with_solver_precision

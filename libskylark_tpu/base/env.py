@@ -384,6 +384,44 @@ SESSION_FSYNC_EVERY = declare(
         "(process-crash durable); the fsync cadence bounds what a "
         "whole-machine crash can lose. 1 = fsync every append.")
 
+# -- training jobs (libskylark_tpu/train) -----------------------------------
+
+TRAIN_SLICE_ITERS = declare(
+    "SKYLARK_TRAIN_SLICE_ITERS", default=8, parser=parse_positive_int,
+    kind="int", propagate=True,
+    doc="Default solver iterations per training slice — the unit of "
+        "preemption and checkpointing of a train job "
+        "(``libskylark_tpu.train``): a slice is never interrupted "
+        "mid-step, so this bounds both how long a job can occupy an "
+        "idle scheduler slot and how much work a crash can lose past "
+        "the last checkpoint. Per-job ``slice_iters`` overrides. "
+        "Propagated so process replicas slice identically.")
+
+TRAIN_RETRY_BUDGET = declare(
+    "SKYLARK_TRAIN_RETRY_BUDGET", default=3, parser=parse_int,
+    kind="int", propagate=True,
+    doc="How many failed slices a training job absorbs (requeue and "
+        "re-run from the journaled state) before the job fails "
+        "terminally. Crash-resume via a peer replica does not consume "
+        "this budget — it covers in-process slice errors.")
+
+TRAIN_CKPT_EVERY = declare(
+    "SKYLARK_TRAIN_CKPT_EVERY", default=4, parser=parse_positive_int,
+    kind="int", propagate=True,
+    doc="Checkpoint cadence of training jobs: every Nth slice "
+        "boundary writes the solver state through the session "
+        "checkpoint path, bounding a crashed replica's journal-replay "
+        "cost to at most N slices. 1 = checkpoint every slice.")
+
+TRAIN_DEADLINE_S = declare(
+    "SKYLARK_TRAIN_DEADLINE_S", default=600.0, parser=parse_float,
+    kind="float", propagate=True,
+    doc="Default wall-clock deadline in seconds for a training job "
+        "(QoS vocabulary: the job-level budget). A job past its "
+        "deadline fails with ``TrainBudgetExhaustedError`` at the "
+        "next slice boundary, reporting exact iterations completed. "
+        "Per-job ``deadline_s`` overrides.")
+
 # -- distributed sketching (libskylark_tpu/dist) ----------------------------
 
 DIST_SHARD_ROWS = declare(

@@ -147,6 +147,25 @@ class TenantQuotaError(SkylarkError):
         self.retry_after_s = float(retry_after_s)
 
 
+class TrainBudgetExhaustedError(SkylarkError):
+    """A training job ran out of its iteration budget or wall-clock
+    deadline before converging. Terminal for the job, but never
+    silent: the error carries ``iterations`` (exactly how many solver
+    iterations completed across all slices), ``residual`` (the last
+    observed convergence signal) and ``slices`` — the caller decides
+    whether to resubmit with a larger budget
+    (:mod:`libskylark_tpu.train`, docs/training)."""
+
+    code = 116
+
+    def __init__(self, message: str = "", *, iterations: int = 0,
+                 residual=None, slices: int = 0):
+        super().__init__(message)
+        self.iterations = int(iterations)
+        self.residual = None if residual is None else float(residual)
+        self.slices = int(slices)
+
+
 _CODE_TABLE = {
     cls.code: cls
     for cls in [
@@ -166,6 +185,7 @@ _CODE_TABLE = {
         SessionEvictedError,
         SketchCoverageError,
         TenantQuotaError,
+        TrainBudgetExhaustedError,
     ]
 }
 

@@ -313,6 +313,16 @@ def dispatch_loop(workq) -> None:
         ex._dispatch_cohort(*work)
 
 
+#: sentinel "bucket" for a training slice in the dispatch plumbing
+#: (docs/training): the flusher offers it to the deficit scheduler as
+#: best-effort backlog only when no higher class has pending work, and
+#: ``_dispatch_cohort`` routes it to the train manager instead of the
+#: cohort runner. One sentinel per flusher pass — at most one slice
+#: dispatches per scheduler decision, so training yields the moment
+#: real traffic arrives (preemption at slice boundaries, structurally).
+_TRAIN_KEY = object()
+
+
 def _percentile(sorted_vals: list, q: float) -> Optional[float]:
     if not sorted_vals:
         return None
@@ -1120,6 +1130,9 @@ class MicrobatchExecutor:
         # built lazily on the first session verb — one-shot serving
         # never pays the directory setup
         self._session_registry = None
+        # training jobs (docs/training): lazy like the registry — the
+        # flusher consults it only once a job has been submitted
+        self._train_mgr = None
         # content-addressed result cache + single-flight dedupe
         # (docs/caching): opt-in — the ctor argument wins, else the
         # SKYLARK_CACHE flag. The residency table exists regardless:
@@ -1783,10 +1796,76 @@ class MicrobatchExecutor:
         """Drain-path hook: checkpoint every live session synchronously
         (journal fsync + accumulator snapshot) so a peer resumes from
         state instead of a full journal replay. No-op when this
-        executor never opened a session."""
+        executor never opened a session. Training sessions are
+        sessions — a drain checkpoints them here, and the flusher has
+        already stopped offering their slices (the draining guard), so
+        a resuming peer continues bit-equal from this snapshot."""
         reg = self._session_registry
         if reg is not None:
             reg.checkpoint_all()
+
+    # -- training jobs (docs/training) ----------------------------------
+
+    @property
+    def train_jobs(self):
+        """This executor's :class:`~libskylark_tpu.train.jobs
+        .TrainManager` (built on first use, like :attr:`sessions`)."""
+        if self._train_mgr is None:
+            from libskylark_tpu.train.jobs import TrainManager
+
+            with self._lock:
+                if self._train_mgr is None:
+                    self._train_mgr = TrainManager(self)
+        return self._train_mgr
+
+    def _wake_flusher(self) -> None:
+        """Nudge the flusher: training work became runnable (submit,
+        resume, or a requeued slice) and the fast-path submit routes
+        never signal ``_work_cv`` for it."""
+        with self._lock:
+            self._work_cv.notify_all()
+
+    def submit_train_job(self, spec, operands: Optional[dict] = None,
+                         *, session_id: Optional[str] = None):
+        """Submit a training job (docs/training): the job's operands
+        and session open durably here, then its slices run as
+        best-effort work in idle scheduler slots. Returns a
+        :class:`~libskylark_tpu.train.jobs.TrainJobHandle` whose
+        future resolves to the trained model — or raises
+        :class:`~libskylark_tpu.base.errors.TrainBudgetExhaustedError`
+        with exact progress when the iteration/deadline budget runs
+        out first. Refused on a draining/stopped executor; shed (like
+        session appends) on a DEGRADED one — training is the
+        definitionally-preemptible class."""
+        with self._lock:
+            self._refuse_if_unavailable_locked()
+        if self._is_degraded():
+            with self._stats_lock:
+                self._counts["train_shed"] += 1
+            raise ServeOverloadedError(
+                "executor DEGRADED: train submits shed before "
+                "interactive traffic")
+        return self.train_jobs.submit(spec, operands=operands,
+                                      session_id=session_id)
+
+    def resume_train_job(self, session_id: str):
+        """Adopt a training job from its on-disk session (drain
+        handoff / crash replay) and continue running its slices here.
+        Same availability gates as submit."""
+        with self._lock:
+            self._refuse_if_unavailable_locked()
+        return self.train_jobs.resume(session_id)
+
+    def train_job_status(self, session_id: str) -> dict:
+        """Progress snapshot of a job live on this executor (raises
+        :class:`~libskylark_tpu.base.errors.SessionEvictedError` when
+        it is not)."""
+        mgr = self._train_mgr
+        if mgr is None:
+            raise _errors.SessionEvictedError(
+                f"train job {session_id!r} is not live on this "
+                "replica (no jobs were ever submitted here)")
+        return mgr.status(session_id)
 
     # -- result cache + operand residency (docs/caching) ---------------
 
@@ -2417,18 +2496,51 @@ class MicrobatchExecutor:
                     else:
                         w = b.oldest + linger - now
                         wait = w if wait is None else min(wait, w)
+                # training slices ride the same scheduler pass as
+                # best-effort backlog (docs/training) — but only when
+                # no higher class has pending work: idle slots feed
+                # training, a single interactive request displaces it
+                # at the next slice boundary
+                train_mgr = self._train_mgr
+                if (train_mgr is not None and not self._stop
+                        and not self._draining
+                        and train_mgr.has_runnable()):
+                    higher = any(
+                        self._class_pending.get(c, 0) > 0
+                        for c in _qtenants.CLASSES
+                        if c != _qtenants.BEST_EFFORT)
+                    if higher:
+                        train_mgr.note_deferred()
+                    elif _qtenants.BEST_EFFORT not in ready:
+                        ready[_qtenants.BEST_EFFORT] = _TRAIN_KEY
+                    if _TRAIN_KEY not in ready.values():
+                        # displaced (or a real best-effort bucket won
+                        # the slot): the fast-path submit does not
+                        # signal _work_cv, so poll for the idle window
+                        # instead of lingering indefinitely
+                        w = 0.05
+                        wait = w if wait is None else min(wait, w)
                 if ready:
                     backlog = {
-                        c: self._class_pending.get(c, 0)
+                        c: (self._class_pending.get(c, 0)
+                            + (1 if ready[c] is _TRAIN_KEY else 0))
                         for c in ready}
 
                     def cost(c):
+                        if ready[c] is _TRAIN_KEY:
+                            return 1
                         b0 = self._buckets[ready[c]]
                         return min(len(b0.reqs),
                                    self._bucket_cap_locked(b0.statics))
 
                     cls = self._sched.next_class(backlog, cost)
-                    if cls is not None:
+                    if cls is not None and ready[cls] is _TRAIN_KEY:
+                        job = train_mgr.claim_next()
+                        if job is not None:
+                            self._inflight += 1
+                            self._sched.charge(cls, 1)
+                            work = (_TRAIN_KEY, job)
+                    elif cls is not None:
                         work = self._pop_cohort_locked(ready[cls])
                         if work is not None:
                             self._sched.charge(cls, len(work[1]))
@@ -2446,6 +2558,18 @@ class MicrobatchExecutor:
         executor, with the last-resort exception fan and the in-flight
         bookkeeping — the single dispatch path shared by the worker
         threads and the synchronous :meth:`flush`."""
+        if bucket_obj is _TRAIN_KEY:
+            # a training slice: ``cohort`` is the claimed job. The
+            # manager resolves every outcome on the job future or
+            # requeues — no client futures to fan an exception to.
+            try:
+                mgr = self._train_mgr
+                if mgr is not None:
+                    mgr.run_slice(cohort)
+            finally:
+                with self._lock:
+                    self._cohort_done_locked()
+            return
         try:
             self._run_cohort(bucket_obj, cohort)
         except (KeyboardInterrupt, SystemExit):
@@ -3927,6 +4051,15 @@ class MicrobatchExecutor:
             "sessions": (self._session_registry.stats()
                          if self._session_registry is not None
                          else None),
+            # the training-job block (docs/training; None until the
+            # first submit — the cross-executor rollup is the "train"
+            # telemetry collector) plus the shed counter, which lives
+            # on the executor because shedding happens before the
+            # manager is consulted
+            "train": (dict(self._train_mgr.stats(),
+                           shed=c.get("train_shed", 0))
+                      if self._train_mgr is not None
+                      else None),
             # the result-cache block (docs/caching): None until the
             # cache is enabled or an operand is pinned; the "cache"
             # telemetry collector aggregates it across executors
@@ -3948,6 +4081,18 @@ class MicrobatchExecutor:
             self._flusher.join()
             for t in self._workers:
                 t.join()
+        # live training jobs are released, not failed: their sessions
+        # stay on disk (the drain hook checkpointed them) and each
+        # unresolved job future breaks retryably so a router's resume
+        # chain re-homes the job on a surviving replica
+        mgr = self._train_mgr
+        if mgr is not None:
+            try:
+                mgr.release_jobs(
+                    f"executor {self.name!r} stopped mid-job; the "
+                    "session remains on disk for a peer to resume")
+            except Exception:  # noqa: BLE001 — shutdown must finish
+                pass
         # sync the session journals WITHOUT deleting artifacts — a
         # peer (or a restarted process) resumes them from disk
         reg = self._session_registry
@@ -4034,6 +4179,12 @@ def serve_stats() -> dict:
     dist_sums: "collections.Counter" = collections.Counter(
         {"jobs": 0, "completed": 0, "failed": 0, "early_resolves": 0})
     dist_by: "collections.Counter" = collections.Counter()
+    _TRAIN_SUM = ("jobs_submitted", "slices_run", "preemptions",
+                  "resumes", "budget_exhausted", "completed", "failed",
+                  "retries", "active", "queued", "shed")
+    train_sums: "collections.Counter" = collections.Counter(
+        {k: 0 for k in _TRAIN_SUM})
+    train_seen = False
     qos_blocks: list = []
     cache_blocks: list = []
     by_replica: dict = {}
@@ -4064,6 +4215,10 @@ def serve_stats() -> dict:
             dist_sums[kk] += s["dist"][kk]
         for kk, vv in s["dist"]["by_replica"].items():
             dist_by[kk] += vv["shard_tasks"]
+        if s.get("train") is not None:
+            train_seen = True
+            for kk in _TRAIN_SUM:
+                train_sums[kk] += int(s["train"].get(kk, 0))
         qos_blocks.append(s["qos"])
         cache_blocks.append(s.get("cache"))
         states[s["state"]] += 1
@@ -4116,6 +4271,10 @@ def serve_stats() -> dict:
                                    "serve": dist_serve_stats()}
     except Exception:  # noqa: BLE001 — stats must never fail serving
         pass
+    # training-job rollup (docs/training): monotone counters and live
+    # occupancy SUM across replicas; None when no replica ever ran one
+    agg["train"] = ({k: int(train_sums[k]) for k in _TRAIN_SUM}
+                    if train_seen else None)
     agg["qos"] = _merge_qos_blocks(qos_blocks)
     agg["cache"] = _rcache.merge_cache_blocks(cache_blocks)
     agg["states"] = dict(sorted(states.items()))

@@ -132,6 +132,16 @@ class Replica:
         ``(seq, rows)``, ``finalize`` to the result dict)."""
         raise NotImplementedError
 
+    def train(self, op: str, /, **kwargs) -> Future:
+        """Training-job verb (docs/training): ``op`` is ``submit`` /
+        ``resume`` / ``status`` with the corresponding
+        ``MicrobatchExecutor`` train method's kwargs. ``submit`` and
+        ``resume`` resolve to the job's TERMINAL result (the trained
+        model dict, or the terminal error — slices run in the
+        replica's idle slots in between); ``status`` resolves to a
+        progress snapshot."""
+        raise NotImplementedError
+
     def shard(self, task: dict) -> Future:
         """Distributed-sketch shard-task verb (docs/distributed): the
         payload is :func:`libskylark_tpu.dist.plan.execute_task`'s —
@@ -221,6 +231,34 @@ class ThreadReplica(Replica):
         except (KeyboardInterrupt, SystemExit):
             raise
         except BaseException as e:  # noqa: BLE001 — resolve, don't leak
+            fut.set_exception(e)
+        return fut
+
+    def train(self, op: str, /, **kwargs) -> Future:
+        if op in ("submit", "resume"):
+            try:
+                if op == "submit":
+                    handle = self.executor.submit_train_job(
+                        kwargs.pop("spec"),
+                        operands=kwargs.pop("operands", None),
+                        **kwargs)
+                else:
+                    handle = self.executor.resume_train_job(**kwargs)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — resolve
+                fut: Future = Future()
+                fut.set_exception(e)
+                return fut
+            return handle.future
+        fut = Future()
+        try:
+            if op != "status":
+                raise ValueError(f"unknown train op {op!r}")
+            fut.set_result(self.executor.train_job_status(**kwargs))
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:  # noqa: BLE001 — resolve
             fut.set_exception(e)
         return fut
 
@@ -500,6 +538,39 @@ def _worker_main(conn, name: str, executor_kwargs: dict,
                     fut.add_done_callback(functools.partial(reply, rid))
                 else:
                     raise ValueError(f"unknown session op {op!r}")
+            elif kind == "train":
+                # training-job verbs (docs/training). submit/resume
+                # start on a one-shot thread — the operand persist +
+                # session open run fsyncs that must not stall the
+                # message loop (same reasoning as session opens) —
+                # and the reply fires only at the job's TERMINAL
+                # future (slices run in idle scheduler slots in
+                # between; a SIGKILL before then leaves the session
+                # on disk for a peer to resume)
+                op, kwargs = msg[2], msg[3]
+                if op == "status":
+                    send(("rpc", rid, ex.train_job_status(**kwargs)))
+                elif op in ("submit", "resume"):
+                    def _train_start(rid=rid, op=op, kwargs=kwargs):
+                        try:
+                            if op == "submit":
+                                h = ex.submit_train_job(
+                                    kwargs.pop("spec"),
+                                    operands=kwargs.pop(
+                                        "operands", None),
+                                    **kwargs)
+                            else:
+                                h = ex.resume_train_job(**kwargs)
+                            h.future.add_done_callback(
+                                functools.partial(reply, rid))
+                        except Exception as e:  # noqa: BLE001
+                            _send_exception(send, rid, e)
+
+                    threading.Thread(target=_train_start,
+                                     name=f"{name}-train",
+                                     daemon=True).start()
+                else:
+                    raise ValueError(f"unknown train op {op!r}")
             elif kind == "shard":
                 # distributed-sketch shard task (docs/distributed):
                 # computed on a one-shot thread — ingest + eager folds
@@ -802,6 +873,12 @@ class ProcessReplica(Replica):
         # session operands ride the pickle pipe (see _worker_main's
         # "session" branch); the child re-validates against its spec
         return self._send("session", op, kwargs)
+
+    def train(self, op: str, /, **kwargs) -> Future:
+        # train operands ride the pickle pipe like session appends —
+        # the child persists them to disk at submit anyway, so a
+        # zero-copy shm view buys nothing (docs/training)
+        return self._send("train", op, kwargs)
 
     def register_operand(self, A, transform=None, dimension=None,
                          **kwargs) -> Future:

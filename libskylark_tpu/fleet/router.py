@@ -1265,6 +1265,128 @@ class Router:
         except NoHealthyReplicaError:
             return None
 
+    # -- training jobs (docs/training) ---------------------------------
+
+    def _train_terminal(self, e: BaseException) -> bool:
+        # errors that END a train job: re-dispatching elsewhere cannot
+        # change the outcome (budget spent; session tombstoned or
+        # finished on a peer; spec bad)
+        return isinstance(e, (_errors.TrainBudgetExhaustedError,
+                              _errors.SessionEvictedError,
+                              _errors.InvalidParametersError))
+
+    def submit_train_job(self, spec, operands: Optional[dict] = None,
+                         *, session_id: Optional[str] = None) -> Future:
+        """Submit a preemptible training job to the fleet
+        (docs/training) and return a future for its TERMINAL result —
+        the trained model dict, or the terminal error
+        (:class:`~libskylark_tpu.base.errors.TrainBudgetExhaustedError`
+        with exact progress when the budget runs out first).
+
+        The job lands on the first healthy replica in the session
+        ring order for its id (a train job IS a session — same
+        key space, same affinity construction) and runs there as
+        best-effort slices. If that replica dies or refuses mid-job
+        (SIGKILL, drain, shed), the pending future breaks and this
+        router **resume-chains**: it dispatches ``train("resume")``
+        to the next candidate, which adopts the on-disk session —
+        fencing the old owner — and continues bit-equal from the last
+        acked slice. The client future survives the whole walk;
+        attempts are bounded at two passes over the pool."""
+        sid = str(session_id) if session_id \
+            else f"train-{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            self._counts["train_jobs"] += 1
+        return self._train_chain(sid, spec, operands, "submit")
+
+    def _train_chain(self, sid: str, spec, operands,
+                     initial_op: str) -> Future:
+        client: Future = Future()
+        tags = faults.current_tags()
+        budget = {"left": 2 * max(1, len(self._pool.names()))}
+
+        def _on_done(f: Future) -> None:
+            try:
+                result = f.result()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — chain/settle
+                if self._train_terminal(e) or budget["left"] <= 0:
+                    with self._lock:
+                        self._sessions.pop(sid, None)
+                    if not client.done():
+                        client.set_exception(e)
+                    return
+                # mid-job loss (dead pipe, drain refusal): the
+                # session is on disk — resume it on a surviving peer
+                _dispatch("resume", exclude=self.session_owner(sid))
+                return
+            with self._lock:
+                self._sessions.pop(sid, None)
+            if not client.done():
+                client.set_result(result)
+
+        def _dispatch(op: str, exclude: Optional[str] = None) -> None:
+            order = [n for n in self._session_candidates(sid)
+                     if n != exclude]
+            if exclude is not None and exclude in \
+                    self._session_candidates(sid):
+                order.append(exclude)   # last resort: it may be back
+            last_err: Optional[BaseException] = None
+            for name in order:
+                if budget["left"] <= 0:
+                    break
+                budget["left"] -= 1
+                try:
+                    faults.check("fleet.route", tags=tags,
+                                 detail=f"train:{op} {sid} -> {name}")
+                    if op == "submit":
+                        fut = self._pool.get(name).train(
+                            "submit", spec=spec, operands=operands,
+                            session_id=sid)
+                    else:
+                        fut = self._pool.get(name).train(
+                            "resume", session_id=sid)
+                        with self._lock:
+                            self._counts["train_resumes"] += 1
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except BaseException as e:  # noqa: BLE001 — failover
+                    last_err = e
+                    with self._lock:
+                        self._counts["failover"] += 1
+                    _FAILOVER.inc(replica=name)
+                    continue
+                self._note_session_owner(sid, name)
+                fut.add_done_callback(_on_done)
+                return
+            with self._lock:
+                self._sessions.pop(sid, None)
+            if not client.done():
+                client.set_exception(NoHealthyReplicaError(
+                    f"no replica accepted train {op!r} for {sid!r}: "
+                    f"tried {order}") if last_err is None
+                    else last_err)
+
+        _dispatch(initial_op)
+        return client
+
+    def resume_train_job(self, session_id: str) -> Future:
+        """Adopt an orphaned on-disk training job (e.g. after a full
+        fleet restart, when no live router holds its chain) and return
+        a future for its terminal result, resume-chaining across
+        replica deaths exactly like :meth:`submit_train_job`."""
+        return self._train_chain(str(session_id), None, None, "resume")
+
+    def train_job_status(self, session_id: str) -> dict:
+        """Progress snapshot from the job's current owner (raises
+        :class:`~libskylark_tpu.base.errors.SessionEvictedError` when
+        no replica has it live)."""
+        sid = str(session_id)
+        owner = self._session_owner(sid)
+        fut = self._pool.get(owner).train("status", session_id=sid)
+        return fut.result(timeout=30.0)
+
     # -- operand residency (docs/caching) ------------------------------
 
     def register_operand(self, A, transform=None, dimension=None,
@@ -1352,6 +1474,8 @@ class Router:
             "single_flight": (self._flights.stats()
                               if self._flights is not None else None),
             "session_handoffs": c.get("session_handoffs", 0),
+            "train_jobs": c.get("train_jobs", 0),
+            "train_resumes": c.get("train_resumes", 0),
             "sessions_assigned": len(self._sessions),
             "session_epoch": self._epoch,
             "session_epoch_hub_seq": self._epoch_hub_seq,

@@ -170,6 +170,131 @@ class BlockADMMSolver:
         start = self.starts[j]
         return X[:, start : start + self.block_sizes[j]]
 
+    # The iteration's three reusable parts. ``train`` composes them
+    # below; the train-job slice engine (libskylark_tpu/train/slices.py)
+    # composes the SAME parts into bounded k-iteration slices, so a
+    # sliced job and a foreground train() iterate identical math — a
+    # numerics change here changes both together.
+
+    def init_carry(self, n: int, k: int, dt) -> tuple:
+        """The zero consensus carry: (Wbar, O, Obar, nu, mu, mu_ij,
+        ZtObar_ij, del_o) — ref: BlockADMM.hpp:322-339."""
+        D = self.num_features
+        return (
+            jnp.zeros((D, k), dt),   # Wbar
+            jnp.zeros((k, n), dt),   # O
+            jnp.zeros((k, n), dt),   # Obar
+            jnp.zeros((k, n), dt),   # nu
+            jnp.zeros((D, k), dt),   # mu
+            jnp.zeros((D, k), dt),   # mu_ij
+            jnp.zeros((D, k), dt),   # ZtObar_ij
+            jnp.zeros((k, n), dt),   # del_o
+        )
+
+    def build_caches(self, X, dt, timer=None):
+        """Per-block Cholesky factorizations of (ZⱼᵀZⱼ + I) — the
+        hoisted iter-1 work of the reference (ref: :435-441). Returns
+        ``(cache_mats, cache_lowers, Zs)``: factor arrays (jit
+        arguments), static lower flags (closure constants), and the
+        cached Zⱼ when ``cache_transforms`` is on. Deterministic given
+        X and the maps' (seed, counter) — a resume rebuilds the same
+        bytes."""
+        cache_mats = []
+        cache_lowers = []
+        Zs = []
+        for j in range(len(self.block_sizes)):
+            if timer is not None:
+                with timer.phase("TRANSFORM"):
+                    Z = self._block_features(X, j)
+            else:
+                Z = self._block_features(X, j)
+            sj = self.block_sizes[j]
+
+            def _factor(Z=Z, sj=sj):
+                return jsl.cho_factor(Z.T @ Z + jnp.eye(sj, dtype=dt))
+
+            if timer is not None:
+                with timer.phase("FACTORIZATION"):
+                    c, low = _factor()
+            else:
+                c, low = _factor()
+            cache_mats.append(c)
+            cache_lowers.append(bool(low))
+            if self.cache_transforms:
+                Zs.append(Z)
+        return cache_mats, tuple(cache_lowers), Zs
+
+    def make_step(self, n: int, k: int, dt, cache_lowers: tuple):
+        """One consensus-ADMM iteration as a pure function
+        ``(carry, X, Y, cache_mats, Zs) -> (carry, (objective,
+        reldel))`` — ref: BlockADMM.hpp:291-600.
+
+        X/Y and every array derived from them (the cached block
+        factorizations, optionally the cached Zⱼ) are jit ARGUMENTS,
+        not closures: on a multi-host mesh they span non-addressable
+        devices, and jax forbids closing over such arrays (each would
+        be baked into the executable as a constant). Static flags
+        (cho lowers) stay in the closure."""
+        loss, reg = self.loss, self.regularizer
+        lam, rho = self.lam, self.rho
+        starts, sizes = self.starts, self.block_sizes
+        D = self.num_features
+        P = len(self.block_sizes)
+
+        def step(carry, X, Y, cache_mats, Zs):
+            Wbar, O, Obar, nu, mu, mu_ij, ZtObar_ij, del_o = carry
+
+            mu_ij = mu_ij - Wbar                     # ref: :378-380
+            Obar = Obar - nu
+            with jax.named_scope("PROXLOSS"):        # trace-visible phases
+                O = loss.prox(Obar, 1.0 / rho, Y)    # ref: :385
+                W = reg.prox(Wbar, lam / rho, mu)    # ref: :389
+
+            sum_o = jnp.zeros((k, n), dt)
+            wbar_output = jnp.zeros((k, n), dt)
+            Wi = jnp.zeros((D, k), dt)
+            new_mu_ij = mu_ij
+            new_ZtObar = ZtObar_ij
+
+            dsum = (del_o / (P + 1.0) + nu).T        # (n, k); ref: :464-469
+
+            # ZMULT phase of the reference — the per-block solves + gemms
+            for j in range(P):
+                start, sj = starts[j], sizes[j]
+                sl = slice(start, start + sj)
+                Z = Zs[j] if self.cache_transforms else self._block_features(X, j)
+                wbar_output = wbar_output + (Z @ Wbar[sl]).T
+                rhs = Wbar[sl] - mu_ij[sl] + ZtObar_ij[sl] + Z.T @ dsum
+                Wi_J = jsl.cho_solve(
+                    (cache_mats[j], cache_lowers[j]), rhs)  # ref: :475-476
+                o = (Z @ Wi_J).T                     # (k, n); ref: :478-480
+                new_mu_ij = new_mu_ij.at[sl].add(Wi_J)
+                new_ZtObar = new_ZtObar.at[sl].set(Z.T @ o.T)
+                Wi = Wi.at[sl].set(Wi_J)
+                sum_o = sum_o + o
+
+            sum_o = O - sum_o                        # ref: :505-507
+            del_o = sum_o
+            objective = loss.evaluate(wbar_output, Y) + lam * reg.evaluate(Wbar)
+
+            Obar = O - sum_o / (P + 1.0)             # ref: :566-568
+            nu = nu + O - Obar                       # ref: :570-571
+
+            # Consensus: single logical rank -> exact (W + Wi)/2
+            # (ref: :575-590 with MPI size P=1).
+            Wbar_new = (Wi + W) / 2.0
+            mu = mu + W - Wbar_new                   # ref: :586-589
+
+            reldel = jnp.linalg.norm(Wbar_new - Wbar) / jnp.maximum(
+                jnp.linalg.norm(Wbar_new), jnp.finfo(dt).tiny
+            )
+            return (
+                (Wbar_new, O, Obar, nu, mu, new_mu_ij, new_ZtObar, del_o),
+                (objective, reldel),
+            )
+
+        return step
+
     @with_solver_precision
     def train(
         self,
@@ -223,7 +348,6 @@ class BlockADMMSolver:
                 else int(jnp.max(Y)) + 1
             )
         D = self.num_features
-        P = len(self.block_sizes)  # feature-partition consensus count
         dt = X.dtype
 
         model = HilbertModel(
@@ -239,67 +363,6 @@ class BlockADMMSolver:
 
         loss, reg = self.loss, self.regularizer
         lam, rho = self.lam, self.rho
-        starts, sizes = self.starts, self.block_sizes
-
-        # X/Y and every array derived from them (the cached block
-        # factorizations, optionally the cached Zⱼ) are jit ARGUMENTS,
-        # not closures: on a multi-host mesh they span non-addressable
-        # devices, and jax forbids closing over such arrays (each would
-        # be baked into the executable as a constant). Static flags
-        # (cho lowers) stay in the closure.
-        def step(carry, X, Y, cache_mats, Zs):
-            Wbar, O, Obar, nu, mu, mu_ij, ZtObar_ij, del_o = carry
-
-            mu_ij = mu_ij - Wbar                     # ref: :378-380
-            Obar = Obar - nu
-            with jax.named_scope("PROXLOSS"):        # trace-visible phases
-                O = loss.prox(Obar, 1.0 / rho, Y)    # ref: :385
-                W = reg.prox(Wbar, lam / rho, mu)    # ref: :389
-
-            sum_o = jnp.zeros((k, n), dt)
-            wbar_output = jnp.zeros((k, n), dt)
-            Wi = jnp.zeros((D, k), dt)
-            new_mu_ij = mu_ij
-            new_ZtObar = ZtObar_ij
-
-            dsum = (del_o / (P + 1.0) + nu).T        # (n, k); ref: :464-469
-
-            # ZMULT phase of the reference — the per-block solves + gemms
-            for j in range(P):
-                start, sj = starts[j], sizes[j]
-                sl = slice(start, start + sj)
-                Z = Zs[j] if self.cache_transforms else self._block_features(X, j)
-                wbar_output = wbar_output + (Z @ Wbar[sl]).T
-                rhs = Wbar[sl] - mu_ij[sl] + ZtObar_ij[sl] + Z.T @ dsum
-                Wi_J = jsl.cho_solve(
-                    (cache_mats[j], cache_lowers[j]), rhs)  # ref: :475-476
-                o = (Z @ Wi_J).T                     # (k, n); ref: :478-480
-                new_mu_ij = new_mu_ij.at[sl].add(Wi_J)
-                new_ZtObar = new_ZtObar.at[sl].set(Z.T @ o.T)
-                Wi = Wi.at[sl].set(Wi_J)
-                sum_o = sum_o + o
-
-            sum_o = O - sum_o                        # ref: :505-507
-            del_o = sum_o
-            objective = loss.evaluate(wbar_output, Y) + lam * reg.evaluate(Wbar)
-
-            Obar = O - sum_o / (P + 1.0)             # ref: :566-568
-            nu = nu + O - Obar                       # ref: :570-571
-
-            # Consensus: single logical rank -> exact (W + Wi)/2
-            # (ref: :575-590 with MPI size P=1).
-            Wbar_new = (Wi + W) / 2.0
-            mu = mu + W - Wbar_new                   # ref: :586-589
-
-            reldel = jnp.linalg.norm(Wbar_new - Wbar) / jnp.maximum(
-                jnp.linalg.norm(Wbar_new), jnp.finfo(dt).tiny
-            )
-            return (
-                (Wbar_new, O, Obar, nu, mu, new_mu_ij, new_ZtObar, del_o),
-                (objective, reldel),
-            )
-
-        step_jit = jax.jit(step)
 
         def _on_data_devices(arrs):
             """Replicate the consensus state onto X's device set (the
@@ -319,16 +382,7 @@ class BlockADMMSolver:
                 return tuple(distribute(a, rep) for a in arrs)
             return tuple(arrs)
 
-        carry = _on_data_devices((
-            jnp.zeros((D, k), dt),   # Wbar
-            jnp.zeros((k, n), dt),   # O
-            jnp.zeros((k, n), dt),   # Obar
-            jnp.zeros((k, n), dt),   # nu
-            jnp.zeros((D, k), dt),   # mu
-            jnp.zeros((D, k), dt),   # mu_ij
-            jnp.zeros((D, k), dt),   # ZtObar_ij
-            jnp.zeros((k, n), dt),   # del_o
-        ))
+        carry = _on_data_devices(self.init_carry(n, k, dt))
 
         # Resume identity: a checkpoint is only valid for the SAME
         # training run — same data, maps, losses, and hyperparameters.
@@ -446,25 +500,11 @@ class BlockADMMSolver:
         # iter 1; hoisted since Zⱼ is deterministic given the maps) —
         # built only when iterations will actually run, so resuming a
         # finished run returns without paying TRANSFORM/FACTORIZATION.
-        # Factor arrays are threaded through step() as jit arguments
-        # (multi-host: they span processes); the static lower flags bind
-        # into the closure.
-        cache_mats = []
-        cache_lowers = []
-        Zs = []
+        cache_mats, cache_lowers, Zs = [], (), []
         if not resume_finished and start_it <= self.maxiter:
-            for j in range(P):
-                with timer.phase("TRANSFORM"):
-                    Z = self._block_features(X, j)
-                sj = self.block_sizes[j]
-                with timer.phase("FACTORIZATION"):
-                    c, low = jsl.cho_factor(
-                        Z.T @ Z + jnp.eye(sj, dtype=dt))
-                    cache_mats.append(c)
-                    cache_lowers.append(bool(low))
-                if self.cache_transforms:
-                    Zs.append(Z)
-        cache_lowers = tuple(cache_lowers)
+            cache_mats, cache_lowers, Zs = self.build_caches(
+                X, dt, timer=timer)
+        step_jit = jax.jit(self.make_step(n, k, dt, cache_lowers))
 
         def _save(it, carry, converged=False):
             with timer.phase("CHECKPOINT"):

@@ -53,6 +53,14 @@ Sites planted today:
                       without touching the queue, so chaos plans can
                       prove class-ordered shedding stays intact under
                       admission failures)
+``train.slice``       training-slice execution entry, once per slice
+                      attempt, BEFORE the journaled append
+                      (:mod:`libskylark_tpu.train.jobs`) — a ``crash``
+                      spec kills the replica with the slice NOT yet
+                      durable, so a peer's resume replays exactly the
+                      acked prefix and continues bit-equal (the train
+                      chaos gate's kill point); an error spec fails
+                      one slice and the job's retry budget re-runs it
 ====================  ====================================================
 
 A plan is a JSON document (or the equivalent dict)::

@@ -91,7 +91,11 @@ from libskylark_tpu.base import errors
 from libskylark_tpu.base import locks as _locks
 from libskylark_tpu.resilience import faults
 from libskylark_tpu.sessions.journal import SessionJournal
-from libskylark_tpu.sessions.state import SessionSpec, SessionState
+from libskylark_tpu.sessions.state import (
+    SessionSpec,
+    SessionState,
+    make_state,
+)
 from libskylark_tpu.telemetry import metrics as _metrics
 
 _OPENED = _metrics.counter(
@@ -167,7 +171,7 @@ class _Entry:
     ``dead`` if it failed)."""
 
     __slots__ = ("state", "journal", "lock", "last_touch", "ttl",
-                 "dead", "lease_gen")
+                 "dead", "lease_gen", "pins")
 
     def __init__(self, state: Optional[SessionState] = None,
                  journal: Optional[SessionJournal] = None):
@@ -178,6 +182,7 @@ class _Entry:
         self.ttl = float("inf")
         self.dead: Optional[str] = None
         self.lease_gen = 0
+        self.pins = 0
         if state is not None:
             self.reset_ttl()
 
@@ -363,7 +368,7 @@ class SessionRegistry:
                         f"session {sid!r} has on-disk state; resume "
                         "it by appending, or pick a fresh id"
                     ) from None
-                state = SessionState(spec)
+                state = make_state(spec, self.directory, sid)
                 tmp = self._meta_path(sid) + ".tmp"
                 with open(tmp, "w") as fh:
                     json.dump({"spec": spec.to_dict(), "v": 1}, fh)
@@ -470,7 +475,8 @@ class SessionRegistry:
                 "finalized, or never opened")
         with open(meta_path) as fh:
             meta = json.load(fh)
-        state = SessionState(SessionSpec.from_dict(meta["spec"]))
+        state = make_state(SessionSpec.from_dict(meta["spec"]),
+                           self.directory, sid)
         # fence the previous owner FIRST: once the generation is
         # bumped, its next touch drops its entry, so it can neither
         # append to the journal we are about to replay nor TTL-evict
@@ -512,7 +518,13 @@ class SessionRegistry:
         if fenced is not None:
             raise errors.SessionEvictedError(
                 f"session {sid!r} is gone ({fenced})")
-        if time.monotonic() - entry.last_touch > entry.ttl:
+        if (entry.pins == 0
+                and time.monotonic() - entry.last_touch > entry.ttl):
+            # pinned sessions (an in-flight or scheduled train slice —
+            # :meth:`pin`) never TTL-evict: a long slice that crosses
+            # the TTL must not race its own checkpoint into eviction.
+            # Fence and dead checks above still apply to pinned
+            # entries — a pin is not a lease.
             self._evict(sid, entry, "ttl")
             raise errors.SessionEvictedError(
                 f"session {sid!r} exceeded its idle TTL "
@@ -560,6 +572,10 @@ class SessionRegistry:
         for p in (self._journal_path(sid), self._meta_path(sid),
                   self._ckpt_path(sid) + ".npz",
                   self._ckpt_path(sid) + ".json",
+                  # train operand sidecar (train/state.py) — written
+                  # before open, removed with the rest of the session
+                  os.path.join(self.directory, f"{sid}.operands.npz"),
+                  os.path.join(self.directory, f"{sid}.operands.json"),
                   self._lease_path(sid)):
             try:
                 os.unlink(p)
@@ -674,6 +690,11 @@ class SessionRegistry:
                 self._ckpt_path(sid), entry.state.arrays(),
                 {"seq": entry.state.seq, "rows": entry.state.rows,
                  "spec": entry.state.spec.to_dict()})
+            # a checkpoint is activity: a train job checkpointing on
+            # schedule must not drift toward its idle TTL while making
+            # durable progress (satellite of the eviction/checkpoint
+            # race — tests/test_train.py pins this)
+            entry.last_touch = time.monotonic()
         with self._lock:
             self._counts["checkpoints"] += 1
         _CKPTS.inc()
@@ -699,7 +720,51 @@ class SessionRegistry:
                     RuntimeWarning, stacklevel=2)
         return n
 
+    # -- pinning (train jobs; satellite of the eviction/checkpoint race)
+
+    def pin(self, sid: str) -> None:
+        """Hold the session out of TTL eviction while work on it is
+        scheduled or in flight (the train manager pins for the whole
+        job: slices refresh ``last_touch`` on each ack, but a single
+        slice longer than the TTL — or a deep scheduler backlog —
+        must not let the sweep race the next slice's checkpoint).
+        Pins nest; they do not survive the registry (an entry rebuilt
+        by resume starts unpinned — the resuming owner re-pins)."""
+        entry = self._resolve(sid)
+        with entry.lock:
+            self._check_ttl(sid, entry)
+            entry.pins += 1
+            entry.last_touch = time.monotonic()
+
+    def unpin(self, sid: str) -> None:
+        """Release one pin. Only live entries are touched — an
+        unpin after eviction/fencing is a no-op, never a resume."""
+        with self._lock:
+            entry = self._live.get(sid)
+        if entry is None:
+            return
+        with entry.lock:
+            if entry.pins > 0:
+                entry.pins -= 1
+            entry.last_touch = time.monotonic()
+
     # -- introspection / lifecycle --------------------------------------
+
+    def describe(self, sid: str) -> dict:
+        """Snapshot of a live (or resumable) session: spec, cursor,
+        and — for states that expose :meth:`info` (train sessions) —
+        the solver's progress facts. Does not refresh ``last_touch``
+        (status polling is not activity, same as :meth:`rows`)."""
+        entry = self._resolve(sid)
+        with entry.lock:
+            self._check_ttl(sid, entry)
+            state = entry.state
+            out = {"spec": state.spec.to_dict(), "seq": state.seq,
+                   "rows": state.rows, "pins": entry.pins}
+            info = getattr(state, "info", None)
+            if callable(info):
+                out["info"] = info()
+            return out
 
     def session_ids(self) -> list:
         with self._lock:

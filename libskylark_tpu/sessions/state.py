@@ -58,7 +58,7 @@ import numpy as np
 
 from libskylark_tpu.base import errors
 
-KINDS = ("cwt", "jlt", "srht", "isvd", "krr")
+KINDS = ("cwt", "jlt", "srht", "isvd", "krr", "train")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,6 +80,7 @@ class SessionSpec:
     lam: float = 1e-3         # krr: ridge
     sigma: float = 1.0        # krr: RFT bandwidth
     ttl_s: Optional[float] = None
+    extra: Optional[dict] = None  # train: TrainJobSpec.to_dict()
 
     def validate(self) -> "SessionSpec":
         if self.kind not in KINDS:
@@ -101,6 +102,12 @@ class SessionSpec:
                                                          self.d):
             raise errors.InvalidParametersError(
                 f"isvd k must be in [0, min(s_dim, d)], got {self.k}")
+        if self.kind == "train":
+            if not isinstance(self.extra, dict) or "solver" not in \
+                    self.extra:
+                raise errors.InvalidParametersError(
+                    "train sessions carry their TrainJobSpec in "
+                    "spec.extra (a dict with at least 'solver')")
         return self
 
     def to_dict(self) -> dict:
@@ -128,6 +135,10 @@ class SessionState:
         from libskylark_tpu.base.context import Context
 
         self.spec = spec.validate()
+        if spec.kind == "train":
+            raise errors.InvalidParametersError(
+                "train sessions are built by sessions.state.make_state"
+                " (they need the registry directory for operands)")
         self.rows = 0
         self.seq = 0
         dt = np.dtype(spec.dtype)
@@ -310,4 +321,22 @@ class SessionState:
         return out
 
 
-__all__ = ["KINDS", "SessionSpec", "SessionState"]
+def make_state(spec: SessionSpec, directory: Optional[str] = None,
+               sid: Optional[str] = None):
+    """State factory the registry goes through at open *and* resume.
+
+    Sketch kinds build the plain :class:`SessionState`; ``train``
+    sessions build :class:`libskylark_tpu.train.state.
+    TrainSessionState`, which needs the registry ``directory`` and
+    ``sid`` to locate the job's persisted operand file (the solver
+    inputs are too large for the spec, so they ride a sidecar
+    ``<sid>.operands.npz`` written before the session opens)."""
+    spec = spec.validate()
+    if spec.kind == "train":
+        from libskylark_tpu.train.state import TrainSessionState
+
+        return TrainSessionState(spec, directory=directory, sid=sid)
+    return SessionState(spec)
+
+
+__all__ = ["KINDS", "SessionSpec", "SessionState", "make_state"]

@@ -108,6 +108,14 @@ METRICS: Dict[str, str] = {
     "fleet.autoscale_up": "counter",
     "fleet.autoscale_down": "counter",
     "fleet.replicas": "gauge",
+    # training jobs (train/jobs.py, docs/training)
+    "train.jobs_submitted": "counter",
+    "train.slices_run": "counter",
+    "train.preemptions": "counter",
+    "train.resumes": "counter",
+    "train.budget_exhausted": "counter",
+    "train.progress": "gauge",
+    "train.residual": "gauge",
 }
 
 __all__ = ["METRICS"]

@@ -1,0 +1,26 @@
+"""Training-as-a-service: preemptible, crash-survivable iterative
+solver jobs inside the serve tier (docs/training).
+
+- :mod:`libskylark_tpu.train.slices` — pure bounded-iteration slice
+  engines over the foreground solvers (ADMM-KRR, LSQR, CG, randomized
+  block Gauss–Seidel) plus the deterministic state byte codec;
+- :mod:`libskylark_tpu.train.state` — the session-state adapter that
+  makes a job a ``kind="train"`` session (journal, checkpoint, lease
+  fencing all inherited);
+- :mod:`libskylark_tpu.train.jobs` — the per-executor manager that
+  schedules slices as best-effort work and owns retry/budget/resume
+  semantics.
+"""
+
+from libskylark_tpu.train.jobs import (TrainJobHandle, TrainJobSpec,
+                                       TrainManager, train_stats)
+from libskylark_tpu.train.slices import (SOLVERS, decode_state,
+                                         encode_state, make_engine,
+                                         step_bytes)
+from libskylark_tpu.train.state import TrainSessionState
+
+__all__ = [
+    "SOLVERS", "TrainJobHandle", "TrainJobSpec", "TrainManager",
+    "TrainSessionState", "decode_state", "encode_state", "make_engine",
+    "step_bytes", "train_stats",
+]

@@ -1,0 +1,475 @@
+"""Sliceable solvers: the paper's iterative trainers as pure
+bounded-iteration steps.
+
+libSkylark ships its ml/algorithms solvers as one-shot calls
+(``skylark_ml``; PAPER.md layer map): a training run is a foreground
+loop that dies with its process. This module refactors each solver
+into a **slice engine** — an object whose ``step(state, k)`` advances
+the iteration at most ``k`` steps and returns the new state, as a
+*pure deterministic function of its inputs*. That single property buys
+the whole robustness story (docs/training):
+
+- a job is a sequence of slices, so the serve tier can run it in idle
+  scheduler slots and preempt it **at slice boundaries, never
+  mid-step**;
+- a slice journaled as "advance k from seq s" replays bit-equal, so
+  the r16 journal/checkpoint path makes the job survive ``kill -9``:
+  any replica resumes from the last checkpoint + journal tail and
+  continues **bit-identical** to the uninterrupted run;
+- replay idempotency falls out of the journal's seq cursor — the
+  solver itself needs no retry logic.
+
+Engines do not invent numerics: they are built from the SAME parts as
+the foreground solvers — :func:`libskylark_tpu.algorithms.krylov.
+lsqr_parts` / ``cg_parts`` (the one-iteration bodies the
+``lax.while_loop`` entry points run), :meth:`libskylark_tpu.ml.admm.
+BlockADMMSolver.make_step` (the consensus-ADMM iteration), and
+:class:`libskylark_tpu.algorithms.asynch._BlockSystem.sweep` (the
+randomized block Gauss-Seidel primitive). A sliced job and a
+foreground call iterate identical math; per-iteration bit-equality is
+pinned by tests/test_train.py.
+
+Engine contract
+===============
+
+``init() -> state``           initial solver state (dict name -> host
+                              ndarray; includes the iteration counter)
+``step(state, k) -> state``   advance ≤ k iterations (fewer only when
+                              the convergence test inside the state
+                              fires); pure + deterministic
+``info(state) -> dict``       {"iterations", "residual", "converged"}
+``result(state) -> dict``     terminal host arrays (the model)
+
+State dicts hold **host numpy arrays only** — they are what the
+registry checkpoints and what :func:`encode_state` frames for the
+byte-level ``step(state_bytes, k) -> state_bytes`` contract.
+``encode_state`` is deliberately *not* ``np.savez`` (zip members carry
+wall-clock timestamps, so equal states would encode to unequal bytes);
+it frames raw ``.npy`` records, which are bit-stable.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from typing import Dict
+
+import numpy as np
+
+from libskylark_tpu.base import errors
+from libskylark_tpu.base.precision import with_solver_precision
+
+SOLVERS = ("admm_krr", "lsqr", "cg", "rand_gs")
+
+
+# -- byte framing -------------------------------------------------------
+
+
+def encode_state(state: Dict[str, np.ndarray]) -> bytes:
+    """Deterministic bytes for a state dict: a key manifest + one raw
+    ``.npy`` record per array, in sorted key order. Equal states encode
+    to equal bytes (the replay bit-equality tests compare these)."""
+    out = io.BytesIO()
+    keys = sorted(state)
+    manifest = json.dumps(keys).encode("utf-8")
+    out.write(struct.pack("<I", len(manifest)))
+    out.write(manifest)
+    for k in keys:
+        arr = np.asarray(state[k])
+        if arr.ndim and not arr.flags.c_contiguous:
+            # NOT np.ascontiguousarray: that promotes 0-d to 1-d, and
+            # the shape must round-trip exactly (scalar counters like
+            # ``it`` feed shape-sensitive while_loop conditions)
+            arr = arr.copy(order="C")
+        np.lib.format.write_array(out, arr, allow_pickle=False)
+    return out.getvalue()
+
+
+def decode_state(data: bytes) -> Dict[str, np.ndarray]:
+    buf = io.BytesIO(data)
+    (mlen,) = struct.unpack("<I", buf.read(4))
+    keys = json.loads(buf.read(mlen).decode("utf-8"))
+    return {k: np.lib.format.read_array(buf, allow_pickle=False)
+            for k in keys}
+
+
+def step_bytes(engine, state_bytes: bytes, k: int) -> bytes:
+    """The ISSUE's literal contract: ``step(state_bytes, k) ->
+    state_bytes``, deterministic so replay is bit-equal."""
+    return encode_state(engine.step(decode_state(state_bytes), int(k)))
+
+
+# -- shared helpers -----------------------------------------------------
+
+
+def _host(state) -> Dict[str, np.ndarray]:
+    return {k: np.asarray(v) for k, v in state.items()}
+
+
+def _build_precond(kind, A, hyper):
+    """Optional sketched right-preconditioner for the Krylov engines —
+    the Blendenpik/LSRN build from algorithms/regression.py, seeded
+    from the job spec so a resume rebuilds the same operator."""
+    if not kind:
+        return None
+    from libskylark_tpu.algorithms import regression as _reg
+    from libskylark_tpu.base.context import Context
+
+    params = _reg.AcceleratedParams(
+        sketch_size_factor=float(hyper.get("sketch_size_factor", 4.0)),
+        sketch=str(hyper.get("sketch", "jlt")),
+    )
+    ctx = Context(seed=int(hyper.get("seed", 0)))
+    if kind == "blendenpik":
+        precond, _ = _reg.build_blendenpik_precond(A, ctx, params)
+        return precond
+    if kind == "lsrn":
+        precond, _ = _reg.build_lsrn_precond(A, ctx, params)
+        return precond
+    raise errors.InvalidParametersError(
+        f"unknown train preconditioner {kind!r} "
+        "(expected 'blendenpik', 'lsrn', or none)")
+
+
+class _KrylovEngine:
+    """Shared machinery for the LSQR/CG engines: the solver's
+    ``*_parts`` body run under a *bounded* while-loop cond
+    ``(it < limit) & ~all(done)`` with the limit a traced argument —
+    one compile per job serves every slice size."""
+
+    solver = ""
+
+    def _parts(self, A, B, params, precond):
+        raise NotImplementedError
+
+    def __init__(self, hyper: dict, operands: dict):
+        import jax
+        import jax.numpy as jnp
+
+        from libskylark_tpu.algorithms.krylov import KrylovParams
+
+        if "A" not in operands or "B" not in operands:
+            raise errors.InvalidParametersError(
+                f"{self.solver} jobs need operands A and B")
+        self.hyper = dict(hyper or {})
+        A = jnp.asarray(operands["A"])
+        B = jnp.asarray(operands["B"])
+        params = KrylovParams(
+            tolerance=float(self.hyper.get("tolerance", 1e-6)))
+        # the whole build runs under solver precision, exactly as the
+        # decorated one-shot entry point computes its initial vectors —
+        # the engine's iteration 0..i bytes must equal lsqr/cg's
+        state0, body, meta = with_solver_precision(self._parts)(
+            A, B, params,
+            _build_precond(self.hyper.get("precond"), A, self.hyper))
+        self._state0, self._meta = state0, meta
+
+        def run(state, limit):
+            from jax import lax
+
+            def cond(s):
+                return (s["it"] < limit) & (~jnp.all(s["done"]))
+
+            return lax.while_loop(cond, body, state)
+
+        # with_solver_precision INSIDE the jit boundary: the precision
+        # context is applied while the body traces, matching the
+        # decorated one-shot entry points' numerics exactly
+        self._run = jax.jit(with_solver_precision(run))
+        self._jnp = jnp
+
+    def init(self) -> Dict[str, np.ndarray]:
+        return _host(self._state0)
+
+    def step(self, state: Dict[str, np.ndarray], k: int
+             ) -> Dict[str, np.ndarray]:
+        jnp = self._jnp
+        dev = {key: jnp.asarray(v) for key, v in state.items()}
+        limit = dev["it"] + jnp.int32(int(k))
+        return _host(self._run(dev, limit))
+
+    def _residual(self, state) -> float:
+        if "nrm_r" in state:  # lsqr carries the residual norms directly
+            return float(np.max(np.asarray(state["nrm_r"])))
+        return float(np.max(np.sqrt(np.sum(
+            np.asarray(state["R"]) ** 2, axis=0))))
+
+    def info(self, state) -> dict:
+        return {
+            "iterations": int(np.asarray(state["it"])),
+            "residual": self._residual(state),
+            "converged": bool(np.all(np.asarray(state["done"]))),
+        }
+
+    def result(self, state) -> dict:
+        jnp = self._jnp
+        dev = {key: jnp.asarray(v) for key, v in state.items()}
+        X = np.asarray(self._meta["extract"](dev))
+        out = {"X": X, "iterations": int(np.asarray(state["it"]))}
+        info = self.info(state)
+        out["converged"] = info["converged"]
+        out["residual"] = info["residual"]
+        return out
+
+
+class LsqrEngine(_KrylovEngine):
+    solver = "lsqr"
+
+    def _parts(self, A, B, params, precond):
+        from libskylark_tpu.algorithms import krylov
+
+        return krylov.lsqr_parts(A, B, params=params, precond=precond,
+                                 shape=A.shape)
+
+
+class CgEngine(_KrylovEngine):
+    solver = "cg"
+
+    def _parts(self, A, B, params, precond):
+        from libskylark_tpu.algorithms import krylov
+
+        return krylov.cg_parts(A, B, params=params, precond=precond)
+
+
+class AdmmKrrEngine:
+    """BlockADMM kernel-ridge training in slices: the SAME
+    ``make_step``/``build_caches``/``init_carry`` parts the foreground
+    :meth:`BlockADMMSolver.train` composes, driven one iteration at a
+    time so a slice boundary can fall after any iteration. The python
+    loop here mirrors train()'s loop exactly (same step function, same
+    convergence test at the same point), so the sliced job's carry is
+    bit-equal to the uninterrupted run at every iteration count."""
+
+    solver = "admm_krr"
+    _CARRY = ("Wbar", "O", "Obar", "nu", "mu", "mu_ij", "ZtObar_ij",
+              "del_o")
+
+    def __init__(self, hyper: dict, operands: dict):
+        import jax
+        import jax.numpy as jnp
+
+        from libskylark_tpu.algorithms import prox
+        from libskylark_tpu.base.context import Context
+        from libskylark_tpu.ml import kernels
+        from libskylark_tpu.ml.admm import BlockADMMSolver
+
+        if "X" not in operands or "Y" not in operands:
+            raise errors.InvalidParametersError(
+                "admm_krr jobs need operands X and Y")
+        h = dict(hyper or {})
+        self.hyper = h
+        X = jnp.asarray(operands["X"])
+        Y = jnp.asarray(operands["Y"]).reshape(-1)
+        n, d = X.shape
+        self._regression = bool(h.get("regression", True))
+        if self._regression:
+            k = 1
+        else:
+            k = int(h.get("num_targets") or int(jnp.max(Y)) + 1)
+        kernel = kernels.Gaussian(d, float(h.get("sigma", 1.0)))
+        solver = BlockADMMSolver.from_kernel(
+            Context(seed=int(h.get("seed", 0))),
+            prox.SquaredLoss(), prox.L2Regularizer(),
+            float(h.get("lam", 1e-3)),
+            int(h.get("num_features", 64)),
+            kernel,
+            num_partitions=int(h.get("num_partitions", 1)),
+        )
+        solver.rho = float(h.get("rho", 1.0))
+        solver.tol = float(h.get("tol", 1e-6))
+        self._solver = solver
+        self._X, self._Y = X, Y
+        self._n, self._k, self._dt = n, k, X.dtype
+        # caches + step are deterministic given (operands, hyper): a
+        # resume on another replica rebuilds the same factor bytes.
+        # Built under solver precision like the decorated train() —
+        # the factors feed every iteration
+        self._cache_mats, lowers, self._Zs = with_solver_precision(
+            solver.build_caches)(X, X.dtype)
+        self._step = jax.jit(solver.make_step(n, k, X.dtype, lowers))
+        self._jnp = jnp
+
+    def init(self) -> Dict[str, np.ndarray]:
+        carry = self._solver.init_carry(self._n, self._k, self._dt)
+        state = {name: np.asarray(a)
+                 for name, a in zip(self._CARRY, carry)}
+        state["it"] = np.int64(0)
+        state["reldel"] = np.asarray(np.inf, np.float64)
+        state["objective"] = np.asarray(np.inf, np.float64)
+        state["done"] = np.asarray(False)
+        return state
+
+    @with_solver_precision
+    def step(self, state: Dict[str, np.ndarray], k: int
+             ) -> Dict[str, np.ndarray]:
+        jnp = self._jnp
+        carry = tuple(jnp.asarray(state[name]) for name in self._CARRY)
+        it = int(np.asarray(state["it"]))
+        reldel = float(np.asarray(state["reldel"]))
+        objective = float(np.asarray(state["objective"]))
+        done = bool(np.asarray(state["done"]))
+        tol = self._solver.tol
+        for _ in range(int(k)):
+            if done:
+                break
+            carry, (obj, rd) = self._step(
+                carry, self._X, self._Y, self._cache_mats, self._Zs)
+            it += 1
+            reldel = float(rd)
+            objective = float(obj)
+            # the foreground loop's convergence test, verbatim
+            if tol > 0 and it > 1 and reldel <= tol:
+                done = True
+        out = {name: np.asarray(a)
+               for name, a in zip(self._CARRY, carry)}
+        out["it"] = np.int64(it)
+        out["reldel"] = np.asarray(reldel, np.float64)
+        out["objective"] = np.asarray(objective, np.float64)
+        out["done"] = np.asarray(done)
+        return out
+
+    def info(self, state) -> dict:
+        return {
+            "iterations": int(np.asarray(state["it"])),
+            "residual": float(np.asarray(state["reldel"])),
+            "converged": bool(np.asarray(state["done"])),
+        }
+
+    def result(self, state) -> dict:
+        out = {"coef": np.asarray(state["Wbar"]),
+               "objective": float(np.asarray(state["objective"]))}
+        out.update({"iterations": int(np.asarray(state["it"])),
+                    "converged": bool(np.asarray(state["done"])),
+                    "residual": float(np.asarray(state["reldel"]))})
+        return out
+
+    def model(self, state):
+        """The trained :class:`HilbertModel` (prediction-ready), for
+        callers that want more than raw coefficients."""
+        from libskylark_tpu.ml.model import HilbertModel
+
+        m = HilbertModel(self._solver.feature_maps,
+                         self._solver.scale_maps,
+                         self._solver.num_features, self._k,
+                         self._regression,
+                         input_size=self._X.shape[1])
+        m.coef = self._jnp.asarray(state["Wbar"])
+        return m
+
+
+class RandGsEngine:
+    """Randomized block Gauss-Seidel (the AsyRGS analog) in slices:
+    one iteration = one sweep, keyed by ``fold_in(key, sweeps_done)``
+    exactly as :func:`algorithms.asynch.rand_block_gauss_seidel` keys
+    its sweeps — the block visit order depends only on the absolute
+    sweep index, so a resumed job draws the same orders."""
+
+    solver = "rand_gs"
+
+    def __init__(self, hyper: dict, operands: dict):
+        import jax
+        import jax.numpy as jnp
+        import jax.random as jr
+
+        from libskylark_tpu.algorithms.asynch import _BlockSystem
+        from libskylark_tpu.base.context import Context
+
+        if "A" not in operands or "B" not in operands:
+            raise errors.InvalidParametersError(
+                "rand_gs jobs need operands A and B")
+        h = dict(hyper or {})
+        self.hyper = h
+        A = jnp.asarray(operands["A"])
+        B = jnp.asarray(operands["B"])
+        self._squeeze = B.ndim == 1
+        if self._squeeze:
+            B = B[:, None]
+        self._tol = float(h.get("tolerance", 1e-6))
+        sys_ = _BlockSystem(A, int(h.get("block_size", 64)))
+        key = Context(seed=int(h.get("seed", 0))).allocate().key
+        B_p = sys_.pad_cols(B)
+        self._sys, self._B_p = sys_, B_p
+        self._nrm_b = jnp.maximum(jnp.linalg.norm(B_p),
+                                  jnp.finfo(B.dtype).eps)
+
+        def sweep(X, idx):
+            return sys_.sweep(X, B_p, jr.fold_in(key, idx))
+
+        def residual(X):
+            return jnp.linalg.norm(B_p - sys_.A_p @ X) / self._nrm_b
+
+        # NOT under solver precision: the foreground
+        # rand_block_gauss_seidel runs at ambient precision, and the
+        # engine must iterate the same bytes it does
+        self._sweep = jax.jit(sweep)
+        self._residual = jax.jit(residual)
+        self._B_shape = B.shape
+        self._jnp = jnp
+
+    def init(self) -> Dict[str, np.ndarray]:
+        jnp = self._jnp
+        n, k = self._B_shape
+        X = self._sys.pad_cols(jnp.zeros((n, k), self._B_p.dtype))
+        return {"X": np.asarray(X), "it": np.int64(0),
+                "res": np.asarray(np.inf, np.float64),
+                "done": np.asarray(False)}
+
+    def step(self, state: Dict[str, np.ndarray], k: int
+             ) -> Dict[str, np.ndarray]:
+        jnp = self._jnp
+        X = jnp.asarray(state["X"])
+        it = int(np.asarray(state["it"]))
+        done = bool(np.asarray(state["done"]))
+        res = float(np.asarray(state["res"]))
+        for _ in range(int(k)):
+            if done:
+                break
+            X = self._sweep(X, np.int32(it))
+            it += 1
+            res = float(self._residual(X))
+            done = res <= self._tol
+        return {"X": np.asarray(X), "it": np.int64(it),
+                "res": np.asarray(res, np.float64),
+                "done": np.asarray(done)}
+
+    def info(self, state) -> dict:
+        return {
+            "iterations": int(np.asarray(state["it"])),
+            "residual": float(np.asarray(state["res"])),
+            "converged": bool(np.asarray(state["done"])),
+        }
+
+    def result(self, state) -> dict:
+        n = self._sys.n
+        X = np.asarray(state["X"])[:n, :]
+        if self._squeeze:
+            X = X[:, 0]
+        return {"X": X, "iterations": int(np.asarray(state["it"])),
+                "converged": bool(np.asarray(state["done"])),
+                "residual": float(np.asarray(state["res"]))}
+
+
+_ENGINES = {
+    "admm_krr": AdmmKrrEngine,
+    "lsqr": LsqrEngine,
+    "cg": CgEngine,
+    "rand_gs": RandGsEngine,
+}
+
+
+def make_engine(solver: str, hyper: dict, operands: dict):
+    """Engine factory keyed by :data:`SOLVERS` name."""
+    cls = _ENGINES.get(solver)
+    if cls is None:
+        raise errors.InvalidParametersError(
+            f"unknown train solver {solver!r}; expected one of "
+            f"{SOLVERS}")
+    return cls(hyper, operands)
+
+
+__all__ = [
+    "SOLVERS", "make_engine", "encode_state", "decode_state",
+    "step_bytes", "AdmmKrrEngine", "LsqrEngine", "CgEngine",
+    "RandGsEngine",
+]

@@ -1,0 +1,188 @@
+"""Training jobs as sessions: the solver slice engine behind the
+session-state protocol.
+
+A train job IS a serve session of kind ``"train"`` — it reuses the r16
+durability stack verbatim instead of growing a parallel one. The
+mapping:
+
+=================  ====================================================
+session concept    train meaning
+=================  ====================================================
+``spec``           :class:`SessionSpec` with ``kind="train"``;
+                   ``spec.extra`` carries the ``TrainJobSpec`` dict,
+                   ``spec.n`` the iteration budget (the "stream
+                   extent" appends may not pass)
+``append batch``   one **slice directive**: a (1, 1) int64 array
+                   holding k, "advance the solver ≤ k iterations"
+``fold``           run ``engine.step(state, k)`` — pure and
+                   deterministic, so journal replay re-executes the
+                   slices bit-equal (the replay invariant of
+                   :mod:`sessions.state`, inherited wholesale)
+``rows``           the slice-position cursor: requested iterations so
+                   far (budget accounting; the engine's own ``it``
+                   counter tracks iterations actually run, which is
+                   smaller once converged)
+``checkpoint``     the engine state dict (host numpy arrays, exact
+                   bytes) through ``utility.checkpoint.save_sync``
+``finalize``       ``engine.result(state)`` — the trained model
+=================  ====================================================
+
+Operands (the training data / system matrices) are too large to ride
+the spec, so they are persisted ONCE at submit as a sidecar
+``<sid>.operands.npz`` next to the journal (same atomic
+``save_sync`` discipline, written durable BEFORE the session opens).
+Rebuild-at-resume then needs nothing but the directory: any replica
+that owns the session files can reconstruct the engine — transforms
+and caches are deterministic given (operands, hyper) — and continue
+bit-equal from the last acked slice.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from libskylark_tpu.base import errors
+from libskylark_tpu.sessions.state import SessionSpec
+from libskylark_tpu.train import slices as _slices
+
+#: sidecar basename suffix (``save_sync`` adds .npz/.json)
+OPERANDS_SUFFIX = ".operands"
+
+
+def operands_path(directory: str, sid: str) -> str:
+    return os.path.join(directory, sid + OPERANDS_SUFFIX)
+
+
+def save_operands(directory: str, sid: str, operands: dict,
+                  digests: Optional[dict] = None) -> None:
+    """Persist the job's operand arrays durably (atomic npz +
+    forensics sidecar), BEFORE the session opens — a session whose
+    journal exists but whose operands don't would be unresumable."""
+    from libskylark_tpu.utility import checkpoint as _ckpt
+
+    _ckpt.save_sync(operands_path(directory, sid),
+                    {k: np.asarray(v) for k, v in operands.items()},
+                    {"digests": digests or {}})
+
+
+def load_operands(directory: str, sid: str) -> dict:
+    from libskylark_tpu.utility import checkpoint as _ckpt
+
+    loaded = _ckpt.load_sync(operands_path(directory, sid))
+    if loaded is None:
+        raise errors.SessionEvictedError(
+            f"train session {sid}: no operand sidecar at "
+            f"{operands_path(directory, sid)}.npz — the job cannot be "
+            "rebuilt (submit persists operands before opening the "
+            "session, so this means the artifacts were removed)")
+    arrays, _meta = loaded
+    return arrays
+
+
+def remove_operands(directory: str, sid: str) -> None:
+    base = operands_path(directory, sid)
+    for suffix in (".npz", ".json"):
+        try:
+            os.unlink(base + suffix)
+        except FileNotFoundError:
+            pass
+
+
+class TrainSessionState:
+    """Session-state protocol over a solver slice engine (built by
+    :func:`sessions.state.make_state` for ``kind="train"``)."""
+
+    def __init__(self, spec: SessionSpec, directory: Optional[str] = None,
+                 sid: Optional[str] = None):
+        self.spec = spec.validate()
+        if directory is None or sid is None:
+            raise errors.InvalidParametersError(
+                "train sessions need a registry directory and session "
+                "id (the operand sidecar lives there); open them "
+                "through a SessionRegistry")
+        job = dict(spec.extra)
+        self._job = job
+        operands = load_operands(directory, sid)
+        self._engine = _slices.make_engine(
+            str(job["solver"]), dict(job.get("hyper") or {}), operands)
+        self._state = self._engine.init()
+        self.rows = 0
+        self.seq = 0
+
+    # -- batch intake (slice directives) --------------------------------
+
+    def coerce_batch(self, X, Y=None):
+        """A train append is a slice directive: a positive iteration
+        count k, canonicalized to a (1, 1) int64 array (the journal
+        record payload). Budget is enforced here — BEFORE the journal
+        write, like every batch validation — so a slice that would
+        exceed ``spec.n`` total iterations is refused, not journaled."""
+        if Y is not None:
+            raise errors.InvalidParametersError(
+                "train sessions take no Y batch")
+        k = np.asarray(X)
+        if k.size != 1:
+            raise errors.InvalidParametersError(
+                f"train append payload must be a single iteration "
+                f"count, got shape {k.shape}")
+        kval = int(k.reshape(()))
+        if kval < 1:
+            raise errors.InvalidParametersError(
+                f"train slice must advance >= 1 iteration, got {kval}")
+        if self.rows + kval > self.spec.n:
+            raise errors.InvalidParametersError(
+                f"slice past the job's iteration budget: "
+                f"{self.rows} + {kval} > budget={self.spec.n}")
+        return np.asarray([[kval]], dtype=np.int64), None
+
+    def fold(self, X: np.ndarray, Y) -> None:
+        """Advance the solver ≤ k iterations — the deterministic
+        replay unit. ``rows`` tracks *requested* iterations (the
+        budget cursor); once the engine's convergence test fires,
+        extra requested iterations are no-ops inside ``step``."""
+        del Y
+        k = int(np.asarray(X).reshape(()))
+        self._state = self._engine.step(self._state, k)
+        self.rows += k
+
+    # -- checkpoint round trip ------------------------------------------
+
+    def arrays(self) -> dict:
+        return {k: np.asarray(v) for k, v in self._state.items()}
+
+    def load(self, arrays: dict, rows: int, seq: int) -> None:
+        expected = set(self._state)
+        got = set(arrays)
+        if expected != got:
+            raise errors.InvalidParametersError(
+                f"train checkpoint state keys {sorted(got)} do not "
+                f"match the engine's {sorted(expected)} — checkpoint "
+                "from a different solver or build")
+        self._state = {k: np.asarray(v) for k, v in arrays.items()}
+        self.rows = int(rows)
+        self.seq = int(seq)
+
+    # -- progress / terminal --------------------------------------------
+
+    def info(self) -> dict:
+        """{"iterations", "residual", "converged"} — the progress/
+        residual gauges' source of truth."""
+        return self._engine.info(self._state)
+
+    @property
+    def converged(self) -> bool:
+        return bool(self.info().get("converged"))
+
+    def finalize(self) -> dict:
+        out = dict(self._engine.result(self._state))
+        out.setdefault("rows", self.rows)
+        return out
+
+
+__all__ = [
+    "OPERANDS_SUFFIX", "TrainSessionState", "operands_path",
+    "save_operands", "load_operands", "remove_operands",
+]

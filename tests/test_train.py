@@ -1,0 +1,529 @@
+"""Training-as-a-service (libskylark_tpu/train/, docs/training).
+
+Oracles:
+
+- *slice determinism*: ``step(state_bytes, k) -> state_bytes`` is a
+  pure function — replaying a step is BIT-equal, and k1+k2 sliced
+  equals k1+k2 straight — for every solver engine (ADMM-KRR, LSQR,
+  CG, randomized block Gauss-Seidel);
+- *survivability*: resume-from-checkpoint+journal-tail is bit-equal to
+  the uninterrupted run, the stale owner is fenced, and a SIGKILL
+  between slices loses nothing past the last acked slice;
+- *scheduling*: slices run only in idle scheduler slots, preemption
+  happens at slice boundaries (never mid-step — a started slice's
+  append always lands), and a pinned training session never
+  TTL-evicts while its job is live (the eviction/refresh regression);
+- *budgets*: exhaustion raises ``TrainBudgetExhaustedError`` carrying
+  the EXACT iterations completed; retries are bounded.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from libskylark_tpu.base import errors as sk_errors
+from libskylark_tpu.sessions.registry import SessionRegistry
+from libskylark_tpu.sessions.state import SessionSpec
+from libskylark_tpu.train import (TrainJobSpec, decode_state,
+                                  encode_state, make_engine,
+                                  step_bytes)
+from libskylark_tpu.train import state as tstate
+
+
+@pytest.fixture()
+def sdir(tmp_path, monkeypatch):
+    d = str(tmp_path / "sessions")
+    monkeypatch.setenv("SKYLARK_SESSION_DIR", d)
+    return d
+
+
+def _lsqr_ops(seed=0, m=48, n=6, t=2):
+    rng = np.random.default_rng(seed)
+    return {"A": rng.standard_normal((m, n)),
+            "B": rng.standard_normal((m, t))}
+
+
+def _cg_ops(seed=0, n=8):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((40, n))
+    M = A.T @ A + n * np.eye(n)
+    return {"A": M, "B": rng.standard_normal((n, 2))}
+
+
+def _krr_ops(seed=0, m=30, d=4):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((m, d))
+    Y = (X[:, :1] > 0).astype(np.float64) * 2 - 1
+    return {"X": X, "Y": Y}
+
+
+_ENGINES = [
+    ("lsqr", {}, _lsqr_ops),
+    ("cg", {}, _cg_ops),
+    ("rand_gs", {"block_size": 4}, _cg_ops),
+    ("admm_krr",
+     {"num_features": 16, "num_partitions": 2, "lam": 1e-2, "seed": 3},
+     _krr_ops),
+]
+
+
+class TestSliceDeterminism:
+    """The tentpole invariant: ``step`` is pure and deterministic, so
+    journal replay is bit-equal by construction."""
+
+    @pytest.mark.parametrize("solver,hyper,ops", _ENGINES,
+                             ids=[e[0] for e in _ENGINES])
+    def test_step_replay_bit_equal(self, solver, hyper, ops):
+        eng = make_engine(solver, hyper, ops())
+        b0 = encode_state(eng.init())
+        assert step_bytes(eng, b0, 3) == step_bytes(eng, b0, 3)
+
+    @pytest.mark.parametrize("solver,hyper,ops", _ENGINES,
+                             ids=[e[0] for e in _ENGINES])
+    def test_sliced_equals_straight(self, solver, hyper, ops):
+        # (k=2; k=2; k=2) must land bit-equal to (k=6): preempting at
+        # any slice boundary cannot change the trajectory
+        eng = make_engine(solver, hyper, ops())
+        b = encode_state(eng.init())
+        for _ in range(3):
+            b = step_bytes(eng, b, 2)
+        assert b == step_bytes(eng, encode_state(eng.init()), 6)
+
+    def test_codec_round_trip_preserves_shapes(self):
+        state = {"it": np.int32(4),
+                 "X": np.arange(6, dtype=np.float64).reshape(2, 3),
+                 "done": np.array([True, False])}
+        out = decode_state(encode_state(state))
+        assert set(out) == set(state)
+        for k in state:
+            assert out[k].shape == np.asarray(state[k]).shape
+            assert out[k].dtype == np.asarray(state[k]).dtype
+            assert np.array_equal(out[k], state[k])
+
+    def test_codec_rejects_nothing_silently(self):
+        # two engines over the same operands, fresh instances: byte
+        # equality must hold across instances (no per-instance salt)
+        ops = _lsqr_ops()
+        e1 = make_engine("lsqr", {}, ops)
+        e2 = make_engine("lsqr", {}, ops)
+        assert encode_state(e1.init()) == encode_state(e2.init())
+
+    def test_unknown_solver_refuses(self):
+        with pytest.raises(sk_errors.InvalidParametersError):
+            make_engine("sgd", {}, _lsqr_ops())
+
+
+def _open_train(reg, sid, spec, ops):
+    tstate.save_operands(reg.directory, sid, ops, {})
+    reg.open(SessionSpec(kind="train", n=spec.budget_iters, s_dim=1,
+                         d=1, extra=spec.to_dict()), session_id=sid)
+
+
+class TestSurvivability:
+    """Resume bit-equality through the registry's checkpoint + journal
+    path, for each solver family."""
+
+    @pytest.mark.parametrize("solver,hyper,ops", _ENGINES,
+                             ids=[e[0] for e in _ENGINES])
+    def test_resume_bit_equal_to_uninterrupted(self, solver, hyper,
+                                               ops, sdir):
+        operands = ops()
+        spec = TrainJobSpec(solver=solver, hyper=hyper,
+                            budget_iters=64)
+        sid = f"train-{solver}-resume"
+        reg = SessionRegistry(directory=sdir)
+        _open_train(reg, sid, spec, operands)
+        # 3 slices of 2, checkpoint mid-way, then one more journal-
+        # only slice — the resume must replay checkpoint + tail
+        for i in range(3):
+            reg.append(sid, np.asarray([[2]], np.int64), seq=i + 1)
+        reg.checkpoint(sid)
+        reg.append(sid, np.asarray([[2]], np.int64), seq=4)
+        # "SIGKILL": abandon reg without close; peer adopts from disk
+        reg2 = SessionRegistry(directory=sdir)
+        desc = reg2.describe(sid)
+        assert desc["seq"] == 4 and desc["rows"] == 8
+        eng = make_engine(solver, hyper, operands)
+        ref = encode_state(eng.step(eng.init(), 8))
+        got = encode_state(reg2._resolve(sid).state.arrays())
+        assert got == ref
+        # the stale owner is fenced at its next verb
+        with pytest.raises(sk_errors.SessionEvictedError):
+            reg.append(sid, np.asarray([[2]], np.int64), seq=5)
+
+    def test_operand_sidecar_required(self, sdir):
+        spec = TrainJobSpec(solver="lsqr", budget_iters=8)
+        reg = SessionRegistry(directory=sdir)
+        with pytest.raises(sk_errors.SessionEvictedError,
+                           match="operand sidecar"):
+            reg.open(SessionSpec(kind="train", n=8, s_dim=1, d=1,
+                                 extra=spec.to_dict()),
+                     session_id="train-no-ops")
+
+    def test_budget_refused_pre_journal(self, sdir):
+        ops = _lsqr_ops()
+        spec = TrainJobSpec(solver="lsqr", budget_iters=4)
+        sid = "train-budget-edge"
+        reg = SessionRegistry(directory=sdir)
+        _open_train(reg, sid, spec, ops)
+        reg.append(sid, np.asarray([[3]], np.int64), seq=1)
+        with pytest.raises(sk_errors.InvalidParametersError,
+                           match="budget"):
+            reg.append(sid, np.asarray([[2]], np.int64), seq=2)
+        # the refused slice was never journaled: the cursor holds
+        assert reg.describe(sid)["rows"] == 3
+
+    def test_eviction_removes_operand_sidecar(self, sdir):
+        import os
+
+        ops = _lsqr_ops()
+        spec = TrainJobSpec(solver="lsqr", budget_iters=8)
+        sid = "train-evict-ops"
+        reg = SessionRegistry(directory=sdir)
+        _open_train(reg, sid, spec, ops)
+        path = tstate.operands_path(sdir, sid) + ".npz"
+        assert os.path.exists(path)
+        reg.evict(sid, reason="test")
+        assert not os.path.exists(path)
+
+
+class TestTTLPinning:
+    """The eviction-guard satellite: a session with a live train job
+    (pinned) must never TTL-evict between slices; activity (appends,
+    checkpoints) refreshes the clock."""
+
+    def test_pinned_session_survives_ttl(self, sdir, monkeypatch):
+        from libskylark_tpu.sessions import registry as reg_mod
+
+        ops = _lsqr_ops()
+        spec = TrainJobSpec(solver="lsqr", budget_iters=64)
+        sid = "train-pinned"
+        reg = SessionRegistry(directory=sdir)
+        tstate.save_operands(sdir, sid, ops, {})
+        reg.open(SessionSpec(kind="train", n=64, s_dim=1, d=1,
+                             ttl_s=10.0, extra=spec.to_dict()),
+                 session_id=sid)
+        reg.pin(sid)
+        t0 = time.monotonic()
+        monkeypatch.setattr(reg_mod.time, "monotonic",
+                            lambda: t0 + 3600.0)
+        # an hour past the TTL: pinned -> still alive and appendable
+        assert reg.describe(sid)["pins"] == 1
+        reg.append(sid, np.asarray([[2]], np.int64), seq=1)
+        # unpin: append refreshed last_touch, so it survives until the
+        # clock passes TTL again
+        reg.unpin(sid)
+        monkeypatch.setattr(reg_mod.time, "monotonic",
+                            lambda: t0 + 7200.0)
+        with pytest.raises(sk_errors.SessionEvictedError):
+            reg.append(sid, np.asarray([[2]], np.int64), seq=2)
+
+    def test_checkpoint_refreshes_ttl(self, sdir, monkeypatch):
+        from libskylark_tpu.sessions import registry as reg_mod
+
+        ops = _lsqr_ops()
+        spec = TrainJobSpec(solver="lsqr", budget_iters=64)
+        sid = "train-ckpt-ttl"
+        reg = SessionRegistry(directory=sdir)
+        tstate.save_operands(sdir, sid, ops, {})
+        reg.open(SessionSpec(kind="train", n=64, s_dim=1, d=1,
+                             ttl_s=10.0, extra=spec.to_dict()),
+                 session_id=sid)
+        t0 = time.monotonic()
+        # 8s in (inside TTL): a checkpoint lands and refreshes
+        monkeypatch.setattr(reg_mod.time, "monotonic",
+                            lambda: t0 + 8.0)
+        reg.checkpoint(sid)
+        # 16s from open, 8s from the checkpoint: still alive
+        monkeypatch.setattr(reg_mod.time, "monotonic",
+                            lambda: t0 + 16.0)
+        reg.append(sid, np.asarray([[1]], np.int64), seq=1)
+
+    def test_pin_nesting_and_unknown(self, sdir):
+        ops = _lsqr_ops()
+        spec = TrainJobSpec(solver="lsqr", budget_iters=8)
+        sid = "train-pin-nest"
+        reg = SessionRegistry(directory=sdir)
+        _open_train(reg, sid, spec, ops)
+        reg.pin(sid)
+        reg.pin(sid)
+        assert reg.describe(sid)["pins"] == 2
+        reg.unpin(sid)
+        reg.unpin(sid)
+        reg.unpin(sid)   # over-unpin clamps at zero, never negative
+        assert reg.describe(sid)["pins"] == 0
+        with pytest.raises(sk_errors.SessionEvictedError):
+            reg.pin("train-never-opened")
+
+
+class TestExecutorJobs:
+    """The manager on a live executor: correctness of the scheduled
+    result, budget exhaustion reporting, counters."""
+
+    def test_job_result_equals_direct_run(self, sdir):
+        from libskylark_tpu.engine.serve import MicrobatchExecutor
+
+        ops = _lsqr_ops(seed=7)
+        with MicrobatchExecutor(name="t-exec") as ex:
+            h = ex.submit_train_job(
+                TrainJobSpec(solver="lsqr", budget_iters=64,
+                             slice_iters=4, checkpoint_every=2),
+                operands=ops)
+            out = h.result(timeout=120)
+        assert out["converged"]
+        eng = make_engine("lsqr", {}, ops)
+        st = eng.init()
+        while not eng.info(st)["converged"]:
+            st = eng.step(st, 4)
+        assert np.array_equal(np.asarray(out["X"]),
+                              np.asarray(eng.result(st)["X"]))
+
+    def test_budget_exhausted_exact_iterations(self, sdir):
+        from libskylark_tpu.engine.serve import MicrobatchExecutor
+
+        ops = _lsqr_ops()
+        with MicrobatchExecutor(name="t-budget") as ex:
+            h = ex.submit_train_job(
+                TrainJobSpec(solver="lsqr", budget_iters=5,
+                             slice_iters=2,
+                             hyper={"tolerance": 1e-30}),
+                operands=ops)
+            with pytest.raises(
+                    sk_errors.TrainBudgetExhaustedError) as ei:
+                h.result(timeout=120)
+            s = ex.stats()["train"]
+        # exact progress: 2+2+1 = 5 requested iterations over 3 slices
+        assert ei.value.iterations == 5
+        assert ei.value.slices == 3
+        assert ei.value.residual is not None
+        assert s["budget_exhausted"] == 1
+        assert s["slices_run"] == 3
+
+    def test_stats_and_serve_stats_surface(self, sdir):
+        from libskylark_tpu.engine import serve as serve_mod
+
+        ops = _cg_ops()
+        with serve_mod.MicrobatchExecutor(name="t-stats") as ex:
+            h = ex.submit_train_job(
+                TrainJobSpec(solver="cg", budget_iters=64,
+                             slice_iters=8),
+                operands=ops)
+            h.result(timeout=120)
+            s = ex.stats()["train"]
+            assert s["jobs_submitted"] == 1
+            assert s["completed"] == 1
+            assert s["slices_run"] >= 1
+            agg = serve_mod.serve_stats()["train"]
+            assert agg["jobs_submitted"] >= 1
+        # the telemetry collector block aggregates the same counters
+        from libskylark_tpu.train.jobs import train_stats
+
+        assert train_stats()["jobs_submitted"] >= 1
+
+    def test_interactive_traffic_preempts_slices(self, sdir):
+        """Preemption at slice boundaries: under a steady interactive
+        stream the training job still completes (idle slots exist
+        between cohorts) and every slice that STARTED also landed —
+        slices_run on the executor equals the session journal's acked
+        sequence, i.e. nothing was torn mid-step."""
+        from libskylark_tpu import Context
+        from libskylark_tpu import sketch as sk
+        from libskylark_tpu.engine.serve import MicrobatchExecutor
+
+        ops = _lsqr_ops(seed=11)
+        rng = np.random.default_rng(0)
+        T = sk.JLT(8, 4, Context(seed=1))
+        with MicrobatchExecutor(name="t-preempt",
+                                linger_us=200) as ex:
+            stop = threading.Event()
+
+            def interactive_storm():
+                while not stop.is_set():
+                    f = ex.submit_sketch(
+                        T, rng.standard_normal((8, 6)),
+                        qos_class="interactive")
+                    f.result(timeout=30)
+
+            t = threading.Thread(target=interactive_storm,
+                                 daemon=True)
+            t.start()
+            try:
+                h = ex.submit_train_job(
+                    TrainJobSpec(solver="lsqr", budget_iters=64,
+                                 slice_iters=2),
+                    operands=ops)
+                out = h.result(timeout=180)
+            finally:
+                stop.set()
+                t.join(timeout=30)
+            s = ex.stats()["train"]
+        assert out["converged"]
+        # bit-equal to the direct run even interleaved with traffic
+        eng = make_engine("lsqr", {}, ops)
+        st = eng.init()
+        while not eng.info(st)["converged"]:
+            st = eng.step(st, 2)
+        assert np.array_equal(np.asarray(out["X"]),
+                              np.asarray(eng.result(st)["X"]))
+        assert s["completed"] == 1
+
+    def test_degraded_executor_sheds_submits(self, sdir):
+        from libskylark_tpu.engine.serve import (MicrobatchExecutor,
+                                                 ServeOverloadedError)
+
+        with MicrobatchExecutor(name="t-shed") as ex:
+            # stub the probe: train submits consult _is_degraded()
+            # exactly like session appends do
+            ex._is_degraded = lambda: True
+            with pytest.raises(ServeOverloadedError):
+                ex.submit_train_job(
+                    TrainJobSpec(solver="lsqr", budget_iters=8),
+                    operands=_lsqr_ops())
+            # shed BEFORE the manager was ever built: no job state
+            assert ex.stats()["train"] is None
+            assert ex._counts["train_shed"] == 1
+
+    def test_retry_budget_bounds_failures(self, sdir, monkeypatch):
+        from libskylark_tpu.engine.serve import MicrobatchExecutor
+        from libskylark_tpu.train import jobs as jobs_mod
+
+        ops = _lsqr_ops()
+        with MicrobatchExecutor(name="t-retry") as ex:
+            mgr = ex.train_jobs
+            calls = {"n": 0}
+            orig = ex.sessions.append
+
+            def flaky_append(*a, **kw):
+                calls["n"] += 1
+                raise RuntimeError("synthetic slice failure")
+
+            monkeypatch.setattr(ex.sessions, "append", flaky_append)
+            h = ex.submit_train_job(
+                TrainJobSpec(solver="lsqr", budget_iters=16,
+                             retry_budget=2),
+                operands=ops)
+            with pytest.raises(RuntimeError, match="synthetic"):
+                h.result(timeout=120)
+            s = mgr.stats()
+        del orig, jobs_mod
+        assert calls["n"] == 3          # first try + 2 retries
+        assert s["retries"] == 2
+        assert s["failed"] == 1
+
+
+class TestFleet:
+    """Router-level submission, resume chaining, and status."""
+
+    def test_fleet_submit_and_result(self, sdir):
+        from libskylark_tpu import fleet
+        from libskylark_tpu.fleet.router import Router
+
+        ops = _cg_ops(seed=9)
+        pool = fleet.ReplicaPool(2, backend="thread")
+        try:
+            router = Router(pool)
+            fut = router.submit_train_job(
+                TrainJobSpec(solver="cg", budget_iters=64,
+                             slice_iters=4).to_dict(),
+                operands=ops)
+            out = fut.result(timeout=120)
+            assert out["converged"]
+            eng = make_engine("cg", {}, ops)
+            st = eng.init()
+            while not eng.info(st)["converged"]:
+                st = eng.step(st, 4)
+            assert np.array_equal(np.asarray(out["X"]),
+                                  np.asarray(eng.result(st)["X"]))
+            assert router.stats()["train_jobs"] == 1
+        finally:
+            pool.shutdown()
+
+    def test_fleet_resume_after_owner_drain(self, sdir):
+        """The handoff leg in-process: the owner drains mid-job; the
+        router's resume chain lands the job on the survivor, which
+        continues from the drain checkpoint and finishes bit-equal."""
+        from libskylark_tpu import fleet
+        from libskylark_tpu.fleet.router import Router
+
+        ops = _krr_ops(seed=13)
+        pool = fleet.ReplicaPool(2, backend="thread")
+        try:
+            router = Router(pool)
+            # tol=0 disables the ADMM convergence test entirely: the
+            # job must run its whole 30-iteration budget in
+            # 1-iteration slices, giving the drain a wide boundary
+            # window to land in
+            fut = router.submit_train_job(
+                TrainJobSpec(solver="admm_krr", budget_iters=30,
+                             slice_iters=1,
+                             hyper={"num_features": 16,
+                                    "num_partitions": 2,
+                                    "lam": 1e-2, "seed": 3,
+                                    "tol": 0.0}).to_dict(),
+                operands=ops, session_id="train-drain-handoff")
+            owner = router.session_owner("train-drain-handoff")
+            assert owner is not None
+            deadline = time.monotonic() + 60
+            # wait for real progress so the drain checkpoint carries
+            # a non-trivial state
+            while time.monotonic() < deadline:
+                try:
+                    st = router.train_job_status("train-drain-handoff")
+                    if st["slices_done"] >= 2:
+                        break
+                except sk_errors.SkylarkError:
+                    pass
+                time.sleep(0.01)
+            pool.remove_replica(owner)  # graceful drain + departure
+            with pytest.raises(sk_errors.TrainBudgetExhaustedError) \
+                    as ei:
+                fut.result(timeout=120)
+            # exact-progress reporting survived the handoff: every
+            # requested iteration in the budget ran exactly once
+            assert ei.value.iterations == 30
+            assert router.stats()["train_resumes"] >= 1
+        finally:
+            pool.shutdown()
+
+
+class TestEnvKnobs:
+    def test_train_knobs_declared_and_propagated(self):
+        from libskylark_tpu.base import env as sk_env
+        from libskylark_tpu.fleet.replica import PROPAGATED_ENV
+
+        for var in ("SKYLARK_TRAIN_SLICE_ITERS",
+                    "SKYLARK_TRAIN_RETRY_BUDGET",
+                    "SKYLARK_TRAIN_CKPT_EVERY",
+                    "SKYLARK_TRAIN_DEADLINE_S"):
+            assert var in sk_env.REGISTRY, var
+            assert var in PROPAGATED_ENV, var
+
+    def test_knob_defaults_flow_into_spec(self, monkeypatch):
+        monkeypatch.setenv("SKYLARK_TRAIN_SLICE_ITERS", "5")
+        monkeypatch.setenv("SKYLARK_TRAIN_DEADLINE_S", "123.0")
+        spec = TrainJobSpec(solver="lsqr", budget_iters=8)
+        assert spec.eff_slice_iters == 5
+        assert spec.eff_deadline_s == 123.0
+        # explicit spec values beat the env
+        spec = TrainJobSpec(solver="lsqr", budget_iters=8,
+                            slice_iters=3, deadline_s=9.0)
+        assert spec.eff_slice_iters == 3
+        assert spec.eff_deadline_s == 9.0
+
+
+class TestMetricsDeclared:
+    def test_train_metrics_in_names_table(self):
+        from libskylark_tpu.telemetry.names import METRICS
+
+        for name, kind in (("train.jobs_submitted", "counter"),
+                           ("train.slices_run", "counter"),
+                           ("train.preemptions", "counter"),
+                           ("train.resumes", "counter"),
+                           ("train.budget_exhausted", "counter"),
+                           ("train.progress", "gauge"),
+                           ("train.residual", "gauge")):
+            assert METRICS.get(name) == kind, name
