@@ -1331,6 +1331,134 @@ def _cache(n_requests: int = 240, n_unique: int = 4,
         sys.exit(1)
 
 
+def _net(n_requests: int = 160, n_unique: int = 4,
+         max_batch: int = 8, rounds: int = 5) -> None:
+    """Loopback-TCP vs in-process front-door A/B (``python bench.py
+    --net``; backend-agnostic — run with JAX_PLATFORMS=cpu for the
+    hardware-free record; docs/networking).
+
+    Workload: the cache bench's hot-operand storm, submitted twice
+    against the SAME warmed 2-replica fleet — once through
+    ``Router.submit_sketch`` in-process, once through a
+    :class:`~libskylark_tpu.net.NetClient` over a loopback TCP
+    :class:`~libskylark_tpu.net.NetServer`. Both measured windows are
+    pure cache hits (zero flushes, zero compiles), so the rps delta
+    is exactly the wire tax: framing, the tagged codec, two socket
+    hops, and the server's dispatch thread. Results must be
+    bit-equal across the wire. Prints exactly one JSON line and
+    appends the loopback headline to ``benchmarks/ledger.json``."""
+    import jax
+    import numpy as np
+
+    from libskylark_tpu import Context, engine, fleet, net
+    from libskylark_tpu import sketch as sk
+    from libskylark_tpu.engine import resultcache as rc
+
+    engine.reset()
+    rng = np.random.default_rng(0)
+    s_dim = 64
+    uniq = []
+    for i in range(n_unique):
+        T = sk.JLT(256, s_dim, Context(seed=i))
+        A = rng.standard_normal((256, 24)).astype(np.float32)
+        uniq.append((T, A))
+
+    def fleet_entries(pool):
+        blocks = [pool.get(n).executor.stats().get("cache")
+                  for n in pool.names()]
+        return rc.merge_cache_blocks(
+            [b for b in blocks if b])["entries"]
+
+    pool = fleet.ReplicaPool(2, max_batch=max_batch, linger_us=2000,
+                             cache=True)
+    router = fleet.Router(pool, cache=True)
+    srv = net.NetServer(router)
+    client = net.NetClient(srv.address, seed=0)
+    try:
+        # warmup: one flush per unique, then barrier on the entry
+        # count so neither measured window can race the last warm
+        # insert into a spurious flush
+        for T, A in uniq:
+            router.submit_sketch(T, A).result(timeout=120)
+        deadline = time.monotonic() + 30
+        while (fleet_entries(pool) < n_unique
+               and time.monotonic() < deadline):
+            time.sleep(0.001)
+        # one loopback round-trip per unique warms the client's
+        # connection and the codec paths
+        for T, A in uniq:
+            client.submit("sketch_apply", transform=T, A=A,
+                          dimension=sk.COLUMNWISE).result(timeout=120)
+
+        def storm_inproc():
+            futs = [router.submit_sketch(*uniq[i % n_unique])
+                    for i in range(n_requests)]
+            return [np.asarray(f.result(timeout=120)) for f in futs]
+
+        def storm_loopback():
+            futs = [client.submit(
+                "sketch_apply", transform=uniq[i % n_unique][0],
+                A=uniq[i % n_unique][1], dimension=sk.COLUMNWISE)
+                for i in range(n_requests)]
+            return [np.asarray(f.result(timeout=120)) for f in futs]
+
+        def measure(storm):
+            best = float("inf")
+            outs = None
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                outs = storm()
+                best = min(best, time.perf_counter() - t0)
+            return n_requests / best, outs
+
+        st = engine.stats()
+        warm = (st.misses, st.recompiles)
+        rps_inproc, out_inproc = measure(storm_inproc)
+        rps_loopback, out_loopback = measure(storm_loopback)
+        st = engine.stats()
+        compiles = (st.misses - warm[0], st.recompiles - warm[1])
+        bit_equal = all(
+            np.array_equal(a, b)
+            for a, b in zip(out_loopback, out_inproc))
+        ns = srv.stats()
+        rec = {
+            "metric": "net_loopback_vs_inprocess",
+            "platform": jax.default_backend(),
+            "n_requests": n_requests,
+            "unique_requests": n_unique,
+            "rps_inprocess": round(rps_inproc, 1),
+            "rps_loopback": round(rps_loopback, 1),
+            "wire_tax_ratio": round(rps_loopback / rps_inproc, 3),
+            "bit_equal_to_inprocess": bit_equal,
+            # compiles across both measured windows: zero proves the
+            # A/B compares transport paths, not compilation luck
+            "compiles_measured": {"misses": compiles[0],
+                                  "recompiles": compiles[1]},
+            "server": {
+                "requests": ns["requests"],
+                "wire_errors": ns["wire_errors"],
+                "bytes_in": ns["bytes_in"],
+                "bytes_out": ns["bytes_out"],
+                "retries_represented": ns["retries_represented"],
+            },
+            "host_cores": os.cpu_count(),
+            "telemetry": _telemetry_snapshot(),
+        }
+    finally:
+        client.close()
+        srv.close()
+        router.close()
+        pool.shutdown()
+    print(json.dumps(rec), flush=True)
+    _ledger_append("net_loopback_hot_rps", rec["rps_loopback"])
+    ok = (bit_equal
+          and compiles == (0, 0)
+          and rec["server"]["wire_errors"] == 0
+          and rps_loopback > 0)
+    if not ok:
+        sys.exit(1)
+
+
 # ---------------------------------------------------------------------------
 # fleet-level measurement: N-replica router vs single executor
 # ---------------------------------------------------------------------------
@@ -3007,6 +3135,11 @@ if __name__ == "__main__":
         # cached vs uncached (bit-equality + zero-flush + single-
         # flight proof); backend-agnostic, in-process like --serve
         _cache()
+    elif "--net" in sys.argv:
+        # loopback-TCP vs in-process front-door A/B: hot cached storm
+        # through NetClient/NetServer vs Router.submit (bit-equality +
+        # zero-compile + zero-wire-error proof); backend-agnostic
+        _net()
     elif "--fwht" in sys.argv:
         # panel vs panel-free SRHT A/B: FWHT fold vs O(n*s) panel
         # contraction (bit-equality + zero-compile proof + ledger

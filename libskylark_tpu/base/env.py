@@ -745,6 +745,59 @@ CACHE_SINGLE_FLIGHT_TIMEOUT = declare(
         "leader start their own flight instead of waiting on a "
         "possibly wedged one (docs/caching, \"Single-flight\").")
 
+# -- network serve front door (docs/networking) -----------------------------
+
+NET_HOST = declare(
+    "SKYLARK_NET_HOST", default="127.0.0.1", kind="str",
+    doc="Bind address of the TCP serve front door "
+        "(:class:`libskylark_tpu.net.server.NetServer`). Loopback by "
+        "default — exposing the listener beyond the host is a "
+        "deliberate deployment decision, not a default.")
+
+NET_PORT = declare(
+    "SKYLARK_NET_PORT", default=0, parser=parse_int, kind="int",
+    doc="Bind port of the TCP serve front door. ``0`` (the default) "
+        "binds an ephemeral port — read ``NetServer.address`` after "
+        "construction (tests, smokes).")
+
+NET_MAX_CONNECTIONS = declare(
+    "SKYLARK_NET_MAX_CONNECTIONS", default=256,
+    parser=parse_positive_int, kind="int",
+    doc="Live-connection ceiling on the front door. A connection past "
+        "the ceiling is refused with a structured overload error frame "
+        "(code 118, docs/networking) rather than a silent reset.")
+
+NET_INFLIGHT_WINDOW = declare(
+    "SKYLARK_NET_INFLIGHT_WINDOW", default=32,
+    parser=parse_positive_int, kind="int",
+    doc="Per-connection inflight-request window. The reader thread "
+        "stops reading once this many responses are unflushed, so a "
+        "slow reader backpressures through TCP instead of buffering "
+        "responses without bound (docs/networking).")
+
+NET_DRAIN_TIMEOUT_S = declare(
+    "SKYLARK_NET_DRAIN_TIMEOUT_S", default=10.0, parser=parse_float,
+    kind="float",
+    doc="Socket-layer drain budget: how long ``NetServer.drain()`` "
+        "(and the SIGTERM preemption hook) waits after GOAWAY for "
+        "inflight responses to flush before closing connections.")
+
+NET_RETRY_BUDGET = declare(
+    "SKYLARK_NET_RETRY_BUDGET", default=3, parser=parse_int,
+    kind="int",
+    doc="Transport reconnect-resend attempts per request in "
+        ":class:`libskylark_tpu.net.client.NetClient`. Safe by "
+        "construction — a re-sent frame is byte-identical, so the "
+        "server's single-flight table coalesces it onto the original "
+        "flight (docs/networking, \"Retry & idempotency\"). 0 "
+        "disables transport retry.")
+
+NET_RETRY_BACKOFF_S = declare(
+    "SKYLARK_NET_RETRY_BACKOFF_S", default=0.05, parser=parse_float,
+    kind="float",
+    doc="Base backoff of the client's reconnect retry loop; actual "
+        "sleeps are decorrelated-jittered multiples, capped at 2 s.")
+
 # -- sketch kernels ---------------------------------------------------------
 
 PALLAS_MTILE = declare(

@@ -166,6 +166,27 @@ class TrainBudgetExhaustedError(SkylarkError):
         self.slices = int(slices)
 
 
+class WireProtocolError(CommunicationError):
+    """A network frame violated the serve wire protocol: bad magic,
+    CRC mismatch, a torn/truncated frame, an unknown verb, or an
+    unencodable value (:mod:`libskylark_tpu.net.wire`,
+    docs/networking). Never retried blindly — a malformed frame on a
+    stream means the stream itself has lost sync, so the connection
+    is torn down and the *client* reconnects and re-sends."""
+
+    code = 117
+
+
+#: The on-wire error code for :class:`libskylark_tpu.engine.serve
+#: .ServeOverloadedError`, which deliberately subclasses RuntimeError
+#: (backpressure is a transport condition, not a numerical-taxonomy
+#: member) and so cannot carry a ``code`` attribute of its own. The
+#: wire codec (:mod:`libskylark_tpu.net.wire`) maps it — and its
+#: fleet subclass ``NoHealthyReplicaError`` — to this code in both
+#: directions; the reconstructed exception carries ``retry_after_s``.
+WIRE_OVERLOADED_CODE = 118
+
+
 _CODE_TABLE = {
     cls.code: cls
     for cls in [
@@ -186,6 +207,7 @@ _CODE_TABLE = {
         SketchCoverageError,
         TenantQuotaError,
         TrainBudgetExhaustedError,
+        WireProtocolError,
     ]
 }
 

@@ -142,6 +142,7 @@ import dataclasses
 import functools
 import itertools
 import math
+import sys
 import threading
 import time
 import warnings
@@ -4285,6 +4286,16 @@ def serve_stats() -> dict:
                         "p99": _percentile(lat_all, 0.99),
                         "n": len(lat_all)}
     agg["by_replica"] = dict(sorted(by_replica.items()))
+    # network front-door rollup (docs/networking) — only when the net
+    # tier is actually loaded (the sys.modules guard keeps a pure
+    # in-process deployment from importing the socket layer just to
+    # report stats about it)
+    if "libskylark_tpu.net.server" in sys.modules:
+        try:
+            from libskylark_tpu.net.server import net_stats
+            agg["net"] = net_stats()
+        except Exception:  # noqa: BLE001 — stats must never fail serving
+            pass
     return agg
 
 

@@ -63,6 +63,7 @@ from __future__ import annotations
 import collections
 import heapq
 import itertools
+import sys
 import threading
 import time
 import uuid
@@ -1454,7 +1455,18 @@ class Router:
         with self._lock:
             degraded = sorted(self._degraded)
             removed = sorted(self._removed)
+        # network front-door rollup (docs/networking): present only
+        # when the net tier is loaded — the sys.modules guard keeps a
+        # pure in-process deployment from importing the socket layer
+        net = None
+        if "libskylark_tpu.net.server" in sys.modules:
+            try:
+                from libskylark_tpu.net.server import net_stats
+                net = net_stats()
+            except Exception:  # noqa: BLE001 — stats never fail serving
+                net = None
         return {
+            "net": net,
             "routed": routed,
             "affinity_hit": c.get("affinity_hit", 0),
             "affinity_hit_rate": (

@@ -1,0 +1,19 @@
+"""Network serve front door: wire protocol, TCP server, client.
+
+The delivery path for the serve stack (docs/networking): a
+deterministic pickle-free framed protocol (:mod:`~libskylark_tpu.net
+.wire`), a threaded TCP server adapting connections onto the fleet
+router (:mod:`~libskylark_tpu.net.server`), and a retry-safe blocking
+client with the same future-shaped surface as ``Router.submit``
+(:mod:`~libskylark_tpu.net.client`). Everything above the socket —
+QoS admission, single-flight coalescing, caching, sessions, training
+— is the existing in-process stack; the net tier only moves frames.
+"""
+
+from __future__ import annotations
+
+from libskylark_tpu.net.client import NetClient
+from libskylark_tpu.net.server import NetServer, net_stats
+from libskylark_tpu.net.wire import PeerClosed
+
+__all__ = ["NetClient", "NetServer", "PeerClosed", "net_stats"]

@@ -61,6 +61,18 @@ Sites planted today:
                       acked prefix and continues bit-equal (the train
                       chaos gate's kill point); an error spec fails
                       one slice and the job's retry budget re-runs it
+``net.accept``        the TCP front door's accept path, once per
+                      accepted connection (:mod:`libskylark_tpu.net
+                      .server` — a fired fault closes the fresh
+                      socket before any frame is read; the client's
+                      reconnect budget absorbs it)
+``net.read``          one frame read on a server connection — a fired
+                      fault tears the connection down mid-stream;
+                      inflight futures detach and the client's
+                      byte-identical re-send coalesces onto the
+                      original flight (docs/networking)
+``net.write``         one frame write on a server connection — same
+                      teardown semantics from the response side
 ====================  ====================================================
 
 A plan is a JSON document (or the equivalent dict)::

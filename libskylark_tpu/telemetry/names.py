@@ -116,6 +116,14 @@ METRICS: Dict[str, str] = {
     "train.budget_exhausted": "counter",
     "train.progress": "gauge",
     "train.residual": "gauge",
+    # network serve front door (net/server.py, docs/networking) —
+    # rendered as skylark_net_* on Prometheus via the net collector
+    "net.connections": "gauge",
+    "net.requests": "counter",
+    "net.wire_errors": "counter",
+    "net.bytes_in": "counter",
+    "net.bytes_out": "counter",
+    "net.drains": "counter",
 }
 
 __all__ = ["METRICS"]

@@ -1,0 +1,515 @@
+"""Network serve front door (libskylark_tpu/net/, docs/networking).
+
+Oracles:
+
+- *codec determinism + fidelity*: every supported operand shape
+  (strided views, F-order, f64, CSR parts, numpy scalars, transforms,
+  nested containers) round-trips bit-equal through the tagged codec,
+  and the same logical request packs to byte-identical frames;
+- *frame integrity*: a torn frame, a flipped payload byte, or bad
+  magic is a :class:`WireProtocolError`, never a mis-decoded value; a
+  clean EOF between frames is :class:`PeerClosed`;
+- *transport propagation*: tenant / qos_class / deadline / request_id
+  cross the wire into ``Router.submit`` exactly as given, and
+  structured errors come back as the same exception type with
+  ``retry_after_s`` intact;
+- *resilience*: a client disconnect mid-request detaches the server
+  future without poisoning anything; a GOAWAY drain settles inflight
+  work with zero client-visible failures; a reconnect re-send of
+  identical bytes coalesces onto the cache/single-flight tier so the
+  engine flushes exactly once;
+- *observability*: the server-side ``net.serve`` span parents under
+  the client's span — one trace end to end.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from libskylark_tpu import Context, engine, fleet, net, telemetry
+from libskylark_tpu import sketch as sk
+from libskylark_tpu.base import errors as sk_errors
+from libskylark_tpu.base.sparse import SparseMatrix
+from libskylark_tpu.engine.serve import ServeOverloadedError
+from libskylark_tpu.net import wire
+from libskylark_tpu.resilience import faults
+
+
+@pytest.fixture()
+def fresh_engine():
+    engine.reset()
+    yield
+    engine.reset()
+
+
+def _round_trip(value):
+    bodies: list = []
+    spec = wire.encode_value(value, bodies)
+    frame = wire.encode_frame({"t": "res", "value": spec,
+                               "nb": len(bodies)}, tuple(bodies))
+    header, out_bodies = wire.read_frame(io.BytesIO(frame).read)
+    return wire.decode_value(header["value"], out_bodies)
+
+
+class TestWireCodec:
+    def test_array_shapes_round_trip(self):
+        rng = np.random.default_rng(0)
+        base = rng.standard_normal((16, 12))
+        cases = [
+            base.astype(np.float32),
+            base,                                   # f64
+            np.asfortranarray(base.astype(np.float32)),
+            base[::2, ::3],                         # strided view
+            base[5],                                # 1-D
+            np.arange(7, dtype=np.int64),
+            np.float32(2.5),                        # numpy scalar
+            np.array(3.0),                          # 0-d
+        ]
+        for v in cases:
+            got = _round_trip(v)
+            assert np.array_equal(np.asarray(got), np.asarray(v))
+            assert np.asarray(got).dtype == np.asarray(v).dtype
+
+    def test_csr_round_trips_without_densify(self):
+        A = sp.random(40, 30, density=0.1, random_state=0,
+                      format="csr", dtype=np.float32)
+        m = SparseMatrix.from_csr(A.data, A.indices, A.indptr, A.shape)
+        bodies: list = []
+        spec = wire.encode_value(m, bodies)
+        assert spec["k"] == "csr"       # parts, never a dense body
+        got = _round_trip(m)
+        assert isinstance(got, SparseMatrix)
+        assert got.shape == m.shape
+        for a, b in zip(got.csr_parts(), m.csr_parts()):
+            assert np.array_equal(a, b)
+
+    def test_nested_containers_and_scalars(self):
+        v = {"a": [1, 2.5, "x", None, True],
+             "b": (np.arange(3), {"c": np.float64(1.5)}),
+             "d": sk.COLUMNWISE}
+        got = _round_trip(v)
+        assert got["a"] == v["a"]
+        assert isinstance(got["b"], tuple)
+        assert np.array_equal(got["b"][0], np.arange(3))
+        assert got["b"][1]["c"] == 1.5
+        assert got["d"] is sk.COLUMNWISE
+
+    def test_sketch_transform_round_trips(self):
+        T = sk.CWT(64, 16, Context(seed=9))
+        got = _round_trip(T)
+        A = np.random.default_rng(1).standard_normal(
+            (64, 4)).astype(np.float32)
+        assert np.array_equal(
+            np.asarray(got.apply(A, sk.COLUMNWISE)),
+            np.asarray(T.apply(A, sk.COLUMNWISE)))
+
+    def test_unencodable_values_refused(self):
+        with pytest.raises(sk_errors.WireProtocolError):
+            wire.encode_value(object(), [])
+        with pytest.raises(sk_errors.WireProtocolError):
+            wire.encode_value({1: "non-str key"}, [])
+
+    def test_request_packing_is_deterministic(self):
+        A = np.random.default_rng(2).standard_normal((8, 3))
+        f1 = wire.pack_request("sketch_apply", {"A": A, "k": 2}, seq=7)
+        f2 = wire.pack_request("sketch_apply", {"A": A, "k": 2}, seq=7)
+        assert f1 == f2
+        # a different operand changes the transport digest
+        f3 = wire.pack_request("sketch_apply", {"A": A + 1, "k": 2},
+                               seq=7)
+        h1, _ = wire.read_frame(io.BytesIO(f1).read)
+        h3, _ = wire.read_frame(io.BytesIO(f3).read)
+        assert h1["digest"] != h3["digest"]
+
+
+class TestFraming:
+    def _frame(self):
+        return wire.pack_request("ping", {"A": np.arange(4)}, seq=1)
+
+    def test_torn_frame_rejected(self):
+        frame = self._frame()
+        with pytest.raises(sk_errors.WireProtocolError,
+                           match="mid-frame"):
+            wire.read_frame(io.BytesIO(frame[:-3]).read)
+
+    def test_bad_crc_rejected(self):
+        frame = bytearray(self._frame())
+        frame[-1] ^= 0xFF               # flip a payload byte
+        with pytest.raises(sk_errors.WireProtocolError, match="CRC"):
+            wire.read_frame(io.BytesIO(bytes(frame)).read)
+
+    def test_bad_magic_rejected(self):
+        frame = b"XXXX" + self._frame()[4:]
+        with pytest.raises(sk_errors.WireProtocolError, match="magic"):
+            wire.read_frame(io.BytesIO(frame).read)
+
+    def test_clean_eof_is_peer_closed(self):
+        with pytest.raises(wire.PeerClosed):
+            wire.read_frame(io.BytesIO(b"").read)
+
+    def test_trailing_bytes_rejected(self):
+        frame = self._frame()
+        import struct
+        import zlib
+        payload = frame[12:] + b"junk"
+        bad = (wire.MAGIC
+               + struct.pack("<II", len(payload), zlib.crc32(payload))
+               + payload)
+        with pytest.raises(sk_errors.WireProtocolError,
+                           match="trailing"):
+            wire.read_frame(io.BytesIO(bad).read)
+
+    def test_error_frame_round_trips_retry_fields(self):
+        exc = sk_errors.TenantQuotaError(
+            "over quota", tenant="team-a", retry_after_s=1.25)
+        h, _ = wire.read_frame(io.BytesIO(wire.pack_error(4, exc)).read)
+        back = wire.unpack_error(h)
+        assert isinstance(back, sk_errors.TenantQuotaError)
+        assert back.tenant == "team-a"
+        assert back.retry_after_s == 1.25
+        over = ServeOverloadedError("shed")
+        over.retry_after_s = 0.5
+        h2, _ = wire.read_frame(
+            io.BytesIO(wire.pack_error(5, over)).read)
+        back2 = wire.unpack_error(h2)
+        assert isinstance(back2, ServeOverloadedError)
+        assert back2.retry_after_s == 0.5
+
+
+class _StubRouter:
+    """Records ``submit`` kwargs and settles through controllable
+    futures — the transport-propagation oracle without a fleet."""
+
+    def __init__(self):
+        self.calls: list = []
+        self.raise_exc = None
+        self.hold = False
+        self.held: list = []
+
+    def submit(self, endpoint, /, **kwargs):
+        self.calls.append((endpoint, kwargs))
+        if self.raise_exc is not None:
+            raise self.raise_exc
+        fut: Future = Future()
+        if self.hold:
+            self.held.append(fut)
+        else:
+            fut.set_result(np.arange(3, dtype=np.float32))
+        return fut
+
+    def stats(self):
+        return {"stub": True}
+
+
+def _serve_stub(stub, **kw):
+    srv = net.NetServer(stub, **kw)
+    return srv, net.NetClient(srv.address, retry_budget=1,
+                              retry_backoff_s=0.01, seed=0)
+
+
+class TestTransportPropagation:
+    def test_tenant_qos_deadline_cross_the_wire(self):
+        stub = _StubRouter()
+        srv, c = _serve_stub(stub)
+        try:
+            out = c.submit("sketch_apply", tenant="team-a",
+                           qos_class="interactive", deadline=30.0,
+                           timeout=12.0, A=np.ones(2)).result(timeout=10)
+            assert np.array_equal(out, np.arange(3, dtype=np.float32))
+            endpoint, kw = stub.calls[0]
+            assert endpoint == "sketch_apply"
+            assert kw["tenant"] == "team-a"
+            assert kw["qos_class"] == "interactive"
+            assert 25.0 < kw["deadline"] <= 30.0
+            assert kw["timeout"] == 12.0
+            assert str(kw["request_id"]).startswith("req-")
+            assert np.array_equal(kw["A"], np.ones(2))
+        finally:
+            c.close()
+            srv.close()
+
+    def test_quota_error_retry_after_fidelity(self):
+        stub = _StubRouter()
+        stub.raise_exc = sk_errors.TenantQuotaError(
+            "bucket empty", tenant="team-b", retry_after_s=2.5)
+        srv, c = _serve_stub(stub)
+        try:
+            fut = c.submit("sketch_apply", A=np.ones(2))
+            with pytest.raises(sk_errors.TenantQuotaError) as ei:
+                fut.result(timeout=10)
+            assert ei.value.retry_after_s == 2.5
+            assert ei.value.tenant == "team-b"
+            assert srv.stats()["by_code"].get("115") == 1
+        finally:
+            c.close()
+            srv.close()
+
+    def test_overload_error_survives_the_wire(self):
+        stub = _StubRouter()
+        exc = ServeOverloadedError("queue full")
+        exc.retry_after_s = 0.75
+        stub.raise_exc = exc
+        srv, c = _serve_stub(stub)
+        try:
+            with pytest.raises(ServeOverloadedError) as ei:
+                c.submit("sketch_apply", A=np.ones(2)).result(timeout=10)
+            assert ei.value.retry_after_s == 0.75
+        finally:
+            c.close()
+            srv.close()
+
+    def test_unknown_verb_is_protocol_error(self):
+        stub = _StubRouter()
+        srv, c = _serve_stub(stub)
+        try:
+            with pytest.raises(sk_errors.WireProtocolError,
+                               match="unknown verb"):
+                c.submit("no.such.verb").result(timeout=10)
+        finally:
+            c.close()
+            srv.close()
+
+
+class TestDisconnectAndDrain:
+    def test_disconnect_mid_request_detaches(self):
+        stub = _StubRouter()
+        stub.hold = True
+        srv, c = _serve_stub(stub)
+        try:
+            fut = c.submit("sketch_apply", A=np.ones(2))
+            deadline = time.monotonic() + 10
+            while not stub.held and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert stub.held, "request never reached the stub router"
+            c.close()               # vanish with the request inflight
+            with pytest.raises(sk_errors.CommunicationError):
+                fut.result(timeout=10)
+            # wait for the server to notice the dead peer BEFORE the
+            # future settles — that is the detach-mid-request window
+            deadline = time.monotonic() + 10
+            while (srv.stats()["connections_live"] > 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert srv.stats()["connections_live"] == 0
+            assert srv.stats()["disconnected_inflight"] == 1
+            # settling the orphaned future must not poison the server
+            stub.held[0].set_result(np.zeros(1, dtype=np.float32))
+            stub.hold = False
+            c2 = net.NetClient(srv.address)
+            try:
+                assert c2.ping() == "pong"
+            finally:
+                c2.close()
+        finally:
+            c.close()
+            srv.close()
+
+    def test_goaway_drain_settles_inflight(self):
+        stub = _StubRouter()
+        stub.hold = True
+        srv, c = _serve_stub(stub)
+        try:
+            fut = c.submit("sketch_apply", A=np.ones(2))
+            deadline = time.monotonic() + 10
+            while not stub.held and time.monotonic() < deadline:
+                time.sleep(0.005)
+            drained: list = []
+            t = threading.Thread(
+                target=lambda: drained.append(srv.drain(timeout=10)))
+            t.start()
+            # the drain waits on the inflight response; settle it
+            time.sleep(0.05)
+            stub.held[0].set_result(np.full(2, 7.0, dtype=np.float32))
+            t.join(timeout=15)
+            assert drained == [True]
+            # zero client-visible failures: the future resolved
+            assert np.array_equal(fut.result(timeout=10),
+                                  np.full(2, 7.0, dtype=np.float32))
+            assert c.client_stats()["goaways_seen"] == 1
+            assert srv.stats()["drains"] == 1
+            assert srv.stats()["goaways_sent"] == 1
+        finally:
+            c.close()
+            srv.close()
+
+    def test_refused_past_max_connections(self):
+        stub = _StubRouter()
+        srv = net.NetServer(stub, max_connections=1)
+        c1 = net.NetClient(srv.address, retry_budget=0)
+        try:
+            assert c1.ping() == "pong"
+            c2 = net.NetClient(srv.address, retry_budget=0)
+            try:
+                with pytest.raises((ServeOverloadedError,
+                                    sk_errors.CommunicationError)):
+                    c2.ping(timeout=10)
+            finally:
+                c2.close()
+            assert srv.stats()["refused"] >= 1
+        finally:
+            c1.close()
+            srv.close()
+
+
+def _fleet_cache_stats(pool) -> dict:
+    from libskylark_tpu.engine import resultcache as rc
+
+    blocks = [pool.get(n).executor.stats().get("cache")
+              for n in pool.names()]
+    merged = rc.merge_cache_blocks([b for b in blocks if b])
+    merged["flushes"] = sum(
+        pool.get(n).executor.stats()["flushes"] for n in pool.names())
+    return merged
+
+
+class TestRetryCoalescing:
+    def test_reconnect_resend_flushes_exactly_once(self, fresh_engine):
+        """The retry-idempotency contract end to end: compute once,
+        tear the connection, re-send the identical request — the
+        cache/single-flight tier answers, the engine never re-flushes."""
+        pool = fleet.ReplicaPool(1, max_batch=8, linger_us=500,
+                                 cache=True)
+        router = fleet.Router(pool, cache=True)
+        srv = net.NetServer(router)
+        c = net.NetClient(srv.address, retry_backoff_s=0.01, seed=1)
+        try:
+            T = sk.CWT(128, 32, Context(seed=5))
+            A = np.random.default_rng(3).standard_normal(
+                (128, 4)).astype(np.float32)
+            first = np.asarray(c.submit(
+                "sketch_apply", transform=T, A=A,
+                dimension=sk.COLUMNWISE).result(timeout=120))
+            deadline = time.monotonic() + 30
+            while (_fleet_cache_stats(pool)["entries"] < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            st0 = _fleet_cache_stats(pool)
+            assert st0["flushes"] == 1
+            # simulate a torn connection between the two sends
+            with c._lock:
+                sock = c._sock
+            sock.close()
+            again = np.asarray(c.submit(
+                "sketch_apply", transform=T, A=A,
+                dimension=sk.COLUMNWISE).result(timeout=120))
+            st1 = _fleet_cache_stats(pool)
+            assert st1["flushes"] == 1      # exactly one engine flush
+            assert st1["hits"] >= 1
+            assert np.array_equal(first, again)
+            # exactly ONE recovery: the dead socket is noticed by both
+            # the failed sendall and the reader's EOF, and double
+            # harvesting would re-send the frame twice (billing two
+            # attempts and waking an idle server reader later)
+            assert c.client_stats()["transport_retries"] == 1
+        finally:
+            c.close()
+            srv.close()
+            router.close()
+            pool.shutdown()
+
+    def test_net_read_fault_absorbed_by_retry(self, fresh_engine):
+        """A chaos ``net.read`` fault tears one server connection; the
+        client's bounded reconnect-resend absorbs it invisibly."""
+        pool = fleet.ReplicaPool(1, max_batch=8, linger_us=500,
+                                 cache=True)
+        router = fleet.Router(pool, cache=True)
+        srv = net.NetServer(router)
+        c = net.NetClient(srv.address, retry_budget=3,
+                          retry_backoff_s=0.01, seed=2)
+        try:
+            T = sk.CWT(128, 32, Context(seed=6))
+            A = np.random.default_rng(4).standard_normal(
+                (128, 4)).astype(np.float32)
+            plan = {"seed": 1, "faults": [
+                {"site": "net.read", "error": "IOError_", "times": 1}]}
+            with faults.fault_plan(plan):
+                out = np.asarray(c.submit(
+                    "sketch_apply", transform=T, A=A,
+                    dimension=sk.COLUMNWISE).result(timeout=120))
+                fired = faults.fired()
+            assert [f[0] for f in fired] == ["net.read"]
+            import jax.numpy as jnp
+            want = np.asarray(T.apply(jnp.asarray(A), sk.COLUMNWISE))
+            assert np.array_equal(out, want)
+            assert _fleet_cache_stats(pool)["flushes"] == 1
+            assert c.client_stats()["transport_retries"] >= 1
+        finally:
+            c.close()
+            srv.close()
+            router.close()
+            pool.shutdown()
+
+
+class TestSpanContinuity:
+    def test_server_span_parents_under_client_span(self):
+        stub = _StubRouter()
+        telemetry.set_enabled(True)
+        try:
+            import libskylark_tpu.telemetry.trace as trace_mod
+
+            trace_mod.clear_finished()
+            srv, c = _serve_stub(stub)
+            try:
+                with trace_mod.span("client.op", force=True,
+                                    request_id="req-net-test-1") as sp:
+                    ctx = sp.context()
+                    c.submit("sketch_apply",
+                             A=np.ones(2)).result(timeout=10)
+                deadline = time.monotonic() + 10
+                serve_spans = []
+                while not serve_spans and time.monotonic() < deadline:
+                    serve_spans = [s for s in trace_mod.finished_spans()
+                                   if s.name == "net.serve"]
+                    time.sleep(0.005)
+                assert serve_spans, "no net.serve span recorded"
+                s = serve_spans[0]
+                assert s.trace_id == ctx.trace_id
+                assert s.parent_id == ctx.span_id
+                assert s.request_id == ctx.request_id
+                assert s.attrs["verb"] == "sketch_apply"
+            finally:
+                c.close()
+                srv.close()
+        finally:
+            telemetry.set_enabled(False)
+
+
+class TestStatsSurfaces:
+    def test_net_stats_and_prometheus(self):
+        stub = _StubRouter()
+        srv, c = _serve_stub(stub)
+        try:
+            c.ping()
+            ns = net.net_stats()
+            assert ns["servers"] >= 1
+            assert ns["requests"] >= 1
+            assert ns["by_verb"]["ping"]["requests"] >= 1
+            telemetry.set_enabled(True)
+            try:
+                text = telemetry.prometheus_text()
+            finally:
+                telemetry.set_enabled(False)
+            assert "skylark_net_requests" in text
+        finally:
+            c.close()
+            srv.close()
+
+    def test_serve_stats_gains_net_block(self, fresh_engine):
+        from libskylark_tpu.engine.serve import serve_stats
+
+        stub = _StubRouter()
+        srv, c = _serve_stub(stub)
+        try:
+            c.ping()
+            blk = serve_stats().get("net")
+            assert blk is not None and blk["requests"] >= 1
+        finally:
+            c.close()
+            srv.close()
